@@ -1,0 +1,83 @@
+"""evolu_tpu — a TPU-native local-first data framework.
+
+A brand-new framework with the capabilities of Evolu (the TypeScript
+reference surveyed in SURVEY.md): reactive SQLite storage, a
+last-write-wins CRDT over (table, row, column) cells, hybrid logical
+clocks, Merkle-tree anti-entropy sync, end-to-end encryption,
+mnemonic-derived identity, and a blind relay server.
+
+The design is TPU-first: CRDT message batches are columnar arrays; the
+merge hot path (LWW resolution, HLC comparison, Merkle insert/diff) runs
+as batched JAX/XLA kernels (`evolu_tpu.ops`), owners shard over a device
+mesh (`evolu_tpu.parallel`), and SQLite remains the durable store with
+byte-identical end state to the reference semantics
+(`evolu_tpu.storage`).
+
+Public API mirrors the reference's surface (reference:
+packages/evolu/src/index.ts):
+- `create_evolu(schema, config)` — the client runtime (useQuery /
+  mutate analogs live on the returned handle).
+- `model` — branded column types and casting helpers.
+- errors, Owner, mnemonic restore, etc.
+"""
+
+from evolu_tpu.core.timestamp import (
+    Timestamp,
+    timestamp_to_string,
+    timestamp_from_string,
+    timestamp_to_hash,
+    send_timestamp,
+    receive_timestamp,
+    create_initial_timestamp,
+    create_sync_timestamp,
+)
+from evolu_tpu.core.merkle import (
+    create_initial_merkle_tree,
+    insert_into_merkle_tree,
+    diff_merkle_trees,
+    merkle_tree_to_string,
+    merkle_tree_from_string,
+)
+from evolu_tpu.core.types import (
+    CrdtMessage,
+    NewCrdtMessage,
+    CrdtClock,
+    TimestampDriftError,
+    TimestampCounterOverflowError,
+    TimestampDuplicateNodeError,
+    SyncError,
+    EvoluError,
+)
+from evolu_tpu.core.ids import create_id, create_node_id, mnemonic_to_owner_id
+from evolu_tpu.utils.config import Config
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Timestamp",
+    "timestamp_to_string",
+    "timestamp_from_string",
+    "timestamp_to_hash",
+    "send_timestamp",
+    "receive_timestamp",
+    "create_initial_timestamp",
+    "create_sync_timestamp",
+    "create_initial_merkle_tree",
+    "insert_into_merkle_tree",
+    "diff_merkle_trees",
+    "merkle_tree_to_string",
+    "merkle_tree_from_string",
+    "CrdtMessage",
+    "NewCrdtMessage",
+    "CrdtClock",
+    "TimestampDriftError",
+    "TimestampCounterOverflowError",
+    "TimestampDuplicateNodeError",
+    "SyncError",
+    "EvoluError",
+    "create_id",
+    "create_node_id",
+    "mnemonic_to_owner_id",
+    "Config",
+    "__version__",
+]

@@ -1,0 +1,6 @@
+"""Pure CPU reference core ("the oracle").
+
+Exact reference semantics for HLC timestamps, murmur3 hashing, the
+Merkle trie, and LWW message application. Every JAX/TPU kernel in
+`evolu_tpu.ops` is property-tested against this module.
+"""

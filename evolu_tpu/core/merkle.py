@@ -1,0 +1,161 @@
+"""Merkle trie anti-entropy digest, bit-exact with the reference.
+
+Reference: packages/evolu/src/merkleTree.ts. A ternary trie keyed by
+base-3-encoded minutes-since-epoch (truncated to int32 via JS `| 0`,
+merkleTree.ts:39). Each node's hash is the XOR of murmur3 hashes of all
+timestamps under that prefix; **hash values are JS signed int32** —
+`undefined ^ h` and `a ^ b` in JS coerce to int32 — which this module
+reproduces so serialized trees interoperate byte-for-byte with
+reference replicas.
+
+Tree representation: a dict with optional keys "hash" (signed int32)
+and "0"/"1"/"2" (child dicts). Matches the reference JSON wire shape
+(types.ts:80-84) directly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from evolu_tpu.core.murmur import to_int32
+from evolu_tpu.core.timestamp import timestamp_to_hash
+from evolu_tpu.core.types import Timestamp
+
+MERKLE_KEY_LENGTH = 16  # base-3 digits of int32 minutes (merkleTree.ts:55-61)
+
+
+def create_initial_merkle_tree() -> dict:
+    return {}
+
+
+def minutes_base3(millis: int) -> str:
+    """merkleTree.ts:39 — `((millis/1000/60) | 0).toString(3)` (no padding)."""
+    minutes = int(millis / 1000 / 60) & 0xFFFFFFFF
+    if minutes >= 0x80000000:  # JS |0 is signed; millis >= 0 keeps this positive until ~year 6053
+        minutes -= 0x100000000
+    sign = "-" if minutes < 0 else ""  # JS Number.toString(3) keeps the sign prefix
+    m = abs(minutes)
+    if m == 0:
+        return "0"
+    digits = []
+    while m:
+        digits.append(str(m % 3))
+        m //= 3
+    return sign + "".join(reversed(digits))
+
+
+def key_to_timestamp_millis(key: str) -> int:
+    """merkleTree.ts:55-61 — right-pad the prefix to 16 digits, parse base 3, to millis."""
+    fullkey = key + "0" * (MERKLE_KEY_LENGTH - len(key))
+    return int(fullkey, 3) * 1000 * 60
+
+
+def _xor(a: Optional[int], b: int) -> int:
+    """JS `a ^ b` with `undefined ^ b === b | 0` (merkleTree.ts:26,45)."""
+    return to_int32((a or 0) ^ b)
+
+
+def insert_into_merkle_tree(t: Timestamp, tree: dict) -> dict:
+    """merkleTree.ts:31-50. Returns a new tree; input is not mutated."""
+    key = minutes_base3(t.millis)
+    h = timestamp_to_hash(t)
+    new_tree = dict(tree)
+    new_tree["hash"] = _xor(tree.get("hash"), h)
+    node = new_tree
+    for c in key:
+        child = dict(node.get(c) or {})
+        child["hash"] = _xor(child.get("hash"), h)
+        node[c] = child
+        node = child
+    return new_tree
+
+
+def insert_many_into_merkle_tree(timestamps, tree: dict) -> dict:
+    """Batch insert (order-independent since XOR commutes). In-place on a copy."""
+    for t in timestamps:
+        tree = insert_into_merkle_tree(t, tree)
+    return tree
+
+
+def apply_prefix_xors(tree: dict, prefix_xors: dict) -> dict:
+    """Apply precomputed {base3-minute-key: xor-of-hashes} deltas to a tree.
+
+    This is the host-side half of the TPU batch insert: the device
+    reduces a message batch to one XOR delta per distinct minute
+    (evolu_tpu.ops.merkle_ops); applying those deltas here touches only
+    O(distinct minutes * 16) nodes. Equivalent to folding
+    insert_into_merkle_tree over the batch.
+    """
+    new_tree = dict(tree)
+    for key, h in prefix_xors.items():
+        # A zero delta (even number of identical hashes in the batch) must
+        # still materialize the path nodes, exactly as individual inserts
+        # would — so no skip here.
+        new_tree["hash"] = _xor(new_tree.get("hash"), h)
+        node = new_tree
+        for c in key:
+            child = dict(node.get(c) or {})
+            child["hash"] = _xor(child.get("hash"), h)
+            node[c] = child
+            node = child
+    return new_tree
+
+
+def _child_keys(tree: dict):
+    # getKeys (merkleTree.ts:52-53) filters only "hash" — any other key
+    # (e.g. a "-" from a negative-minutes key) participates in the walk.
+    return [k for k in tree if k != "hash"]
+
+
+def diff_merkle_trees(tree1: dict, tree2: dict) -> Optional[int]:
+    """merkleTree.ts:63-91 — earliest minute (as millis) where trees diverge, else None.
+
+    Walk both trees from the root; at each level take the sorted union
+    of child keys and descend into the first child whose hashes differ.
+    `None` (JS undefined) hash is distinct from hash 0.
+    """
+    if tree1.get("hash") == tree2.get("hash"):
+        return None
+    node1, node2 = tree1, tree2
+    k = ""
+    while True:
+        keys = sorted(set(_child_keys(node1)) | set(_child_keys(node2)))
+        diffkey = None
+        for key in keys:
+            next1 = node1.get(key) or {}
+            next2 = node2.get(key) or {}
+            if next1.get("hash") != next2.get("hash"):
+                diffkey = key
+                break
+        if diffkey is None:
+            return key_to_timestamp_millis(k)
+        k += diffkey
+        node1 = node1.get(diffkey) or {}
+        node2 = node2.get(diffkey) or {}
+
+
+def _ordered(tree: dict) -> dict:
+    """Recursively order keys the way JS object property order does:
+
+    integer-like keys ("0","1","2") ascending first, then "hash" —
+    matching JSON.stringify output of the reference so serialized trees
+    are byte-identical.
+    """
+    out = {}
+    for k in ("0", "1", "2"):
+        if k in tree:
+            out[k] = _ordered(tree[k])
+    if "hash" in tree:
+        out["hash"] = tree["hash"]
+    return out
+
+
+def merkle_tree_to_string(tree: dict) -> str:
+    """types.ts:80-81 — JSON with JS property order and no whitespace."""
+    return json.dumps(_ordered(tree), separators=(",", ":"))
+
+
+def merkle_tree_from_string(s: str) -> dict:
+    """types.ts:83-84."""
+    return json.loads(s)

@@ -1,0 +1,140 @@
+"""Hybrid logical clocks with the reference's exact semantics.
+
+Reference: packages/evolu/src/timestamp.ts. The critical invariant
+(timestamp.ts:43-48): the string encoding
+`ISO8601(millis) + "-" + HEX4(counter) + "-" + node` is fixed-width, so
+lexicographic order of timestamp strings equals the (millis, counter,
+node) tuple order. All LWW comparisons — Python, SQL `ORDER BY`, and
+the TPU kernels' packed u64 keys — rely on this.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Optional
+
+from evolu_tpu.core.murmur import murmur3_32
+from evolu_tpu.core.types import (
+    MAX_COUNTER,
+    Timestamp,
+    TimestampCounterOverflowError,
+    TimestampDriftError,
+    TimestampDuplicateNodeError,
+    TimestampParseError,
+)
+from evolu_tpu.core.ids import create_node_id
+
+SYNC_NODE_ID = "0000000000000000"
+TIMESTAMP_STRING_LENGTH = 46  # 24 (ISO) + 1 + 4 (hex counter) + 1 + 16 (node)
+
+
+def create_initial_timestamp(node: Optional[str] = None) -> Timestamp:
+    """timestamp.ts:27-31 — millis 0, counter 0, fresh random node id."""
+    return Timestamp(0, 0, node if node is not None else create_node_id())
+
+
+def create_sync_timestamp(millis: int = 0) -> Timestamp:
+    """timestamp.ts:35-41 — node id all zeros; used for 'everything after minute X' range queries."""
+    return Timestamp(millis, 0, SYNC_NODE_ID)
+
+
+def millis_to_iso(millis: int) -> str:
+    """JS `new Date(millis).toISOString()` for 0 <= millis (years 1970-9999).
+
+    Always `YYYY-MM-DDTHH:mm:ss.sssZ` (24 chars, 3-digit millis) —
+    the fixed width is what makes string order == numeric order.
+    """
+    dt = datetime.datetime.fromtimestamp(millis // 1000, tz=datetime.timezone.utc)
+    return f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}T{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}.{millis % 1000:03d}Z"
+
+
+def iso_to_millis(iso: str) -> int:
+    """Inverse of millis_to_iso (JS Date.parse on the ISO string)."""
+    if (
+        len(iso) != 24
+        or iso[4] != "-" or iso[7] != "-" or iso[10] != "T"
+        or iso[13] != ":" or iso[16] != ":" or iso[19] != "."
+        or iso[23] != "Z"
+    ):
+        raise TimestampParseError(f"bad ISO timestamp: {iso!r}")
+    digits = iso[0:4] + iso[5:7] + iso[8:10] + iso[11:13] + iso[14:16] + iso[17:19] + iso[20:23]
+    if not digits.isascii() or not digits.isdigit():
+        raise TimestampParseError(f"bad ISO timestamp: {iso!r}")
+    try:
+        dt = datetime.datetime(
+            int(iso[0:4]), int(iso[5:7]), int(iso[8:10]),
+            int(iso[11:13]), int(iso[14:16]), int(iso[17:19]),
+            tzinfo=datetime.timezone.utc,
+        )
+    except ValueError as e:
+        raise TimestampParseError(f"bad ISO timestamp: {iso!r}") from e
+    return int(dt.timestamp()) * 1000 + int(iso[20:23])
+
+
+def timestamp_to_string(t: Timestamp) -> str:
+    """timestamp.ts:43-48 — counter is 4 UPPERCASE hex digits; node is 16 lowercase hex."""
+    return f"{millis_to_iso(t.millis)}-{t.counter:04X}-{t.node}"
+
+
+_HEX = set("0123456789abcdefABCDEF")
+
+
+def timestamp_from_string(s: str) -> Timestamp:
+    """timestamp.ts:50-55, with strict field validation (counter is 4 hex
+    digits, node is 16 lowercase-hex; separators checked)."""
+    if len(s) != TIMESTAMP_STRING_LENGTH or s[24] != "-" or s[29] != "-":
+        raise TimestampParseError(f"bad timestamp string: {s!r}")
+    counter_s, node = s[25:29], s[30:46]
+    if not all(c in _HEX for c in counter_s) or not all(c in _HEX for c in node):
+        raise TimestampParseError(f"bad timestamp string: {s!r}")
+    return Timestamp(iso_to_millis(s[0:24]), int(counter_s, 16), node)
+
+
+def timestamp_to_hash(t: Timestamp) -> int:
+    """timestamp.ts:87-88 — murmur3-32 (unsigned) of the canonical string."""
+    return murmur3_32(timestamp_to_string(t).encode("ascii"))
+
+
+def _increment_counter(counter: int) -> int:
+    """timestamp.ts:90-95."""
+    if counter < MAX_COUNTER:
+        return counter + 1
+    raise TimestampCounterOverflowError()
+
+
+def send_timestamp(t: Timestamp, now: int, max_drift: int = 60000) -> Timestamp:
+    """Stamp a local event (timestamp.ts:97-123).
+
+    millis' = max(local.millis, now); same millis keeps the node's
+    counter incrementing, a newer wall clock resets it to 0. Drift
+    guard: next - now <= max_drift.
+    """
+    next_millis = max(t.millis, now)
+    if next_millis - now > max_drift:
+        raise TimestampDriftError(next_millis, now)
+    counter = _increment_counter(t.counter) if next_millis == t.millis else 0
+    return Timestamp(next_millis, counter, t.node)
+
+
+def receive_timestamp(
+    local: Timestamp, remote: Timestamp, now: int, max_drift: int = 60000
+) -> Timestamp:
+    """Merge a remote timestamp into the local clock (timestamp.ts:125-165).
+
+    Order of checks matches the reference exactly: drift first, then
+    duplicate-node, then the counter rules.
+    """
+    next_millis = max(local.millis, remote.millis, now)
+    if next_millis - now > max_drift:
+        raise TimestampDriftError(next_millis, now)
+    if local.node == remote.node:
+        raise TimestampDuplicateNodeError(local.node)
+    if next_millis == local.millis and next_millis == remote.millis:
+        counter = _increment_counter(max(local.counter, remote.counter))
+    elif next_millis == local.millis:
+        counter = _increment_counter(local.counter)
+    elif next_millis == remote.millis:
+        counter = _increment_counter(remote.counter)
+    else:
+        counter = 0
+    return Timestamp(next_millis, counter, local.node)

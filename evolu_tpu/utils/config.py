@@ -1,0 +1,30 @@
+"""Runtime configuration (reference: packages/evolu/src/config.ts).
+
+Unlike the reference's mutable module singleton, config is passed
+explicitly to the runtime (`create_evolu(schema, config=Config(...))`);
+a module-level default exists for parity with `setConfig`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Union
+
+
+@dataclass
+class Config:
+    sync_url: str = "http://localhost:4000"
+    log: Union[bool, str, List[str]] = False
+    max_drift: int = 60000  # config.ts:9
+    reload_url: str = "/"
+    # TPU-native extensions (no reference equivalent):
+    backend: str = "auto"  # "cpu" | "tpu" | "auto" — merge kernel backend
+    min_device_batch: int = 1024  # below this, the CPU oracle path is faster than dispatch
+
+
+default_config = Config()
+
+
+def set_config(c: Config) -> None:
+    global default_config
+    default_config = c

@@ -1,0 +1,15 @@
+"""Test env: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any jax import. The real TPU chip is reserved for
+bench.py; tests validate sharding semantics on the virtual mesh.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

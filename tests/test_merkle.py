@@ -1,0 +1,126 @@
+"""Merkle trie golden tests.
+
+Expected values ported from the reference's vitest snapshots
+(packages/evolu/test/merkleTree.test.ts +
+__snapshots__/merkleTree.test.ts.snap). Hashes are JS signed int32
+(XOR coercion), serialization matches JS JSON.stringify property order.
+"""
+
+import json
+import random
+
+from evolu_tpu.core.merkle import (
+    apply_prefix_xors,
+    create_initial_merkle_tree,
+    diff_merkle_trees,
+    insert_into_merkle_tree,
+    key_to_timestamp_millis,
+    merkle_tree_from_string,
+    merkle_tree_to_string,
+    minutes_base3,
+)
+from evolu_tpu.core.timestamp import timestamp_to_hash
+from evolu_tpu.core.murmur import to_int32
+from evolu_tpu.core.types import Timestamp
+
+
+def node1(millis=0, counter=0):
+    return Timestamp(millis, counter, "0000000000000001")
+
+
+def test_create_initial_merkle_tree():
+    assert create_initial_merkle_tree() == {}
+
+
+def test_insert_single_at_epoch():
+    # snapshot `insertIntoMerkleTree 1`
+    tree = insert_into_merkle_tree(node1(), {})
+    assert tree == {"hash": -1416139081, "0": {"hash": -1416139081}}
+
+
+def test_insert_single_2022():
+    # snapshot `insertIntoMerkleTree 2` — ts 1656873738591, 16-digit base-3 key
+    tree = insert_into_merkle_tree(node1(1656873738591), {})
+    assert tree["hash"] == -468843282
+    key = minutes_base3(1656873738591)
+    # Path read off the snapshot's nesting: 1→2→2→0→2→2→1→2→2→2→0→0→1→1→2→0
+    assert key == "1220221222001120"
+    node = tree
+    for c in key:
+        node = node[c]
+        assert node["hash"] == -468843282
+    assert "0" not in node and "1" not in node and "2" not in node
+
+
+def test_insert_both_and_order_independence():
+    # snapshot `insertIntoMerkleTree 3` — root hash is XOR of both
+    ts1, ts2 = node1(), node1(1656873738591)
+    t_a = insert_into_merkle_tree(ts2, insert_into_merkle_tree(ts1, {}))
+    t_b = insert_into_merkle_tree(ts1, insert_into_merkle_tree(ts2, {}))
+    assert t_a == t_b
+    assert t_a["hash"] == 1335454297
+    assert t_a["0"]["hash"] == -1416139081
+
+
+def test_diff_merkle_trees():
+    assert diff_merkle_trees({}, {}) is None
+    mt = insert_into_merkle_tree(node1(1656873738591), {})
+    # snapshot `diffMerkleTrees 2` — minute floor of the inserted ts
+    assert diff_merkle_trees({}, mt) == 1656873720000
+    assert diff_merkle_trees({}, mt) == diff_merkle_trees(mt, {})
+
+
+def test_diff_detects_divergence_minute():
+    # Modern millis ⇒ full 16-digit keys ⇒ diff pinpoints the exact minute.
+    # (Tiny millis produce short, right-padded keys — a reference quirk we
+    # reproduce: see keyToTimestamp right-padding, merkleTree.ts:55-61.)
+    t0 = 1656873720000  # minute-aligned
+    base = {}
+    for m in [t0, t0 + 60000, t0 + 120000, t0 + 600000]:
+        base = insert_into_merkle_tree(node1(m), base)
+    other = insert_into_merkle_tree(node1(t0 + 120000, 1), base)
+    assert diff_merkle_trees(base, other) == t0 + 120000
+
+
+def test_key_to_timestamp_millis():
+    assert key_to_timestamp_millis("") == 0
+    assert key_to_timestamp_millis(minutes_base3(1656873720000)) == 1656873720000
+
+
+def test_serialization_matches_js_json():
+    tree = insert_into_merkle_tree(
+        node1(), insert_into_merkle_tree(node1(1656873738591), {})
+    )
+    s = merkle_tree_to_string(tree)
+    # JS property order: numeric keys ascending first, then "hash".
+    assert s.startswith('{"0":{"hash":-1416139081}')
+    assert merkle_tree_from_string(s) == tree
+    # No whitespace (JSON.stringify default).
+    assert " " not in s
+
+
+def test_hash_zero_vs_missing_distinct():
+    # undefined !== 0 in the diff walk.
+    t1 = {"hash": 0, "0": {"hash": 0}}
+    t2 = {}
+    assert diff_merkle_trees(t1, t2) is not None
+
+
+def test_apply_prefix_xors_equivalence():
+    rng = random.Random(42)
+    timestamps = [
+        Timestamp(rng.randrange(0, 2**41), rng.randrange(0, 65536), "0000000000000001")
+        for _ in range(200)
+    ]
+    seq = {}
+    for t in timestamps:
+        seq = insert_into_merkle_tree(t, seq)
+
+    # Batch: aggregate XOR per full 16-level prefix chain, like the TPU path.
+    deltas = {}
+    for t in timestamps:
+        key = minutes_base3(t.millis)
+        h = timestamp_to_hash(t)
+        deltas[key] = to_int32(deltas.get(key, 0) ^ h)
+    batched = apply_prefix_xors({}, deltas)
+    assert batched == seq

@@ -1,0 +1,148 @@
+"""HLC golden tests.
+
+Expected values are ported from the reference's vitest snapshots
+(packages/evolu/test/timestamp.test.ts +
+test/__snapshots__/timestamp.test.ts.snap) — byte-for-byte parity with
+the TypeScript implementation is the contract.
+"""
+
+import pytest
+
+from evolu_tpu.core.timestamp import (
+    create_initial_timestamp,
+    create_sync_timestamp,
+    receive_timestamp,
+    send_timestamp,
+    timestamp_from_string,
+    timestamp_to_hash,
+    timestamp_to_string,
+)
+from evolu_tpu.core.types import (
+    Timestamp,
+    TimestampCounterOverflowError,
+    TimestampDriftError,
+    TimestampDuplicateNodeError,
+)
+
+MAX_DRIFT = 60000
+
+
+def node1(millis=0, counter=0):
+    return Timestamp(millis, counter, "0000000000000001")
+
+
+def node2(millis=0, counter=0):
+    return Timestamp(millis, counter, "0000000000000002")
+
+
+def test_create_initial_timestamp():
+    ts = create_initial_timestamp()
+    assert ts.counter == 0
+    assert ts.millis == 0
+    assert len(ts.node) == 16
+
+
+def test_create_sync_timestamp():
+    ts = create_sync_timestamp()
+    assert (ts.millis, ts.counter, ts.node) == (0, 0, "0000000000000000")
+
+
+def test_timestamp_to_string():
+    # snapshot `timestampToString 1`
+    assert (
+        timestamp_to_string(create_sync_timestamp())
+        == "1970-01-01T00:00:00.000Z-0000-0000000000000000"
+    )
+
+
+def test_timestamp_string_roundtrip():
+    t = create_sync_timestamp()
+    assert timestamp_from_string(timestamp_to_string(t)) == t
+    t2 = Timestamp(1656873738591, 42, "a1b2c3d4e5f60718")
+    assert timestamp_from_string(timestamp_to_string(t2)) == t2
+
+
+def test_timestamp_string_order_is_tuple_order():
+    ts = [
+        Timestamp(0, 0, "0000000000000001"),
+        Timestamp(0, 1, "0000000000000000"),
+        Timestamp(1, 0, "ffffffffffffffff"),
+        Timestamp(1656873738591, 65535, "0000000000000000"),
+        Timestamp(1656873738591, 65535, "0000000000000001"),
+        Timestamp(1656873738592, 0, "0000000000000000"),
+    ]
+    strings = [timestamp_to_string(t) for t in ts]
+    assert strings == sorted(strings)
+
+
+def test_timestamp_to_hash():
+    # snapshot `timestampToHash 1`
+    assert timestamp_to_hash(create_sync_timestamp()) == 4179357717
+
+
+class TestSendTimestamp:
+    def test_monotonic_clock(self):
+        # snapshot: millis 1, counter 0
+        t = send_timestamp(create_sync_timestamp(), now=1)
+        assert (t.millis, t.counter, t.node) == (1, 0, "0000000000000000")
+
+    def test_stuttering_clock(self):
+        # snapshot: millis 0, counter 1
+        t = send_timestamp(create_sync_timestamp(), now=0)
+        assert (t.millis, t.counter, t.node) == (0, 1, "0000000000000000")
+
+    def test_regressing_clock(self):
+        # snapshot: millis 1, counter 1
+        t = send_timestamp(create_sync_timestamp(1), now=0)
+        assert (t.millis, t.counter, t.node) == (1, 1, "0000000000000000")
+
+    def test_counter_overflow(self):
+        t = create_sync_timestamp()
+        with pytest.raises(TimestampCounterOverflowError):
+            for _ in range(65536):
+                t = send_timestamp(t, now=0)
+
+    def test_clock_drift(self):
+        with pytest.raises(TimestampDriftError) as e:
+            send_timestamp(create_sync_timestamp(MAX_DRIFT + 1), now=0)
+        assert e.value.next == 60001
+        assert e.value.now == 0
+
+
+class TestReceiveTimestamp:
+    def test_wall_clock_later_than_both(self):
+        t = receive_timestamp(node1(), node2(0, 0), now=1)
+        assert (t.millis, t.counter, t.node) == (1, 0, "0000000000000001")
+
+    def test_same_millis_take_bigger_counter(self):
+        t = receive_timestamp(node1(1, 0), node2(1, 1), now=0)
+        assert (t.millis, t.counter, t.node) == (1, 2, "0000000000000001")
+        t = receive_timestamp(node1(1, 1), node2(1, 0), now=0)
+        assert (t.millis, t.counter, t.node) == (1, 2, "0000000000000001")
+
+    def test_local_millis_later(self):
+        t = receive_timestamp(node1(2), node2(1), now=0)
+        assert (t.millis, t.counter, t.node) == (2, 1, "0000000000000001")
+
+    def test_remote_millis_later(self):
+        t = receive_timestamp(node1(1), node2(2), now=0)
+        assert (t.millis, t.counter, t.node) == (2, 1, "0000000000000001")
+
+    def test_duplicate_node(self):
+        with pytest.raises(TimestampDuplicateNodeError) as e:
+            receive_timestamp(node1(), node1(), now=1)
+        assert e.value.node == "0000000000000001"
+
+    def test_clock_drift(self):
+        with pytest.raises(TimestampDriftError) as e:
+            receive_timestamp(create_sync_timestamp(MAX_DRIFT + 1), node2(), now=0)
+        assert (e.value.next, e.value.now) == (60001, 0)
+        with pytest.raises(TimestampDriftError):
+            receive_timestamp(node2(), create_sync_timestamp(MAX_DRIFT + 1), now=0)
+
+    def test_drift_checked_before_duplicate_node(self):
+        # The reference checks drift first (timestamp.ts:138-153).
+        with pytest.raises(TimestampDriftError):
+            receive_timestamp(
+                node1(MAX_DRIFT + 1), node1(MAX_DRIFT + 1), now=0
+            )
