@@ -1,0 +1,31 @@
+"""Durable storage: real SQLite with reference-identical schema and semantics.
+
+The `Database` interface is the backend boundary the reference exposes
+(types.ts:162-176); the TPU merge engine plugs in above it — kernels
+decide winners/masks, storage applies them transactionally. Two
+implementations: `sqlite.PySqliteDatabase` (stdlib sqlite3 — the real
+SQLite C library) and the native C++ host layer in `storage/native`
+(bulk columnar apply, used by the server reconcile path).
+"""
+
+from evolu_tpu.storage.sqlite import PySqliteDatabase, open_database
+from evolu_tpu.storage.schema import (
+    init_db_model,
+    update_db_schema,
+    get_existing_tables,
+    delete_all_tables,
+)
+from evolu_tpu.storage.clock import read_clock, update_clock
+from evolu_tpu.storage.apply import apply_messages
+
+__all__ = [
+    "PySqliteDatabase",
+    "open_database",
+    "init_db_model",
+    "update_db_schema",
+    "get_existing_tables",
+    "delete_all_tables",
+    "read_clock",
+    "update_clock",
+    "apply_messages",
+]

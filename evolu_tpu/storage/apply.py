@@ -1,0 +1,161 @@
+"""LWW message application — the merge hot path.
+
+`apply_messages_sequential` reproduces the reference's per-message loop
+(applyMessages.ts:26-131) exactly and serves as the correctness oracle:
+
+1. winner lookup: latest __message timestamp for the (table,row,column)
+   cell (applyMessages.ts:34-40);
+2. if absent or older than the message ⇒ upsert the app table
+   (applyMessages.ts:92-103);
+3. if the winner differs from the message timestamp ⇒ INSERT OR NOTHING
+   into __message and XOR the timestamp hash into the Merkle tree
+   (applyMessages.ts:104-122). NB the XOR is NOT gated on the insert
+   actually inserting — a re-received non-winning duplicate XORs again
+   (client semantics; the server gates on changes==1 instead,
+   apps/server/src/index.ts:153-158).
+
+`apply_messages` is the batched path with identical end state: one
+winner query for all touched cells, decision masks computed batch-wise
+(host here; `evolu_tpu.ops.merge` computes the same masks on device for
+large batches), then bulk SQL. Equivalence is property-tested in
+tests/test_apply.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from evolu_tpu.core.merkle import insert_into_merkle_tree, apply_prefix_xors, minutes_base3
+from evolu_tpu.core.murmur import to_int32
+from evolu_tpu.core.timestamp import timestamp_from_string, timestamp_to_hash
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.storage.sqlite import PySqliteDatabase
+
+_SELECT_WINNER = (
+    'SELECT "timestamp" FROM "__message" '
+    'WHERE "table" = ? AND "row" = ? AND "column" = ? '
+    'ORDER BY "timestamp" DESC LIMIT 1'
+)
+_INSERT_MESSAGE = (
+    'INSERT INTO "__message" ("timestamp", "table", "row", "column", "value") '
+    "VALUES (?, ?, ?, ?, ?) ON CONFLICT DO NOTHING"
+)
+
+
+def _upsert_sql(table: str, column: str) -> str:
+    return (
+        f'INSERT INTO "{table}" ("id", "{column}") VALUES (?, ?) '
+        f'ON CONFLICT DO UPDATE SET "{column}" = ?'
+    )
+
+
+def apply_messages_sequential(
+    db: PySqliteDatabase, merkle_tree: dict, messages: Sequence[CrdtMessage]
+) -> dict:
+    """The reference loop, message by message. O(n) SQL round trips."""
+    for m in messages:
+        rows = db.exec_sql_query(_SELECT_WINNER, (m.table, m.row, m.column))
+        t = rows[0]["timestamp"] if rows else None
+        if t is None or t < m.timestamp:
+            db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
+        if t is None or t != m.timestamp:
+            db.run(_INSERT_MESSAGE, (m.timestamp, m.table, m.row, m.column, m.value))
+            merkle_tree = insert_into_merkle_tree(
+                timestamp_from_string(m.timestamp), merkle_tree
+            )
+    return merkle_tree
+
+
+def fetch_existing_winners(
+    db: PySqliteDatabase, cells: Iterable[Tuple[str, str, str]]
+) -> Dict[Tuple[str, str, str], str]:
+    """Current winner timestamp per cell, one indexed query per cell batch
+    via a temp table join (uses the (table,row,column,timestamp) covering
+    index, initDbModel.ts:51-56)."""
+    cells = list(cells)
+    if not cells:
+        return {}
+    with db.transaction():
+        db.exec('CREATE TEMP TABLE IF NOT EXISTS "__cells" ("t" BLOB, "r" BLOB, "c" BLOB)')
+        db.run('DELETE FROM "__cells"')
+        db.run_many('INSERT INTO "__cells" VALUES (?, ?, ?)', cells)
+        rows = db.exec_sql_query(
+            'SELECT m."table" AS t, m."row" AS r, m."column" AS c, '
+            'MAX(m."timestamp") AS w FROM "__message" m '
+            'JOIN "__cells" x ON m."table" = x."t" AND m."row" = x."r" AND m."column" = x."c" '
+            "GROUP BY m.\"table\", m.\"row\", m.\"column\""
+        )
+        db.run('DELETE FROM "__cells"')
+    return {(r["t"], r["r"], r["c"]): r["w"] for r in rows}
+
+
+def plan_batch(
+    messages: Sequence[CrdtMessage],
+    existing_winners: Dict[Tuple[str, str, str], str],
+):
+    """Compute merge decisions for a batch on host (pure, no SQL).
+
+    Returns (xor_mask, upserts) where xor_mask[i] says message i's hash
+    is XORed into the Merkle tree, and upserts maps cell -> (row, column,
+    table, value) for cells whose final winner beats the stored one.
+    Mirrors the sequential running-max semantics exactly; the device
+    kernel (ops.merge.plan_batch_device) computes the same masks with a
+    sort + segmented scan.
+    """
+    xor_mask: List[bool] = [False] * len(messages)
+    running: Dict[Tuple[str, str, str], Optional[str]] = {}
+    final: Dict[Tuple[str, str, str], CrdtMessage] = {}
+    for i, m in enumerate(messages):
+        cell = (m.table, m.row, m.column)
+        w = running.get(cell, existing_winners.get(cell))
+        xor_mask[i] = w is None or w != m.timestamp
+        if w is None or w < m.timestamp:
+            running[cell] = m.timestamp
+            final[cell] = m
+        else:
+            running[cell] = w
+    upserts = [
+        m for cell, m in final.items()
+        if (existing_winners.get(cell) is None or existing_winners[cell] < m.timestamp)
+    ]
+    return xor_mask, upserts
+
+
+def apply_messages(
+    db: PySqliteDatabase,
+    merkle_tree: dict,
+    messages: Sequence[CrdtMessage],
+    planner=None,
+) -> dict:
+    """Batched apply with end state identical to the sequential oracle.
+
+    `planner` defaults to the host `plan_batch`; the TPU runtime passes
+    a device planner with the same contract.
+    """
+    if not messages:
+        return merkle_tree
+    with db.transaction():  # whole-batch atomicity, like the reference's dbTransaction
+        cells = {(m.table, m.row, m.column) for m in messages}
+        existing = fetch_existing_winners(db, cells)
+        xor_mask, upserts = (planner or plan_batch)(messages, existing)
+
+        # App tables: only the final winner per cell touches the row.
+        for m in upserts:
+            db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
+
+        # __message: bulk insert, PK dedup handles duplicates.
+        db.run_many(
+            _INSERT_MESSAGE,
+            [(m.timestamp, m.table, m.row, m.column, m.value) for m in messages],
+        )
+
+    # Merkle: aggregate XOR per minute key, then one sparse-tree pass.
+    # Hash the canonical re-rendered form (timestamp_to_hash), exactly as
+    # the sequential oracle does — raw wire strings may be non-canonical.
+    deltas: Dict[str, int] = {}
+    for i, m in enumerate(messages):
+        if xor_mask[i]:
+            ts = timestamp_from_string(m.timestamp)
+            key = minutes_base3(ts.millis)
+            deltas[key] = to_int32(deltas.get(key, 0) ^ timestamp_to_hash(ts))
+    return apply_prefix_xors(merkle_tree, deltas)

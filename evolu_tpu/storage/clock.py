@@ -1,0 +1,30 @@
+"""CrdtClock persistence in the single-row __clock table.
+
+Reference: packages/evolu/src/readClock.ts, updateClock.ts. The clock
+row is the replica's resumable sync cursor: its timestamp is the HLC
+high-water mark, its merkleTree the digest of all stored messages.
+"""
+
+from __future__ import annotations
+
+from evolu_tpu.core.merkle import merkle_tree_from_string, merkle_tree_to_string
+from evolu_tpu.core.timestamp import timestamp_from_string, timestamp_to_string
+from evolu_tpu.core.types import CrdtClock
+from evolu_tpu.storage.sqlite import PySqliteDatabase
+
+
+def read_clock(db: PySqliteDatabase) -> CrdtClock:
+    """readClock.ts:15-27."""
+    row = db.exec_sql_query('SELECT "timestamp", "merkleTree" FROM "__clock" LIMIT 1')[0]
+    return CrdtClock(
+        timestamp=timestamp_from_string(row["timestamp"]),
+        merkle_tree=merkle_tree_from_string(row["merkleTree"]),
+    )
+
+
+def update_clock(db: PySqliteDatabase, clock: CrdtClock) -> None:
+    """updateClock.ts:8-26."""
+    db.run(
+        'UPDATE "__clock" SET "timestamp" = ?, "merkleTree" = ?',
+        (timestamp_to_string(clock.timestamp), merkle_tree_to_string(clock.merkle_tree)),
+    )

@@ -1,0 +1,83 @@
+"""System-table bootstrap and app-schema evolution.
+
+Reference: packages/evolu/src/initDbModel.ts (system tables + owner
+seed), updateDbSchema.ts (add-only DDL migration), deleteAllTables.ts.
+App columns get BLOB affinity on purpose — "no attempt is made to
+coerce data from one storage class into another"
+(updateDbSchema.ts:72-77) — which is what makes end states comparable
+byte-for-byte across implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Set
+
+from evolu_tpu.core.ids import mnemonic_to_owner_id
+from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_string
+from evolu_tpu.core.mnemonic import generate_mnemonic
+from evolu_tpu.core.timestamp import create_initial_timestamp, timestamp_to_string
+from evolu_tpu.core.types import Owner, TableDefinition
+from evolu_tpu.storage.sqlite import PySqliteDatabase
+
+
+def init_db_model(db: PySqliteDatabase, mnemonic: Optional[str] = None) -> Owner:
+    """Idempotent bootstrap (initDbModel.ts:29-81): __message + covering
+    index, __clock seeded with the initial timestamp/empty tree, __owner
+    seeded with the (possibly generated) mnemonic identity."""
+    initialized = len(db.exec_sql_query("PRAGMA table_info (__message)")) > 0
+    if not initialized:
+        if mnemonic is None:
+            mnemonic = generate_mnemonic()
+        timestamp = timestamp_to_string(create_initial_timestamp())
+        merkle = merkle_tree_to_string(create_initial_merkle_tree())
+        owner_id = mnemonic_to_owner_id(mnemonic)
+        with db.transaction():
+            db.exec(
+                'CREATE TABLE __message ('
+                '"timestamp" BLOB PRIMARY KEY, "table" BLOB, "row" BLOB, '
+                '"column" BLOB, "value" BLOB)'
+            )
+            db.exec(
+                'CREATE INDEX index__message ON __message '
+                '("table", "row", "column", "timestamp")'
+            )
+            db.exec('CREATE TABLE __clock ("timestamp" BLOB, "merkleTree" BLOB)')
+            db.run(
+                'INSERT INTO __clock ("timestamp", "merkleTree") VALUES (?, ?)',
+                (timestamp, merkle),
+            )
+            db.exec('CREATE TABLE __owner ("id" BLOB, "mnemonic" BLOB)')
+            db.run(
+                'INSERT INTO __owner ("id", "mnemonic") VALUES (?, ?)',
+                (owner_id, mnemonic),
+            )
+    row = db.exec_sql_query('SELECT "id", "mnemonic" FROM __owner LIMIT 1')[0]
+    return Owner(id=row["id"], mnemonic=row["mnemonic"])
+
+
+def get_existing_tables(db: PySqliteDatabase) -> Set[str]:
+    """Non-system app tables (updateDbSchema.ts:12-28)."""
+    rows = db.exec_sql_query("SELECT \"name\" FROM sqlite_schema WHERE type='table'")
+    return {r["name"] for r in rows if not r["name"].startswith("__")}
+
+
+def update_db_schema(db: PySqliteDatabase, table_definitions: Iterable[TableDefinition]) -> None:
+    """Add-only migration (updateDbSchema.ts:85-103): CREATE missing
+    tables (id TEXT PRIMARY KEY + BLOB columns) or ALTER ... ADD COLUMN."""
+    existing = get_existing_tables(db)
+    for td in table_definitions:
+        if td.name in existing:
+            have = {r["name"] for r in db.exec_sql_query(f'PRAGMA table_info ("{td.name}")')}
+            for col in td.columns:
+                if col not in have:
+                    db.run(f'ALTER TABLE "{td.name}" ADD COLUMN "{col}" BLOB')
+        else:
+            cols = ", ".join(f'"{c}" BLOB' for c in td.columns)
+            db.exec(f'CREATE TABLE "{td.name}" ("id" TEXT PRIMARY KEY, {cols})')
+
+
+def delete_all_tables(db: PySqliteDatabase) -> None:
+    """DROP every table (deleteAllTables.ts:6-25)."""
+    rows = db.exec_sql_query("SELECT \"name\" FROM sqlite_schema WHERE type='table'")
+    for r in rows:
+        db.exec(f'DROP TABLE "{r["name"]}"')

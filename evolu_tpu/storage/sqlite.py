@@ -1,0 +1,109 @@
+"""SQLite `Database` implementation over the stdlib sqlite3 module.
+
+This is real SQLite (the C library), satisfying the byte-identical
+end-state contract. The interface mirrors the reference's backend
+boundary (types.ts:162-176): exec, changes, exec_sql_query, prepare,
+and transaction — one writer, transaction-at-a-time, exactly like the
+reference's dbTransaction (initDb.ts:55-80).
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from contextlib import contextmanager
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from evolu_tpu.core.types import UnknownError
+
+
+class PySqliteDatabase:
+    """Single-writer SQLite handle.
+
+    All access is serialized through an RLock — the moral equivalent of
+    the reference DbWorker's WritableStream queue (db.worker.ts:50-75).
+    """
+
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.isolation_level = None  # explicit BEGIN/COMMIT
+        self._lock = threading.RLock()
+        self.path = path
+
+    # -- Database interface (types.ts:162-176) --
+
+    def exec(self, sql: str) -> List[Tuple]:
+        """Execute a single statement; returns its rows (if any)."""
+        with self._lock:
+            try:
+                return self._conn.execute(sql).fetchall()
+            except sqlite3.Error as e:
+                raise UnknownError(e) from e
+
+    def exec_script(self, sql: str) -> None:
+        """Execute a multi-statement script (DDL bootstrap). Never returns
+        rows; must not be called inside a transaction — sqlite3's
+        executescript issues an implicit COMMIT first."""
+        with self._lock:
+            if self._conn.in_transaction:
+                raise UnknownError("exec_script inside an open transaction")
+            try:
+                self._conn.executescript(sql)
+            except sqlite3.Error as e:
+                raise UnknownError(e) from e
+
+    def exec_sql_query(self, sql: str, parameters: Sequence = ()) -> List[dict]:
+        """Parameterized query; rows as column->value dicts (initDb.ts:94-113)."""
+        with self._lock:
+            try:
+                cur = self._conn.execute(sql, tuple(parameters))
+                cols = [d[0] for d in cur.description] if cur.description else []
+                return [dict(zip(cols, row)) for row in cur.fetchall()]
+            except sqlite3.Error as e:
+                raise UnknownError(e) from e
+
+    def run(self, sql: str, parameters: Sequence = ()) -> int:
+        """Execute a write; returns rowcount (the reference's `changes`)."""
+        with self._lock:
+            try:
+                cur = self._conn.execute(sql, tuple(parameters))
+                return cur.rowcount
+            except sqlite3.Error as e:
+                raise UnknownError(e) from e
+
+    def run_many(self, sql: str, rows: Iterable[Sequence]) -> int:
+        with self._lock:
+            try:
+                cur = self._conn.executemany(sql, rows)
+                return cur.rowcount
+            except sqlite3.Error as e:
+                raise UnknownError(e) from e
+
+    def changes(self) -> int:
+        with self._lock:
+            return self._conn.total_changes
+
+    @contextmanager
+    def transaction(self):
+        """BEGIN/COMMIT/ROLLBACK wrapper (initDb.ts:66-80). Reentrant-safe:
+        nested use joins the outer transaction."""
+        with self._lock:
+            if self._conn.in_transaction:
+                yield self
+                return
+            self._conn.execute("BEGIN")
+            try:
+                yield self
+            except BaseException:
+                self._conn.execute("ROLLBACK")
+                raise
+            else:
+                self._conn.execute("COMMIT")
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+def open_database(path: str = ":memory:") -> PySqliteDatabase:
+    return PySqliteDatabase(path)

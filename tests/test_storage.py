@@ -1,0 +1,86 @@
+"""Storage bootstrap, schema evolution, clock persistence, identity."""
+
+import pytest
+
+from evolu_tpu.core.mnemonic import generate_mnemonic, validate_mnemonic
+from evolu_tpu.core.ids import mnemonic_to_owner_id
+from evolu_tpu.core.types import CrdtClock, TableDefinition, Timestamp
+from evolu_tpu.storage import (
+    delete_all_tables,
+    get_existing_tables,
+    init_db_model,
+    open_database,
+    read_clock,
+    update_clock,
+    update_db_schema,
+)
+from evolu_tpu.core.merkle import insert_into_merkle_tree
+
+
+def test_init_db_model_bootstrap_and_idempotence():
+    db = open_database()
+    owner = init_db_model(db, mnemonic="legal winner thank year wave sausage worth useful legal winner thank yellow")
+    assert owner.id == mnemonic_to_owner_id(owner.mnemonic)
+    assert len(owner.id) == 21
+    # Idempotent: second init returns the same owner, keeps data.
+    owner2 = init_db_model(db)
+    assert owner2 == owner
+    clock = read_clock(db)
+    assert clock.timestamp.millis == 0 and clock.timestamp.counter == 0
+    assert clock.merkle_tree == {}
+
+
+def test_clock_roundtrip():
+    db = open_database()
+    init_db_model(db)
+    t = Timestamp(1656873738591, 7, "aaaaaaaaaaaaaaaa")
+    tree = insert_into_merkle_tree(t, {})
+    update_clock(db, CrdtClock(t, tree))
+    clock = read_clock(db)
+    assert clock.timestamp == t
+    assert clock.merkle_tree == tree
+
+
+def test_update_db_schema_create_and_alter():
+    db = open_database()
+    init_db_model(db)
+    update_db_schema(db, [TableDefinition.of("todo", ["title", "isCompleted"])])
+    assert get_existing_tables(db) == {"todo"}
+    cols = {r["name"] for r in db.exec_sql_query("PRAGMA table_info (todo)")}
+    assert cols == {"id", "title", "isCompleted"}
+    # Add-only migration: new column appears, nothing dropped.
+    update_db_schema(db, [TableDefinition.of("todo", ["title", "isCompleted", "dueAt"])])
+    cols = {r["name"] for r in db.exec_sql_query("PRAGMA table_info (todo)")}
+    assert "dueAt" in cols and "title" in cols
+
+
+def test_delete_all_tables():
+    db = open_database()
+    init_db_model(db)
+    update_db_schema(db, [TableDefinition.of("todo", ["title"])])
+    delete_all_tables(db)
+    rows = db.exec_sql_query("SELECT name FROM sqlite_schema WHERE type='table'")
+    assert rows == []
+
+
+def test_transaction_rollback():
+    db = open_database()
+    init_db_model(db)
+    update_db_schema(db, [TableDefinition.of("todo", ["title"])])
+    with pytest.raises(RuntimeError):
+        with db.transaction():
+            db.run('INSERT INTO "todo" ("id", "title") VALUES (?, ?)', ("x" * 21, "a"))
+            raise RuntimeError("boom")
+    assert db.exec_sql_query('SELECT * FROM "todo"') == []
+
+
+def test_mnemonic_generate_validate():
+    m = generate_mnemonic()
+    assert len(m.split(" ")) == 12
+    assert validate_mnemonic(m)
+    assert not validate_mnemonic("abandon " * 12)
+    # BIP-39 spec test vector (entropy 0x7f...7f).
+    assert validate_mnemonic(
+        "legal winner thank year wave sausage worth useful legal winner thank yellow"
+    )
+    assert not validate_mnemonic("not a mnemonic at all")
