@@ -1,0 +1,38 @@
+"""Device (JAX/TPU) kernels for the CRDT hot paths.
+
+These kernels replace the reference's per-message loops (reference:
+packages/evolu/src/applyMessages.ts:78, merkleTree.ts:31-50) with
+columnar batch pipelines:
+
+- `hash`    — vmapped murmur3-32 over fixed-width timestamp strings.
+- `encode`  — on-device canonical timestamp rendering + packed sort keys.
+- `merge`   — radix-style sort + segmented prefix-max LWW planner.
+- `merkle_ops` — batched minute-key XOR deltas.
+
+HLC millis are 48-bit, so the kernels need 64-bit integer types. Public
+entry points enter `jax.experimental.enable_x64` per call (see
+`with_x64`) instead of flipping the process-global x64 flag — importing
+this package must not change dtype semantics for the host application's
+own JAX code. Pass numpy arrays across the host↔device boundary; the
+wrappers convert inside the x64 scope so 64-bit dtypes survive.
+"""
+
+import functools
+
+import jax
+
+
+def with_x64(fn):
+    """Run `fn` under the `jax.enable_x64(True)` config scope.
+
+    Applied to every public kernel entry point: tracing (and jit cache
+    keying) happens under the x64 config, so 64-bit HLC keys keep their
+    width regardless of the embedding application's global setting.
+    """
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        with jax.enable_x64(True):
+            return fn(*args, **kwargs)
+
+    return wrapper

@@ -1,0 +1,147 @@
+"""On-device canonical timestamp encoding and packed sort keys.
+
+The reference orders all CRDT writes by the lexicographic order of the
+46-char timestamp string `ISO8601(millis)-HEX4(counter)-node16`
+(reference packages/evolu/src/timestamp.ts:43-48). On device we keep
+timestamps columnar — `millis:int64, counter:int32, node:uint64` — and
+
+- `render_timestamp_strings` materializes the canonical ASCII bytes
+  (N, 46) entirely on device (civil-calendar arithmetic, no host
+  round-trip) so `hash.murmur3_32_batch` can hash them in one pass;
+- `pack_ts_keys` packs (millis, counter) into one uint64 whose numeric
+  order equals the string order (node is a second uint64 tiebreak).
+
+millis < 2**48 for any representable date (year 9999 ≈ 2**47.8), so
+`millis << 16 | counter` is exact in uint64.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from evolu_tpu.ops import with_x64
+from evolu_tpu.ops.hash import murmur3_32_batch
+
+TIMESTAMP_STRING_LENGTH = 46
+
+
+def _civil_from_days(days):
+    """days-since-1970-01-01 → (year, month, day). Howard Hinnant's
+    `civil_from_days`, pure int64 arithmetic (valid for all our dates)."""
+    z = days + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+_ZERO = jnp.uint8(ord("0"))
+_UPPER_A = jnp.uint8(ord("A") - 10)
+_LOWER_A = jnp.uint8(ord("a") - 10)
+
+
+def _digits(x, n: int):
+    """x → list of n ASCII decimal digit arrays, most significant first."""
+    out = []
+    for i in range(n - 1, -1, -1):
+        out.append((x // (10**i) % 10).astype(jnp.uint8) + _ZERO)
+    return out
+
+
+def _hex_nibble(x, upper: bool):
+    x = x.astype(jnp.uint8)
+    return jnp.where(x < 10, x + _ZERO, x + (_UPPER_A if upper else _LOWER_A))
+
+
+@with_x64
+def render_timestamp_strings(millis, counter, node) -> jnp.ndarray:
+    """(N,) int64 millis, (N,) int32 counter, (N,) uint64 node →
+    (N, 46) uint8 canonical strings `YYYY-MM-DDTHH:mm:ss.sssZ-CCCC-n*16`.
+
+    Counter hex is UPPERCASE, node hex lowercase — exactly the
+    reference encoding (timestamp.ts:43-48) whose byte order the LWW
+    comparisons rely on.
+    """
+    millis = jnp.asarray(millis, jnp.int64)
+    counter = jnp.asarray(counter, jnp.int32)
+    node = jnp.asarray(node, jnp.uint64)
+    ms = millis % 1000
+    secs = millis // 1000
+    days = secs // 86400
+    sod = secs % 86400
+    hh, mm, ss = sod // 3600, (sod // 60) % 60, sod % 60
+    y, mo, d = _civil_from_days(days)
+
+    cols = []
+    cols += _digits(y, 4)
+    cols.append(jnp.full_like(cols[0], ord("-")))
+    cols += _digits(mo, 2)
+    cols.append(jnp.full_like(cols[0], ord("-")))
+    cols += _digits(d, 2)
+    cols.append(jnp.full_like(cols[0], ord("T")))
+    cols += _digits(hh, 2)
+    cols.append(jnp.full_like(cols[0], ord(":")))
+    cols += _digits(mm, 2)
+    cols.append(jnp.full_like(cols[0], ord(":")))
+    cols += _digits(ss, 2)
+    cols.append(jnp.full_like(cols[0], ord(".")))
+    cols += _digits(ms, 3)
+    cols.append(jnp.full_like(cols[0], ord("Z")))
+    cols.append(jnp.full_like(cols[0], ord("-")))
+    c32 = counter.astype(jnp.uint32)
+    for shift in (12, 8, 4, 0):
+        cols.append(_hex_nibble((c32 >> shift) & 0xF, upper=True))
+    cols.append(jnp.full_like(cols[0], ord("-")))
+    n64 = node.astype(jnp.uint64)
+    for shift in range(60, -4, -4):
+        cols.append(_hex_nibble((n64 >> jnp.uint64(shift)) & jnp.uint64(0xF), upper=False))
+    return jnp.stack(cols, axis=1)
+
+
+@with_x64
+def timestamp_hashes(millis, counter, node) -> jnp.ndarray:
+    """Batched `timestampToHash` (timestamp.ts:87-88): murmur3-32 of the
+    canonical string, computed fully on device. → (N,) uint32."""
+    return murmur3_32_batch(render_timestamp_strings(millis, counter, node))
+
+
+@with_x64
+def pack_ts_keys(millis, counter) -> jnp.ndarray:
+    """(millis, counter) → uint64 key; numeric order == string order.
+
+    Key 0 is reserved as the "no existing winner" sentinel in the merge
+    planner: a real stored message never has millis == 0 and counter == 0
+    with the all-zero sync node (createSyncTimestamp timestamps are
+    range-query bounds, never stored — timestamp.ts:33-41).
+    """
+    millis = jnp.asarray(millis)
+    counter = jnp.asarray(counter)
+    return (millis.astype(jnp.uint64) << jnp.uint64(16)) | counter.astype(jnp.uint64)
+
+
+def pack_ts_key_host(millis, counter):
+    """Host twin of `pack_ts_keys` — same bit layout, numpy or Python ints.
+
+    One definition of the layout on each side of the boundary; the
+    order-equivalence test (tests/test_ops.py) pins them together.
+    """
+    if isinstance(millis, np.ndarray):
+        return (millis.astype(np.uint64) << np.uint64(16)) | counter.astype(np.uint64)
+    return (int(millis) << 16) | int(counter)
+
+
+def node_hex_to_u64(node: str) -> int:
+    """Host helper: 16-lowercase-hex node id → uint64 (big-endian nibbles,
+    matching render_timestamp_strings)."""
+    return int(node, 16)
+
+
+def u64_to_node_hex(v: int) -> str:
+    return f"{v:016x}"

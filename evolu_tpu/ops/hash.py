@@ -1,0 +1,72 @@
+"""Batched MurmurHash3 x86/32 on device.
+
+Bit-exact with the host oracle `evolu_tpu.core.murmur.murmur3_32`
+(which itself matches the npm `murmurhash` package used by the
+reference at packages/evolu/src/timestamp.ts:87-88). Operates on a
+batch of fixed-width byte strings as a (N, L) uint8 array; the block
+loop is unrolled at trace time since L is static (46 for canonical
+timestamp strings).
+
+All arithmetic is uint32 with explicit wrapping — XLA integer ops wrap
+by construction, so the JS `Math.imul`/`>>>` semantics come for free.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+
+
+def _rotl(x, r: int):
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def _mix_k(k):
+    k = k * _C1
+    k = _rotl(k, 15)
+    return k * _C2
+
+
+def murmur3_32_batch(data: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """murmur3-32 of each row of a (N, L) uint8 array → (N,) uint32.
+
+    L is static; rows are full strings (no per-row lengths — the CRDT
+    only hashes canonical 46-char timestamp strings).
+    """
+    n_rows, length = data.shape
+    data = data.astype(jnp.uint32)
+    h = jnp.full((n_rows,), seed, dtype=jnp.uint32)
+
+    n_blocks = length // 4
+    for i in range(n_blocks):
+        b = i * 4
+        k = (
+            data[:, b]
+            | (data[:, b + 1] << jnp.uint32(8))
+            | (data[:, b + 2] << jnp.uint32(16))
+            | (data[:, b + 3] << jnp.uint32(24))
+        )
+        h = h ^ _mix_k(k)
+        h = _rotl(h, 13)
+        h = h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+    tail = length & 3
+    if tail:
+        k = jnp.zeros((n_rows,), dtype=jnp.uint32)
+        base = n_blocks * 4
+        if tail >= 3:
+            k = k ^ (data[:, base + 2] << jnp.uint32(16))
+        if tail >= 2:
+            k = k ^ (data[:, base + 1] << jnp.uint32(8))
+        k = k ^ data[:, base]
+        h = h ^ _mix_k(k)
+
+    h = h ^ jnp.uint32(length)
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h

@@ -1,0 +1,202 @@
+"""Batched LWW merge planner on device.
+
+Replaces the reference's per-message SQL loop (reference
+packages/evolu/src/applyMessages.ts:78-124) with one columnar pass:
+
+    stable sort by (cell, batch order)
+      → segmented exclusive prefix-max of HLC keys (running winner)
+      → xor mask   (message's hash goes into the Merkle tree)
+      → segmented total max (final winner per cell)
+      → upsert mask (final winner beats the stored winner)
+
+Semantics are *exactly* the sequential loop's, including its quirks:
+the Merkle XOR is gated on "running winner != message timestamp", not
+on the __message insert actually inserting, so a re-received
+non-winning duplicate XORs again (applyMessages.ts:104-122) — the
+running winner is the max of the stored winner and all *earlier batch
+messages* for the same cell, in batch order.
+
+HLC keys are (k1, k2) uint64 pairs from `encode.pack_ts_keys` — k1 =
+millis<<16|counter, k2 = node — compared lexicographically; (0, 0) is
+the "no stored winner" sentinel (see encode.pack_ts_keys docstring).
+
+Everything here is shape-static and jit-compiled once per bucket size;
+`plan_batch_device` pads to power-of-two buckets to avoid recompiles
+(SURVEY.md §7 "dynamic shapes").
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evolu_tpu.core.timestamp import timestamp_from_string
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.ops import with_x64
+from evolu_tpu.ops.encode import node_hex_to_u64, pack_ts_key_host
+
+_PAD_CELL = jnp.int32(0x7FFFFFFF)
+
+
+def _lex_max(a1, a2, b1, b2):
+    """Elementwise max of (a1,a2) vs (b1,b2) under lexicographic order."""
+    a_wins = (a1 > b1) | ((a1 == b1) & (a2 >= b2))
+    return jnp.where(a_wins, a1, b1), jnp.where(a_wins, a2, b2)
+
+
+def _segmented_max_scan(flags, k1, k2):
+    """Inclusive segmented lexicographic max scan.
+
+    flags[i] marks a segment start. Monoid on (flag, k1, k2): the right
+    operand wins outright when it starts a segment.
+    """
+
+    def combine(left, right):
+        lf, l1, l2 = left
+        rf, r1, r2 = right
+        m1, m2 = _lex_max(l1, l2, r1, r2)
+        return lf | rf, jnp.where(rf, r1, m1), jnp.where(rf, r2, m2)
+
+    _, m1, m2 = jax.lax.associative_scan(combine, (flags, k1, k2))
+    return m1, m2
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments",))
+def plan_merge(cell_id, k1, k2, ex_k1, ex_k2, num_segments: int):
+    """The device LWW planner.
+
+    Args (all shape (N,), padding rows use cell_id=_PAD_CELL, keys 0):
+      cell_id: int32 interned (table,row,column) id per message.
+      k1, k2: uint64 HLC sort keys per message.
+      ex_k1, ex_k2: uint64 stored-winner keys for the message's cell
+        ((0,0) = no stored winner).
+      num_segments: static upper bound on distinct cells (= N).
+
+    Returns (xor_mask, upsert_mask) bools in original batch order.
+    """
+    n = cell_id.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    # Stable sort by cell, preserving batch order within a cell.
+    order = jnp.lexsort((idx, cell_id))
+    c = cell_id[order]
+    s1, s2 = k1[order], k2[order]
+    e1, e2 = ex_k1[order], ex_k2[order]
+
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), c[1:] != c[:-1]])
+    seg_ids = jnp.cumsum(seg_start.astype(jnp.int32)) - 1
+
+    # Running winner BEFORE each message: exclusive segmented max of the
+    # batch keys, seeded with the stored winner.
+    m1, m2 = _segmented_max_scan(seg_start, s1, s2)
+    zero = jnp.zeros((), jnp.uint64)
+    p1 = jnp.where(seg_start, zero, jnp.roll(m1, 1))
+    p2 = jnp.where(seg_start, zero, jnp.roll(m2, 1))
+    r1, r2 = _lex_max(p1, p2, e1, e2)
+    xor_sorted = (r1 != s1) | (r2 != s2)
+
+    # Final winner per cell: segment-wide lexicographic max.
+    t1 = jax.ops.segment_max(s1, seg_ids, num_segments=num_segments)[seg_ids]
+    is_max1 = s1 == t1
+    t2 = jax.ops.segment_max(jnp.where(is_max1, s2, zero), seg_ids, num_segments=num_segments)[seg_ids]
+    eligible = is_max1 & (s2 == t2)
+    # First eligible in batch order: segmented rank via global cumsum
+    # minus the segment's base (cumsum-before-segment, which equals the
+    # segment-min of the nondecreasing `cume - eligible`).
+    cume = jnp.cumsum(eligible.astype(jnp.int32))
+    base = jax.ops.segment_min(
+        cume - eligible.astype(jnp.int32), seg_ids, num_segments=num_segments
+    )[seg_ids]
+    first_eligible = eligible & (cume - base == 1)
+    # Winner strictly beats the stored winner iff lex_max(t, e) != e.
+    beats1, beats2 = _lex_max(t1, t2, e1, e2)
+    beats = (beats1 != e1) | (beats2 != e2)
+    upsert_sorted = first_eligible & beats & (c != _PAD_CELL)
+
+    xor_mask = jnp.zeros((n,), bool).at[order].set(xor_sorted & (c != _PAD_CELL))
+    upsert_mask = jnp.zeros((n,), bool).at[order].set(upsert_sorted)
+    return xor_mask, upsert_mask
+
+
+def _bucket_size(n: int) -> int:
+    size = 64
+    while size < n:
+        size *= 2
+    return size
+
+
+def messages_to_columns(
+    messages: Sequence[CrdtMessage],
+    existing_winners: Dict[Tuple[str, str, str], str],
+):
+    """Host-side columnarization: intern cells, parse timestamps, pack keys.
+
+    Returns numpy arrays (cell_id, k1, k2, ex_k1, ex_k2) plus the parsed
+    (millis, counter, node_u64) columns for the Merkle kernel.
+    """
+    n = len(messages)
+    cell_ids = np.empty(n, np.int32)
+    millis = np.empty(n, np.int64)
+    counter = np.empty(n, np.int32)
+    node = np.empty(n, np.uint64)
+    ex_k1 = np.zeros(n, np.uint64)
+    ex_k2 = np.zeros(n, np.uint64)
+    intern: Dict[Tuple[str, str, str], int] = {}
+    ex_cache: Dict[int, Tuple[int, int]] = {}
+    for i, m in enumerate(messages):
+        cell = (m.table, m.row, m.column)
+        cid = intern.setdefault(cell, len(intern))
+        cell_ids[i] = cid
+        t = timestamp_from_string(m.timestamp)
+        millis[i], counter[i] = t.millis, t.counter
+        node[i] = node_hex_to_u64(t.node)
+        if cid not in ex_cache:
+            w = existing_winners.get(cell)
+            if w is None:
+                ex_cache[cid] = (0, 0)
+            else:
+                wt = timestamp_from_string(w)
+                ex_cache[cid] = (pack_ts_key_host(wt.millis, wt.counter), node_hex_to_u64(wt.node))
+        ex_k1[i], ex_k2[i] = ex_cache[cid]
+    k1 = pack_ts_key_host(millis, counter)
+    k2 = node
+    return cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node
+
+
+def pad_columns(arrays, n: int, pad_cell: bool = True):
+    """Pad 1-D columns to the power-of-two bucket ≥ n. First array is
+    cell_id (padded with _PAD_CELL); the rest pad with 0."""
+    size = _bucket_size(n)
+    out = []
+    for j, a in enumerate(arrays):
+        pad_val = int(_PAD_CELL) if (j == 0 and pad_cell) else 0
+        p = np.full(size - n, pad_val, dtype=a.dtype)
+        out.append(np.concatenate([a, p]))
+    return out, size
+
+
+@with_x64
+def plan_batch_device(
+    messages: Sequence[CrdtMessage],
+    existing_winners: Dict[Tuple[str, str, str], str],
+):
+    """Drop-in replacement for the host `storage.apply.plan_batch` with
+    the decision masks computed on device. Same return contract:
+    (xor_mask: list[bool], upserts: list[CrdtMessage])."""
+    n = len(messages)
+    if n == 0:
+        return [], []
+    cell_ids, k1, k2, ex_k1, ex_k2, *_ = messages_to_columns(messages, existing_winners)
+    (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns([cell_ids, k1, k2, ex_k1, ex_k2], n)
+    xor_mask, upsert_mask = plan_merge(
+        jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
+        jnp.asarray(ex_k1), jnp.asarray(ex_k2), num_segments=size,
+    )
+    xor_mask = np.asarray(xor_mask)[:n]
+    upsert_mask = np.asarray(upsert_mask)[:n]
+    upserts: List[CrdtMessage] = [m for i, m in enumerate(messages) if upsert_mask[i]]
+    return list(map(bool, xor_mask)), upserts

@@ -1,0 +1,88 @@
+"""Batched Merkle-trie updates on device.
+
+The reference inserts timestamps into the trie one at a time, XORing
+the murmur hash into every node on the root→minute path (reference
+packages/evolu/src/merkleTree.ts:31-50). XOR is associative and
+commutative, so a whole batch reduces to **one XOR delta per distinct
+minute**; the host then applies each delta along its ≤16-node path
+(`core.merkle.apply_prefix_xors`), touching O(distinct-minutes × 16)
+nodes instead of O(batch × 16).
+
+Device pass: hash timestamps (fully on device, `encode.timestamp_hashes`)
+→ minute key with JS `|0` int32 truncation (merkleTree.ts:39) → sort by
+minute → segmented XOR reduce via the prefix-XOR trick (segment XOR =
+prefix[end] ^ prefix[prev_end]).
+
+Hashes are uint32 on device; the host converts to JS signed int32 when
+writing trie nodes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evolu_tpu.core.merkle import minutes_base3
+from evolu_tpu.core.murmur import to_int32
+from evolu_tpu.ops import with_x64
+from evolu_tpu.ops.encode import timestamp_hashes
+
+
+@with_x64
+@jax.jit
+def merkle_minute_deltas(millis, counter, node, xor_mask):
+    """Per-minute XOR deltas for a timestamp batch.
+
+    Args (shape (N,)): millis int64, counter int32, node uint64,
+      xor_mask bool (False rows contribute nothing — padding or
+      messages whose hash the merge planner excluded).
+
+    Returns (minutes_sorted int32, seg_end bool, seg_xor uint32,
+    seg_valid bool), all (N,), where positions with seg_end give one
+    (minute, xor-delta, any-contributor) triple per distinct minute.
+    """
+    n = millis.shape[0]
+    hashes = jnp.where(xor_mask, timestamp_hashes(millis, counter, node), jnp.uint32(0))
+    # JS `((millis/1000/60) | 0)` — float-divide then truncate to int32.
+    # millis >= 0 so floor == trunc; int32 cast wraps like `|0`.
+    minutes = (millis // 60000).astype(jnp.int32)
+    # Park masked-out rows in a sentinel minute so a minute whose every
+    # row is masked doesn't emit a spurious zero-delta node path.
+    minutes = jnp.where(xor_mask, minutes, jnp.int32(0x7FFFFFFF))
+
+    order = jnp.argsort(minutes)
+    m_sorted = minutes[order]
+    h_sorted = hashes[order]
+    valid_sorted = xor_mask[order]
+
+    prefix = jax.lax.associative_scan(jnp.bitwise_xor, h_sorted)
+    seg_end = jnp.concatenate([m_sorted[1:] != m_sorted[:-1], jnp.ones((1,), bool)])
+    # XOR of a segment = prefix at its end ^ prefix at the previous
+    # segment's end. Propagate "index of previous segment end" forward
+    # with a running max (-1 = no previous segment).
+    idx = jnp.arange(n)
+    seg_first = jnp.concatenate([jnp.zeros((1,), bool), seg_end[:-1]])
+    prev_end = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_first, idx - 1, -1))
+    prev_end_prefix = jnp.where(prev_end >= 0, prefix[jnp.maximum(prev_end, 0)], jnp.uint32(0))
+    seg_xor = prefix ^ prev_end_prefix
+    return m_sorted, seg_end, seg_xor, valid_sorted
+
+
+def minute_deltas_to_dict(m_sorted, seg_end, seg_xor, valid_sorted) -> Dict[str, int]:
+    """Host side: device outputs → {base3-minute-key: signed-int32 delta}
+    consumable by `core.merkle.apply_prefix_xors`."""
+    m = np.asarray(m_sorted)
+    ends = np.asarray(seg_end)
+    xs = np.asarray(seg_xor)
+    valid = np.asarray(valid_sorted)
+    out: Dict[str, int] = {}
+    for i in np.nonzero(ends)[0]:
+        if not valid[i]:
+            continue  # sentinel minute (all rows masked)
+        minute = int(m[i])
+        out[minutes_base3(minute * 60000)] = to_int32(int(xs[i]))
+    return out

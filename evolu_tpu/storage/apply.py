@@ -139,6 +139,19 @@ def apply_messages(
         existing = fetch_existing_winners(db, cells)
         xor_mask, upserts = (planner or plan_batch)(messages, existing)
 
+        # Merkle deltas: aggregate XOR per minute key. Computed BEFORE any
+        # write so a malformed timestamp rolls the whole batch back —
+        # committing messages whose hashes never reach the tree would
+        # diverge the digest permanently. Hash the canonical re-rendered
+        # form (timestamp_to_hash), exactly as the sequential oracle does
+        # — raw wire strings may be non-canonical.
+        deltas: Dict[str, int] = {}
+        for i, m in enumerate(messages):
+            if xor_mask[i]:
+                ts = timestamp_from_string(m.timestamp)
+                key = minutes_base3(ts.millis)
+                deltas[key] = to_int32(deltas.get(key, 0) ^ timestamp_to_hash(ts))
+
         # App tables: only the final winner per cell touches the row.
         for m in upserts:
             db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
@@ -149,13 +162,5 @@ def apply_messages(
             [(m.timestamp, m.table, m.row, m.column, m.value) for m in messages],
         )
 
-    # Merkle: aggregate XOR per minute key, then one sparse-tree pass.
-    # Hash the canonical re-rendered form (timestamp_to_hash), exactly as
-    # the sequential oracle does — raw wire strings may be non-canonical.
-    deltas: Dict[str, int] = {}
-    for i, m in enumerate(messages):
-        if xor_mask[i]:
-            ts = timestamp_from_string(m.timestamp)
-            key = minutes_base3(ts.millis)
-            deltas[key] = to_int32(deltas.get(key, 0) ^ timestamp_to_hash(ts))
+    # One sparse-tree pass (pure, cannot fail after commit).
     return apply_prefix_xors(merkle_tree, deltas)

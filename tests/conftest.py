@@ -1,15 +1,38 @@
 """Test env: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any jax import. The real TPU chip is reserved for
-bench.py; tests validate sharding semantics on the virtual mesh.
+Tests must never claim the real TPU chip — that's reserved for
+bench.py. Two layers of defense:
+
+1. If the axon TPU-tunnel env (`PALLAS_AXON_POOL_IPS`) is present,
+   re-exec pytest with it stripped so the interpreter's sitecustomize
+   hook doesn't register the TPU PJRT plugin (registration serializes
+   on the pool's grant and can block every python process on the
+   machine while another process holds the chip). The re-exec happens
+   in pytest_configure with global capture stopped, so the child
+   pytest inherits the real stdout/stderr, not the capture tempfile.
+2. Force `JAX_PLATFORMS=cpu` with 8 virtual host devices before any
+   jax backend initializes; sharding tests validate mesh semantics on
+   the virtual mesh.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    env = dict(os.environ)
+    for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+        env.pop(var, None)
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)

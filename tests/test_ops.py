@@ -1,0 +1,213 @@
+"""Device kernels vs. the CPU oracle: hashing, encoding, merge, Merkle.
+
+The oracle modules (evolu_tpu.core.*, evolu_tpu.storage.apply) carry
+the reference's exact semantics; every kernel must agree bit-for-bit.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from evolu_tpu.core.merkle import (
+    create_initial_merkle_tree,
+    insert_into_merkle_tree,
+    apply_prefix_xors,
+    merkle_tree_to_string,
+)
+from evolu_tpu.core.murmur import murmur3_32
+from evolu_tpu.core.timestamp import (
+    Timestamp,
+    timestamp_to_string,
+    timestamp_to_hash,
+)
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.ops.encode import (
+    node_hex_to_u64,
+    pack_ts_keys,
+    render_timestamp_strings,
+    timestamp_hashes,
+)
+from evolu_tpu.ops.hash import murmur3_32_batch
+from evolu_tpu.ops.merge import plan_batch_device
+from evolu_tpu.ops.merkle_ops import merkle_minute_deltas, minute_deltas_to_dict
+from evolu_tpu.storage.apply import plan_batch
+
+
+def _random_timestamps(rng, n, millis_range=(0, 2**43), nodes=None):
+    out = []
+    for _ in range(n):
+        millis = rng.randrange(*millis_range)
+        counter = rng.randrange(0, 65536)
+        node = rng.choice(nodes) if nodes else f"{rng.getrandbits(64):016x}"
+        out.append(Timestamp(millis, counter, node))
+    return out
+
+
+class TestDeviceHash:
+    def test_matches_host_murmur_on_random_bytes(self):
+        rng = random.Random(7)
+        rows = [bytes(rng.randrange(256) for _ in range(46)) for _ in range(64)]
+        batch = jnp.asarray(np.frombuffer(b"".join(rows), np.uint8).reshape(64, 46))
+        got = np.asarray(murmur3_32_batch(batch))
+        want = np.asarray([murmur3_32(r) for r in rows], np.uint32)
+        np.testing.assert_array_equal(got, want)
+
+    def test_various_lengths(self):
+        rng = random.Random(8)
+        for length in (1, 2, 3, 4, 5, 7, 13, 46):
+            rows = [bytes(rng.randrange(256) for _ in range(length)) for _ in range(8)]
+            batch = jnp.asarray(np.frombuffer(b"".join(rows), np.uint8).reshape(8, length))
+            got = np.asarray(murmur3_32_batch(batch))
+            want = np.asarray([murmur3_32(r) for r in rows], np.uint32)
+            np.testing.assert_array_equal(got, want)
+
+
+class TestDeviceEncode:
+    def test_render_matches_host_string(self):
+        rng = random.Random(9)
+        ts = _random_timestamps(rng, 128) + [
+            Timestamp(0, 0, "0000000000000000"),
+            Timestamp(253402300799999, 65535, "ffffffffffffffff"),  # 9999-12-31
+        ]
+        millis = np.array([t.millis for t in ts], np.int64)
+        counter = np.array([t.counter for t in ts], np.int32)
+        node = np.array([node_hex_to_u64(t.node) for t in ts], np.uint64)
+        rendered = np.asarray(render_timestamp_strings(millis, counter, node))
+        for i, t in enumerate(ts):
+            assert rendered[i].tobytes().decode("ascii") == timestamp_to_string(t)
+
+    def test_device_hash_pipeline_matches_timestamp_to_hash(self):
+        rng = random.Random(10)
+        ts = _random_timestamps(rng, 64)
+        millis = np.array([t.millis for t in ts], np.int64)
+        counter = np.array([t.counter for t in ts], np.int32)
+        node = np.array([node_hex_to_u64(t.node) for t in ts], np.uint64)
+        got = np.asarray(timestamp_hashes(millis, counter, node))
+        want = np.asarray([timestamp_to_hash(t) for t in ts], np.uint32)
+        np.testing.assert_array_equal(got, want)
+
+    def test_pack_keys_order_equals_string_order(self):
+        rng = random.Random(11)
+        ts = _random_timestamps(rng, 200, millis_range=(0, 10**6))
+        millis = np.array([t.millis for t in ts], np.int64)
+        counter = np.array([t.counter for t in ts], np.int32)
+        k1 = np.asarray(pack_ts_keys(millis, counter))
+        keys = [(int(k1[i]), node_hex_to_u64(ts[i].node)) for i in range(len(ts))]
+        strings = [timestamp_to_string(t) for t in ts]
+        assert sorted(range(len(ts)), key=lambda i: keys[i]) == sorted(
+            range(len(ts)), key=lambda i: strings[i]
+        )
+
+
+def _random_messages(rng, n, n_cells=10, nodes=None, millis_range=(0, 10**7)):
+    cells = [
+        (rng.choice(["todo", "todoCategory"]), f"row{i}", rng.choice(["title", "isDeleted"]))
+        for i in range(n_cells)
+    ]
+    msgs = []
+    for i in range(n):
+        t = _random_timestamps(rng, 1, millis_range=millis_range, nodes=nodes)[0]
+        table, row, col = rng.choice(cells)
+        msgs.append(CrdtMessage(timestamp_to_string(t), table, row, col, f"v{i}"))
+    return msgs
+
+
+class TestDeviceMergePlanner:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_matches_host_plan_batch(self, seed):
+        rng = random.Random(seed)
+        nodes = [f"{i:016x}" for i in range(1, 5)]
+        msgs = _random_messages(rng, 97, n_cells=7, nodes=nodes)
+        # Random existing winners for half the cells.
+        existing = {}
+        for cell in {(m.table, m.row, m.column) for m in msgs}:
+            if rng.random() < 0.5:
+                t = _random_timestamps(rng, 1, millis_range=(0, 10**7), nodes=nodes)[0]
+                existing[cell] = timestamp_to_string(t)
+        want_xor, want_upserts = plan_batch(msgs, existing)
+        got_xor, got_upserts = plan_batch_device(msgs, existing)
+        assert got_xor == want_xor
+        # One upsert per cell; list order is unspecified (host emits
+        # cell-first-touched order, device emits batch order).
+        assert sorted(got_upserts, key=str) == sorted(want_upserts, key=str)
+        assert len(got_upserts) == len({(m.table, m.row, m.column) for m in got_upserts})
+
+    def test_duplicate_messages_xor_twice(self):
+        # The reference quirk: re-received non-winning duplicates XOR again.
+        t_old = timestamp_to_string(Timestamp(1000, 0, "0000000000000001"))
+        t_win = timestamp_to_string(Timestamp(2000, 0, "0000000000000002"))
+        msgs = [
+            CrdtMessage(t_old, "todo", "r1", "title", "a"),
+            CrdtMessage(t_old, "todo", "r1", "title", "a"),
+        ]
+        existing = {("todo", "r1", "title"): t_win}
+        want = plan_batch(msgs, existing)
+        got = plan_batch_device(msgs, existing)
+        assert got[0] == want[0] == [True, True]
+        assert got[1] == want[1] == []
+
+    def test_high_contention_tiebreak(self):
+        # 64 nodes writing the same cell at the same millis/counter:
+        # winner must be the max node id (string order == node order).
+        nodes = sorted(f"{random.Random(42).getrandbits(64):016x}" for _ in range(64))
+        msgs = [
+            CrdtMessage(
+                timestamp_to_string(Timestamp(5000, 7, node)), "todo", "r", "title", node
+            )
+            for node in nodes
+        ]
+        want = plan_batch(msgs, {})
+        got = plan_batch_device(msgs, {})
+        assert got == want
+        assert got[1][0].value == nodes[-1]
+
+
+class TestDeviceMerkle:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_batch_deltas_equal_sequential_inserts(self, seed):
+        rng = random.Random(100 + seed)
+        ts = _random_timestamps(rng, 150, millis_range=(0, 10**10))
+        millis = np.array([t.millis for t in ts], np.int64)
+        counter = np.array([t.counter for t in ts], np.int32)
+        node = np.array([node_hex_to_u64(t.node) for t in ts], np.uint64)
+        mask = np.array([rng.random() < 0.8 for t in ts], bool)
+
+        deltas = minute_deltas_to_dict(*merkle_minute_deltas(millis, counter, node, mask))
+        got = apply_prefix_xors(create_initial_merkle_tree(), deltas)
+
+        want = create_initial_merkle_tree()
+        for i, t in enumerate(ts):
+            if bool(mask[i]):
+                want = insert_into_merkle_tree(t, want)
+        assert merkle_tree_to_string(got) == merkle_tree_to_string(want)
+
+    def test_all_masked_minute_emits_nothing(self):
+        millis = np.array([60000, 60000], np.int64)
+        counter = np.array([0, 1], np.int32)
+        node = np.array([1, 2], np.uint64)
+        mask = np.array([False, False])
+        deltas = minute_deltas_to_dict(*merkle_minute_deltas(millis, counter, node, mask))
+        assert deltas == {}
+
+
+class TestDevicePlannerEndState:
+    def test_sqlite_end_state_matches_sequential_oracle(self):
+        # Full pipeline: device planner driving real SQLite apply must
+        # produce byte-identical end state vs. the reference loop.
+        from tests.test_apply import make_db, dump, random_messages
+        from evolu_tpu.storage import apply_messages
+        from evolu_tpu.storage.apply import apply_messages_sequential
+
+        for seed in (0, 1):
+            rng = random.Random(1000 + seed)
+            batches = [random_messages(rng, rng.randrange(1, 100)) for _ in range(3)]
+            db_seq, db_dev = make_db(), make_db()
+            tree_seq, tree_dev = {}, {}
+            for batch in batches:
+                tree_seq = apply_messages_sequential(db_seq, tree_seq, batch)
+                tree_dev = apply_messages(db_dev, tree_dev, batch, planner=plan_batch_device)
+            assert dump(db_seq) == dump(db_dev)
+            assert tree_seq == tree_dev
