@@ -1,0 +1,14 @@
+"""Public bindings: data model validation, the compile-only query
+builder, and reactive subscription helpers.
+
+Reference: packages/evolu/src/model.ts (branded column types + casts),
+kysely.ts (compile-only query builder), createHooks.ts / useOwner.ts
+(React bindings). Python has no React; the binding analog is the
+subscription API on `evolu_tpu.runtime.client.Evolu` plus this
+package's query builder and model validators.
+"""
+
+from evolu_tpu.api import model
+from evolu_tpu.api.query import QueryBuilder, table
+
+__all__ = ["model", "QueryBuilder", "table"]

@@ -1,0 +1,106 @@
+"""Compile-only SQL query builder — the Kysely analog.
+
+Reference: packages/evolu/src/kysely.ts builds a Kysely instance with a
+DummyDriver: queries are *compiled* to `{sql, parameters}` but never
+executed by the builder; execution belongs to the DbWorker
+(createHooks.ts:28-37). This module is the same idea natively: a small
+immutable fluent builder whose `.serialize()` yields the
+`SqlQueryString` the runtime subscribes with.
+
+Identifiers are always double-quoted; values always travel as bound
+parameters — the builder never interpolates values into SQL.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import List, Optional, Sequence, Tuple, Union
+
+from evolu_tpu.runtime.messages import serialize_query
+
+_OPS = ("=", "!=", "<>", "<", "<=", ">", ">=", "like", "not like", "is", "is not", "in")
+
+
+def _quote(identifier: str) -> str:
+    if "\x00" in identifier:
+        raise ValueError("identifier contains NUL")
+    return '"' + identifier.replace('"', '""') + '"'
+
+
+@dataclass(frozen=True)
+class QueryBuilder:
+    """An immutable SELECT builder; every method returns a new builder."""
+
+    _table: str
+    _columns: Tuple[str, ...] = ()
+    _wheres: Tuple[Tuple[str, str, object], ...] = ()
+    _order_by: Tuple[Tuple[str, str], ...] = ()
+    _limit: Optional[int] = None
+    _offset: Optional[int] = None
+
+    def select(self, *columns: str) -> "QueryBuilder":
+        return replace(self, _columns=self._columns + columns)
+
+    def select_all(self) -> "QueryBuilder":
+        return replace(self, _columns=())
+
+    def where(self, column: str, op: str, value: object) -> "QueryBuilder":
+        if op.lower() not in _OPS:
+            raise ValueError(f"unsupported operator: {op}")
+        return replace(self, _wheres=self._wheres + ((column, op.lower(), value),))
+
+    def where_is_deleted(self, deleted: bool = False) -> "QueryBuilder":
+        """The common soft-delete filter (examples/nextjs/pages/index.tsx
+        queries filter `isDeleted is not 1`)."""
+        op, v = ("is", 1) if deleted else ("is not", 1)
+        return self.where("isDeleted", op, v)
+
+    def order_by(self, column: str, direction: str = "asc") -> "QueryBuilder":
+        if direction.lower() not in ("asc", "desc"):
+            raise ValueError(f"bad direction: {direction}")
+        return replace(self, _order_by=self._order_by + ((column, direction.lower()),))
+
+    def limit(self, n: int) -> "QueryBuilder":
+        return replace(self, _limit=int(n))
+
+    def offset(self, n: int) -> "QueryBuilder":
+        return replace(self, _offset=int(n))
+
+    def compile(self) -> Tuple[str, List[object]]:
+        """→ (sql, parameters), like Kysely's `.compile()`."""
+        cols = ", ".join(_quote(c) for c in self._columns) if self._columns else "*"
+        sql = f"SELECT {cols} FROM {_quote(self._table)}"
+        parameters: List[object] = []
+        if self._wheres:
+            terms = []
+            for column, op, value in self._wheres:
+                if op == "in":
+                    values = list(value)  # type: ignore[arg-type]
+                    marks = ", ".join("?" for _ in values)
+                    terms.append(f"{_quote(column)} in ({marks})")
+                    parameters.extend(values)
+                elif op in ("is", "is not") and value is None:
+                    terms.append(f"{_quote(column)} {op} null")
+                else:
+                    terms.append(f"{_quote(column)} {op} ?")
+                    parameters.append(value)
+            sql += " WHERE " + " AND ".join(terms)
+        if self._order_by:
+            sql += " ORDER BY " + ", ".join(f"{_quote(c)} {d}" for c, d in self._order_by)
+        if self._limit is not None:
+            sql += " LIMIT ?"
+            parameters.append(self._limit)
+        if self._offset is not None:
+            sql += " OFFSET ?"
+            parameters.append(self._offset)
+        return sql, parameters
+
+    def serialize(self) -> str:
+        """→ SqlQueryString, the runtime's canonical query key."""
+        sql, parameters = self.compile()
+        return serialize_query(sql, parameters)
+
+
+def table(name: str) -> QueryBuilder:
+    """Entry point: `table("todo").select("id", "title").where(...)`."""
+    return QueryBuilder(name)

@@ -51,8 +51,10 @@ def merkle_minute_deltas(millis, counter, node, xor_mask):
     # millis >= 0 so floor == trunc; int32 cast wraps like `|0`.
     minutes = (millis // 60000).astype(jnp.int32)
     # Park masked-out rows in a sentinel minute so a minute whose every
-    # row is masked doesn't emit a spurious zero-delta node path.
-    minutes = jnp.where(xor_mask, minutes, jnp.int32(0x7FFFFFFF))
+    # row is masked doesn't emit a spurious zero-delta node path. The
+    # sentinel lives outside the int32 range (sort key is int64), so it
+    # can never share a segment with a real (wrapped) minute.
+    minutes = jnp.where(xor_mask, minutes.astype(jnp.int64), jnp.int64(1) << 31)
 
     order = jnp.argsort(minutes)
     m_sorted = minutes[order]
@@ -82,7 +84,7 @@ def minute_deltas_to_dict(m_sorted, seg_end, seg_xor, valid_sorted) -> Dict[str,
     out: Dict[str, int] = {}
     for i in np.nonzero(ends)[0]:
         if not valid[i]:
-            continue  # sentinel minute (all rows masked)
+            continue  # the sentinel segment (masked rows)
         minute = int(m[i])
         out[minutes_base3(minute * 60000)] = to_int32(int(xs[i]))
     return out

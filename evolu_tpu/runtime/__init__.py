@@ -1,0 +1,14 @@
+"""Client runtime: the DbWorker command engine and the Evolu handle.
+
+Reference: packages/evolu/src/db.worker.ts (single-writer command loop)
+and db.ts (main-thread runtime). Here the "worker" is an in-process
+thread with an ordered command queue — same single-writer discipline,
+no postMessage serialization tax. The sync transport
+(`evolu_tpu.sync`) plugs in via a callback, mirroring the
+DbWorker ↔ SyncWorker MessageChannel boundary (db.ts:134-136).
+"""
+
+from evolu_tpu.runtime.worker import DbWorker
+from evolu_tpu.runtime.client import Evolu, create_evolu
+
+__all__ = ["DbWorker", "Evolu", "create_evolu"]

@@ -1,0 +1,344 @@
+"""The Evolu client handle — main-thread runtime analog.
+
+Reference: packages/evolu/src/db.ts. Owns the DbWorker, the reactive
+query-rows store (patch application keeps unchanged row identity,
+db.ts:96-115), the mutation batch queue (db.ts:302-361), subscription
+ref-counting (db.ts:236-266), the error store (error.ts), and owner
+lifecycle (db.ts:367-388).
+
+Differences from the browser, by design:
+- No microtasks: mutations made inside `with evolu.batching():` flush
+  as one `Send` (the reference batches per microtask); a bare
+  `mutate()` flushes immediately.
+- Sync triggers (`load`/`online`/`focus`, db.ts:390-412) become the
+  explicit `sync()` method plus the transport's periodic pull.
+"""
+
+from __future__ import annotations
+
+import datetime
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from evolu_tpu.api.model import sqlite_value
+from evolu_tpu.core.ids import create_id
+from evolu_tpu.core.types import NewCrdtMessage, Owner, TableDefinition
+from evolu_tpu.runtime import messages as msg
+from evolu_tpu.runtime.jsonpatch import apply_patch
+from evolu_tpu.runtime.worker import DbWorker
+from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.utils.config import Config
+
+
+def _now_iso() -> str:
+    from evolu_tpu.core.timestamp import millis_to_iso
+    import time
+
+    return millis_to_iso(int(time.time() * 1000))
+
+
+class Evolu:
+    """One local replica: reactive queries + LWW mutations + sync."""
+
+    def __init__(
+        self,
+        db_path: str = ":memory:",
+        config: Optional[Config] = None,
+        mnemonic: Optional[str] = None,
+        now_iso: Callable[[], str] = _now_iso,
+    ):
+        self.config = config or Config()
+        self.db = PySqliteDatabase(db_path)
+        self._now_iso = now_iso
+        self._lock = threading.RLock()
+        self._rows_cache: Dict[str, List[dict]] = {}  # queriesRowsCacheRef (db.ts:55)
+        self._subscribed: Dict[str, int] = {}  # ref-counted (db.ts:236)
+        self._listeners: List[Callable[[], None]] = []
+        self._error: Optional[Exception] = None
+        self._error_listeners: List[Callable[[Exception], None]] = []
+        self._on_completes: Dict[str, Callable[[], None]] = {}  # by id (db.ts:70-82)
+        self._batch_depth = 0
+        self._pending: List[NewCrdtMessage] = []
+        self._pending_complete_ids: List[str] = []
+        self._on_reload: Optional[Callable[[], None]] = None
+        self._transport = None  # set by attach_transport
+        self.worker = DbWorker(
+            self.db,
+            config=self.config,
+            on_output=self._dispatch_output,
+            post_sync=self._post_sync,
+        )
+        self.owner: Owner = self.worker.start(mnemonic)
+        self.first_data_loaded = threading.Event()
+
+    # -- schema --
+
+    def update_db_schema(self, schema: Dict[str, Sequence[str]]) -> None:
+        """createHooks.ts:26 → updateDbSchema command. `schema` maps table
+        name → app columns (id + common columns are implicit)."""
+        tds = tuple(TableDefinition.of(name, cols) for name, cols in schema.items())
+        self.worker.post(msg.UpdateDbSchema(tds))
+
+    # -- reactive queries --
+
+    def subscribe_query(self, query: str, listener: Optional[Callable[[], None]] = None):
+        """Subscribe a SqlQueryString; returns unsubscribe (db.ts:241-266)."""
+        with self._lock:
+            fresh = query not in self._subscribed
+            self._subscribed[query] = self._subscribed.get(query, 0) + 1
+            if listener is not None:
+                self._listeners.append(listener)
+        if fresh:
+            self.worker.post(msg.Query((query,)))
+
+        def unsubscribe() -> None:
+            with self._lock:
+                n = self._subscribed.get(query, 0) - 1
+                if n <= 0:
+                    self._subscribed.pop(query, None)
+                else:
+                    self._subscribed[query] = n
+                if listener is not None and listener in self._listeners:
+                    self._listeners.remove(listener)
+
+        return unsubscribe
+
+    def listen(self, listener: Callable[[], None]):
+        """Row-store change notification (db.ts:57-68)."""
+        with self._lock:
+            self._listeners.append(listener)
+
+        def unlisten() -> None:
+            with self._lock:
+                if listener in self._listeners:
+                    self._listeners.remove(listener)
+
+        return unlisten
+
+    def get_query_rows(self, query: str) -> List[dict]:
+        """Current rows for a subscribed query (db.ts:231-234). Row objects
+        are identity-stable across unrelated updates."""
+        with self._lock:
+            return self._rows_cache.get(query, [])
+
+    def query_once(self, query: str) -> List[dict]:
+        """One-shot read-through (no subscription): runs on the worker
+        thread to respect the single-writer discipline."""
+        unsubscribe = self.subscribe_query(query)
+        self.worker.flush()
+        try:
+            return self.get_query_rows(query)
+        finally:
+            unsubscribe()
+
+    # -- mutations --
+
+    def batching(self):
+        """Group several mutate() calls into one Send (db.ts:337-361)."""
+        client = self
+
+        class _Batch:
+            def __enter__(self):
+                with client._lock:
+                    client._batch_depth += 1
+                return client
+
+            def __exit__(self, exc_type, exc, tb):
+                with client._lock:
+                    client._batch_depth -= 1
+                    flush = client._batch_depth == 0
+                    if flush and exc_type is not None:
+                        # Aborted batch: drop its mutations outright —
+                        # leaving them pending would splice them into the
+                        # next unrelated Send.
+                        client._pending.clear()
+                        for i in client._pending_complete_ids:
+                            client._on_completes.pop(i, None)
+                        client._pending_complete_ids.clear()
+                if flush and exc_type is None:
+                    client._flush_mutations()
+                return False
+
+        return _Batch()
+
+    def mutate(
+        self,
+        table: str,
+        values: Dict[str, object],
+        on_complete: Optional[Callable[[], None]] = None,
+    ) -> str:
+        """Insert or update one row (db.ts:309-365).
+
+        No "id" in `values` ⇒ insert with a fresh nanoid id plus
+        createdAt/createdBy; with an id ⇒ update plus updatedAt
+        (db.ts:286-290). Values expand to one CrdtMessage per column;
+        bools/datetimes cast to their SQLite encodings (db.ts:281-283).
+        Returns the row id.
+        """
+        values = dict(values)
+        row_id = values.pop("id", None)
+        is_insert = row_id is None
+        if is_insert:
+            row_id = create_id()
+        now = self._now_iso()
+        if is_insert:
+            values.setdefault("createdAt", now)
+            values.setdefault("createdBy", self.owner.id)
+        else:
+            values.setdefault("updatedAt", now)
+        new_messages = [
+            NewCrdtMessage(table, row_id, column, sqlite_value(v))
+            for column, v in values.items()
+        ]
+        with self._lock:
+            self._pending.extend(new_messages)
+            if on_complete is not None:
+                complete_id = create_id()
+                self._on_completes[complete_id] = on_complete
+                self._pending_complete_ids.append(complete_id)
+            immediate = self._batch_depth == 0
+        if immediate:
+            self._flush_mutations()
+        return row_id
+
+    def create(self, table: str, values: Dict[str, object], on_complete=None) -> str:
+        values = dict(values)
+        values.pop("id", None)
+        return self.mutate(table, values, on_complete)
+
+    def update(self, table: str, row_id: str, values: Dict[str, object], on_complete=None) -> str:
+        values = dict(values)
+        values["id"] = row_id
+        return self.mutate(table, values, on_complete)
+
+    def _flush_mutations(self) -> None:
+        with self._lock:
+            if not self._pending:
+                return
+            batch = tuple(self._pending)
+            ids = tuple(self._pending_complete_ids)
+            queries = tuple(self._subscribed)
+            self._pending.clear()
+            self._pending_complete_ids.clear()
+        self.worker.post(msg.Send(batch, ids, queries))
+
+    # -- sync --
+
+    def attach_transport(self, transport) -> None:
+        """Wire a sync transport (the SyncWorker analog). The transport
+        must expose `request_sync(SyncRequestInput)` and feed responses
+        back via `receive()`."""
+        self._transport = transport
+
+    def sync(self, refresh_queries: bool = True) -> None:
+        """Trigger a pull round (the load/online/focus trigger analog,
+        db.ts:390-412)."""
+        queries = tuple(self._subscribed) if refresh_queries else ()
+        self.worker.post(msg.Sync(queries))
+
+    def receive(
+        self, messages: tuple, merkle_tree: str, previous_diff: Optional[int] = None
+    ) -> None:
+        """Feed a sync response into the engine (db.worker.ts:129-135)."""
+        self.worker.post(msg.Receive(tuple(messages), merkle_tree, previous_diff))
+
+    def _post_sync(self, request: msg.SyncRequestInput) -> None:
+        if self._transport is not None:
+            self._transport.request_sync(request)
+
+    # -- owner lifecycle (db.ts:367-388) --
+
+    def get_owner(self) -> Owner:
+        return self.owner
+
+    def reset_owner(self) -> None:
+        self.worker.post(msg.ResetOwner())
+
+    def restore_owner(self, mnemonic: str) -> None:
+        from evolu_tpu.core.mnemonic import validate_mnemonic
+        from evolu_tpu.core.types import UnknownError
+
+        if not validate_mnemonic(mnemonic):
+            raise UnknownError(f"invalid mnemonic")
+        self.worker.post(msg.RestoreOwner(mnemonic))
+
+    def on_reload(self, callback: Callable[[], None]) -> None:
+        """reloadAllTabs analog (reloadAllTabs.ts:6-14)."""
+        self._on_reload = callback
+
+    # -- errors (error.ts:8-22) --
+
+    def subscribe_error(self, listener: Callable[[Exception], None]):
+        with self._lock:
+            self._error_listeners.append(listener)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                if listener in self._error_listeners:
+                    self._error_listeners.remove(listener)
+
+        return unsubscribe
+
+    def get_error(self) -> Optional[Exception]:
+        return self._error
+
+    # -- worker output dispatch (db.ts:158-186) --
+
+    def _dispatch_output(self, output: object) -> None:
+        if isinstance(output, msg.OnError):
+            with self._lock:
+                self._error = output.error
+                listeners = list(self._error_listeners)
+            for fn in listeners:
+                fn(output.error)
+        elif isinstance(output, msg.OnQuery):
+            self._on_query(output)
+        elif isinstance(output, msg.OnReceive):
+            # Re-run every subscribed query (db.ts:174-176).
+            with self._lock:
+                queries = tuple(self._subscribed)
+            if queries:
+                self.worker.post(msg.Query(queries))
+        elif isinstance(output, msg.ReloadAllTabs):
+            with self._lock:
+                self._rows_cache.clear()
+                self.owner = self.worker.owner
+            if self._on_reload is not None:
+                self._on_reload()
+        elif isinstance(output, msg.OnInit):
+            self.owner = output.owner
+
+    def _on_query(self, output: msg.OnQuery) -> None:
+        with self._lock:
+            for query, ops in output.queries_patches:
+                self._rows_cache[query] = apply_patch(self._rows_cache.get(query, []), ops)
+            listeners = list(self._listeners)
+            completes = [
+                self._on_completes.pop(i)
+                for i in output.on_complete_ids
+                if i in self._on_completes
+            ]
+        self.first_data_loaded.set()
+        for fn in listeners:
+            fn()
+        for fn in completes:
+            fn()
+
+    def dispose(self) -> None:
+        self.worker.stop()
+        if self._transport is not None and hasattr(self._transport, "stop"):
+            self._transport.stop()
+        self.db.close()
+
+
+def create_evolu(
+    schema: Dict[str, Sequence[str]],
+    config: Optional[Config] = None,
+    db_path: str = ":memory:",
+    mnemonic: Optional[str] = None,
+) -> Evolu:
+    """The `createHooks` analog (createHooks.ts:20-26): build a client
+    and register the app schema."""
+    evolu = Evolu(db_path=db_path, config=config, mnemonic=mnemonic)
+    evolu.update_db_schema(schema)
+    return evolu

@@ -1,0 +1,267 @@
+"""DbWorker — the single-writer command engine.
+
+Reference: packages/evolu/src/db.worker.ts. All state-changing work
+funnels through one ordered queue processed by one thread; every
+command runs inside one SQLite transaction and reports failures as an
+`OnError` output instead of raising (db.worker.ts:50-75). Command
+semantics live in methods named after the reference's command modules
+(send.ts, receive.ts, query.ts, sync.ts, updateDbSchema.ts,
+resetOwner.ts, restoreOwner.ts).
+
+TPU-native twist: `Send`/`Receive` batches are applied through a
+pluggable merge planner — the host oracle for small batches, the
+device kernel (`evolu_tpu.ops.merge.plan_batch_device`) above
+`config.min_device_batch` — with identical end state either way
+(tests/test_apply.py property-tests the equivalence).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from evolu_tpu.core.merkle import diff_merkle_trees, merkle_tree_from_string, merkle_tree_to_string
+from evolu_tpu.core.timestamp import (
+    create_sync_timestamp,
+    receive_timestamp,
+    send_timestamp,
+    timestamp_from_string,
+    timestamp_to_string,
+)
+from evolu_tpu.core.types import CrdtClock, CrdtMessage, Owner, SyncError
+from evolu_tpu.runtime import messages as msg
+from evolu_tpu.runtime.jsonpatch import create_patch
+from evolu_tpu.runtime.synclock import SyncLock, get_sync_lock
+from evolu_tpu.storage.apply import apply_messages, plan_batch
+from evolu_tpu.storage.clock import read_clock, update_clock
+from evolu_tpu.storage.schema import delete_all_tables, init_db_model, update_db_schema
+from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.utils.config import Config
+
+
+def _now_millis() -> int:
+    return int(time.time() * 1000)
+
+
+def select_planner(config: Config) -> Callable:
+    """Pick the merge planner per config.backend: the host oracle below
+    `min_device_batch`, the device kernel at/above it ("auto"/"tpu")."""
+    if config.backend == "cpu":
+        return plan_batch
+
+    from evolu_tpu.ops.merge import plan_batch_device
+
+    threshold = 0 if config.backend == "tpu" else config.min_device_batch
+
+    def planner(batch, existing):
+        if len(batch) >= threshold:
+            return plan_batch_device(batch, existing)
+        return plan_batch(batch, existing)
+
+    return planner
+
+
+class DbWorker:
+    """The engine. Post commands with `post`; outputs arrive on the
+    `on_output` callback from the worker thread (or synchronously from
+    `start` for `OnInit`)."""
+
+    def __init__(
+        self,
+        db: PySqliteDatabase,
+        config: Optional[Config] = None,
+        on_output: Optional[Callable[[object], None]] = None,
+        post_sync: Optional[Callable[[msg.SyncRequestInput], None]] = None,
+        now: Callable[[], int] = _now_millis,
+        sync_lock: Optional[SyncLock] = None,
+    ):
+        self.db = db
+        self.config = config or Config()
+        self.on_output = on_output or (lambda _o: None)
+        self.post_sync = post_sync or (lambda _r: None)
+        self.now = now
+        self.sync_lock = sync_lock or get_sync_lock(db.path)
+        self.owner: Optional[Owner] = None
+        self.queries_rows_cache: Dict[str, List[dict]] = {}
+        self._planner = select_planner(self.config)
+        self._queue: "queue.Queue[object]" = queue.Queue()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = object()
+
+    # -- lifecycle --
+
+    def start(self, mnemonic: Optional[str] = None) -> Owner:
+        """Init: bootstrap the db model in one transaction and emit
+        OnInit with the owner (db.worker.ts:77-137)."""
+        with self.db.transaction():
+            self.owner = init_db_model(self.db, mnemonic)
+        self.on_output(msg.OnInit(self.owner))
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="evolu-db-worker")
+        self._thread.start()
+        return self.owner
+
+    def stop(self) -> None:
+        self._queue.put(self._stop)
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def post(self, command: object) -> None:
+        """Enqueue a DbWorkerInput (db.worker.ts:47-75)."""
+        self._queue.put(command)
+
+    def flush(self) -> None:
+        """Block until every queued command has been processed (test/sync aid)."""
+        done = threading.Event()
+        self._queue.put(done)
+        done.wait()
+
+    def _loop(self) -> None:
+        while True:
+            command = self._queue.get()
+            if command is self._stop:
+                return
+            if isinstance(command, threading.Event):
+                command.set()
+                continue
+            self.handle(command)
+
+    def handle(self, command: object) -> None:
+        """Dispatch one command inside one transaction; errors roll back
+        and surface as OnError (db.worker.ts:57-73)."""
+        try:
+            with self.db.transaction():
+                if isinstance(command, msg.Send):
+                    self._send(command)
+                elif isinstance(command, msg.Receive):
+                    self._receive(command)
+                elif isinstance(command, msg.Query):
+                    self._query(command.queries)
+                elif isinstance(command, msg.Sync):
+                    self._sync(command)
+                elif isinstance(command, msg.UpdateDbSchema):
+                    update_db_schema(self.db, command.table_definitions)
+                elif isinstance(command, msg.ResetOwner):
+                    self._reset_owner()
+                elif isinstance(command, msg.RestoreOwner):
+                    self._restore_owner(command.mnemonic)
+                else:
+                    raise ValueError(f"unknown command: {command!r}")
+        except Exception as e:  # noqa: BLE001 - the Either-left channel
+            self.on_output(msg.OnError(e))
+
+    # -- commands --
+
+    def _send(self, command: msg.Send) -> None:
+        """send.ts:82-122: stamp → apply → persist clock → push → re-query."""
+        clock = read_clock(self.db)
+        t = clock.timestamp
+        stamped: List[CrdtMessage] = []
+        for m in command.messages:
+            t = send_timestamp(t, self.now(), self.config.max_drift)
+            stamped.append(
+                CrdtMessage(timestamp_to_string(t), m.table, m.row, m.column, m.value)
+            )
+        tree = apply_messages(self.db, clock.merkle_tree, stamped, planner=self._planner)
+        next_clock = CrdtClock(t, tree)
+        update_clock(self.db, next_clock)
+        self.post_sync(
+            msg.SyncRequestInput(
+                messages=tuple(stamped),
+                clock_timestamp=timestamp_to_string(t),
+                merkle_tree=merkle_tree_to_string(tree),
+                owner=self.owner,
+            )
+        )
+        self._query(command.queries, command.on_complete_ids)
+
+    def _receive(self, command: msg.Receive) -> None:
+        """receive.ts:144-199: merge remote messages, then anti-entropy."""
+        clock = read_clock(self.db)
+        if command.messages:
+            # HLC merge folded over every remote timestamp (receive.ts:45-66).
+            t = clock.timestamp
+            for m in command.messages:
+                t = receive_timestamp(
+                    t, timestamp_from_string(m.timestamp), self.now(), self.config.max_drift
+                )
+            tree = apply_messages(
+                self.db, clock.merkle_tree, list(command.messages), planner=self._planner
+            )
+            clock = CrdtClock(t, tree)
+            update_clock(self.db, clock)
+            self.on_output(msg.OnReceive())
+
+        server_tree = merkle_tree_from_string(command.merkle_tree)
+        diff = diff_merkle_trees(server_tree, clock.merkle_tree)
+        if diff is None:
+            return
+        # Livelock guard: the same diff twice in a row means the replicas
+        # cannot converge (receive.ts:99-104).
+        if command.previous_diff is not None and diff == command.previous_diff:
+            raise SyncError()
+        if self.sync_lock.is_pending_or_held():
+            return
+        since = timestamp_to_string(create_sync_timestamp(diff))
+        rows = self.db.exec_sql_query(
+            'SELECT * FROM "__message" WHERE "timestamp" > ? ORDER BY "timestamp"',
+            (since,),
+        )
+        resend = tuple(
+            CrdtMessage(r["timestamp"], r["table"], r["row"], r["column"], r["value"])
+            for r in rows
+        )
+        self.post_sync(
+            msg.SyncRequestInput(
+                messages=resend,
+                clock_timestamp=timestamp_to_string(clock.timestamp),
+                merkle_tree=merkle_tree_to_string(clock.merkle_tree),
+                owner=self.owner,
+                previous_diff=diff,
+            )
+        )
+
+    def _query(self, queries: Sequence[str], on_complete_ids: Sequence[str] = ()) -> None:
+        """query.ts:16-76: run, diff vs cache, post non-empty patches."""
+        patches = []
+        for q in queries:
+            sql, parameters = msg.deserialize_query(q)
+            rows = self.db.exec_sql_query(sql, parameters)
+            ops = create_patch(self.queries_rows_cache.get(q, []), rows)
+            self.queries_rows_cache[q] = rows
+            if ops:
+                patches.append((q, ops))
+        if patches or on_complete_ids:
+            self.on_output(msg.OnQuery(tuple(patches), tuple(on_complete_ids)))
+
+    def _sync(self, command: msg.Sync) -> None:
+        """sync.ts:20-69: optional query refresh, then a pull-only round."""
+        if command.queries:
+            self._query(command.queries)
+        if self.sync_lock.is_pending_or_held():
+            return
+        clock = read_clock(self.db)
+        self.post_sync(
+            msg.SyncRequestInput(
+                messages=(),
+                clock_timestamp=timestamp_to_string(clock.timestamp),
+                merkle_tree=merkle_tree_to_string(clock.merkle_tree),
+                owner=self.owner,
+            )
+        )
+
+    def _reset_owner(self) -> None:
+        """resetOwner.ts:7-21."""
+        delete_all_tables(self.db)
+        self.queries_rows_cache.clear()
+        self.on_output(msg.ReloadAllTabs())
+
+    def _restore_owner(self, mnemonic: str) -> None:
+        """restoreOwner.ts:9-23 — wipe, re-seed identity; history returns
+        via the first sync against the relay (SURVEY.md §3.5)."""
+        delete_all_tables(self.db)
+        self.queries_rows_cache.clear()
+        self.owner = init_db_model(self.db, mnemonic)
+        self.on_output(msg.ReloadAllTabs())
