@@ -90,6 +90,8 @@ class QueryBuilder:
         if self._limit is not None:
             sql += " LIMIT ?"
             parameters.append(self._limit)
+        elif self._offset is not None:
+            sql += " LIMIT -1"  # SQLite requires LIMIT before OFFSET
         if self._offset is not None:
             sql += " OFFSET ?"
             parameters.append(self._offset)

@@ -57,9 +57,9 @@ class Evolu:
         self._error: Optional[Exception] = None
         self._error_listeners: List[Callable[[Exception], None]] = []
         self._on_completes: Dict[str, Callable[[], None]] = {}  # by id (db.ts:70-82)
-        self._batch_depth = 0
-        self._pending: List[NewCrdtMessage] = []
-        self._pending_complete_ids: List[str] = []
+        # Batching state is thread-local: a batch open on one thread must
+        # not capture (or, if aborted, discard) another thread's mutations.
+        self._batch = threading.local()
         self._on_reload: Optional[Callable[[], None]] = None
         self._transport = None  # set by attach_transport
         self.worker = DbWorker(
@@ -94,12 +94,16 @@ class Evolu:
         def unsubscribe() -> None:
             with self._lock:
                 n = self._subscribed.get(query, 0) - 1
-                if n <= 0:
+                evict = n <= 0
+                if evict:
                     self._subscribed.pop(query, None)
+                    self._rows_cache.pop(query, None)
                 else:
                     self._subscribed[query] = n
                 if listener is not None and listener in self._listeners:
                     self._listeners.remove(listener)
+            if evict:
+                self.worker.post(msg.EvictQueries((query,)))
 
         return unsubscribe
 
@@ -133,30 +137,36 @@ class Evolu:
 
     # -- mutations --
 
+    def _batch_state(self):
+        b = self._batch
+        if not hasattr(b, "depth"):
+            b.depth, b.pending, b.complete_ids = 0, [], []
+        return b
+
     def batching(self):
         """Group several mutate() calls into one Send (db.ts:337-361)."""
         client = self
 
         class _Batch:
             def __enter__(self):
-                with client._lock:
-                    client._batch_depth += 1
+                client._batch_state().depth += 1
                 return client
 
             def __exit__(self, exc_type, exc, tb):
-                with client._lock:
-                    client._batch_depth -= 1
-                    flush = client._batch_depth == 0
-                    if flush and exc_type is not None:
+                b = client._batch_state()
+                b.depth -= 1
+                if b.depth == 0:
+                    if exc_type is None:
+                        client._flush_mutations()
+                    else:
                         # Aborted batch: drop its mutations outright —
                         # leaving them pending would splice them into the
                         # next unrelated Send.
-                        client._pending.clear()
-                        for i in client._pending_complete_ids:
-                            client._on_completes.pop(i, None)
-                        client._pending_complete_ids.clear()
-                if flush and exc_type is None:
-                    client._flush_mutations()
+                        b.pending.clear()
+                        with client._lock:
+                            for i in b.complete_ids:
+                                client._on_completes.pop(i, None)
+                        b.complete_ids.clear()
                 return False
 
         return _Batch()
@@ -190,14 +200,14 @@ class Evolu:
             NewCrdtMessage(table, row_id, column, sqlite_value(v))
             for column, v in values.items()
         ]
-        with self._lock:
-            self._pending.extend(new_messages)
-            if on_complete is not None:
-                complete_id = create_id()
+        b = self._batch_state()
+        b.pending.extend(new_messages)
+        if on_complete is not None:
+            complete_id = create_id()
+            with self._lock:
                 self._on_completes[complete_id] = on_complete
-                self._pending_complete_ids.append(complete_id)
-            immediate = self._batch_depth == 0
-        if immediate:
+            b.complete_ids.append(complete_id)
+        if b.depth == 0:
             self._flush_mutations()
         return row_id
 
@@ -212,14 +222,15 @@ class Evolu:
         return self.mutate(table, values, on_complete)
 
     def _flush_mutations(self) -> None:
+        b = self._batch_state()
+        if not b.pending:
+            return
+        batch = tuple(b.pending)
+        ids = tuple(b.complete_ids)
+        b.pending.clear()
+        b.complete_ids.clear()
         with self._lock:
-            if not self._pending:
-                return
-            batch = tuple(self._pending)
-            ids = tuple(self._pending_complete_ids)
             queries = tuple(self._subscribed)
-            self._pending.clear()
-            self._pending_complete_ids.clear()
         self.worker.post(msg.Send(batch, ids, queries))
 
     # -- sync --

@@ -58,6 +58,15 @@ class Query:
 
 
 @dataclass(frozen=True)
+class EvictQueries:
+    """Drop unsubscribed queries from the worker's diff cache (no
+    reference analog — the reference's worker cache lives for the
+    worker's lifetime; eviction keeps long-lived clients bounded)."""
+
+    queries: tuple  # SqlQueryString
+
+
+@dataclass(frozen=True)
 class Receive:
     messages: tuple  # of CrdtMessage
     merkle_tree: str  # serialized server tree

@@ -86,6 +86,8 @@ class DbWorker:
         self.owner: Optional[Owner] = None
         self.queries_rows_cache: Dict[str, List[dict]] = {}
         self._planner = select_planner(self.config)
+        self._staged_effects: List = []
+        self._staged_cache: Dict[str, List[dict]] = {}
         self._queue: "queue.Queue[object]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._stop = object()
@@ -128,9 +130,25 @@ class DbWorker:
                 continue
             self.handle(command)
 
+    # Side effects (outputs, sync pushes, worker-cache writes) are staged
+    # during a command and flushed only after its transaction commits —
+    # otherwise a failure later in the command would roll back local
+    # state that was already pushed to the relay (the relay's own-node
+    # exclusion would then never return those messages: permanent
+    # divergence), and the worker's query cache would desync from the
+    # committed rows.
+
+    def _emit(self, output: object) -> None:
+        self._staged_effects.append(lambda: self.on_output(output))
+
+    def _push(self, request: msg.SyncRequestInput) -> None:
+        self._staged_effects.append(lambda: self.post_sync(request))
+
     def handle(self, command: object) -> None:
         """Dispatch one command inside one transaction; errors roll back
         and surface as OnError (db.worker.ts:57-73)."""
+        self._staged_effects = []
+        self._staged_cache: Dict[str, List[dict]] = {}
         try:
             with self.db.transaction():
                 if isinstance(command, msg.Send):
@@ -139,6 +157,9 @@ class DbWorker:
                     self._receive(command)
                 elif isinstance(command, msg.Query):
                     self._query(command.queries)
+                elif isinstance(command, msg.EvictQueries):
+                    for q in command.queries:
+                        self.queries_rows_cache.pop(q, None)
                 elif isinstance(command, msg.Sync):
                     self._sync(command)
                 elif isinstance(command, msg.UpdateDbSchema):
@@ -151,6 +172,10 @@ class DbWorker:
                     raise ValueError(f"unknown command: {command!r}")
         except Exception as e:  # noqa: BLE001 - the Either-left channel
             self.on_output(msg.OnError(e))
+            return
+        self.queries_rows_cache.update(self._staged_cache)
+        for effect in self._staged_effects:
+            effect()
 
     # -- commands --
 
@@ -167,7 +192,7 @@ class DbWorker:
         tree = apply_messages(self.db, clock.merkle_tree, stamped, planner=self._planner)
         next_clock = CrdtClock(t, tree)
         update_clock(self.db, next_clock)
-        self.post_sync(
+        self._push(
             msg.SyncRequestInput(
                 messages=tuple(stamped),
                 clock_timestamp=timestamp_to_string(t),
@@ -192,7 +217,7 @@ class DbWorker:
             )
             clock = CrdtClock(t, tree)
             update_clock(self.db, clock)
-            self.on_output(msg.OnReceive())
+            self._emit(msg.OnReceive())
 
         server_tree = merkle_tree_from_string(command.merkle_tree)
         diff = diff_merkle_trees(server_tree, clock.merkle_tree)
@@ -213,7 +238,7 @@ class DbWorker:
             CrdtMessage(r["timestamp"], r["table"], r["row"], r["column"], r["value"])
             for r in rows
         )
-        self.post_sync(
+        self._push(
             msg.SyncRequestInput(
                 messages=resend,
                 clock_timestamp=timestamp_to_string(clock.timestamp),
@@ -229,12 +254,13 @@ class DbWorker:
         for q in queries:
             sql, parameters = msg.deserialize_query(q)
             rows = self.db.exec_sql_query(sql, parameters)
-            ops = create_patch(self.queries_rows_cache.get(q, []), rows)
-            self.queries_rows_cache[q] = rows
+            prev = self._staged_cache.get(q, self.queries_rows_cache.get(q, []))
+            ops = create_patch(prev, rows)
+            self._staged_cache[q] = rows
             if ops:
                 patches.append((q, ops))
         if patches or on_complete_ids:
-            self.on_output(msg.OnQuery(tuple(patches), tuple(on_complete_ids)))
+            self._emit(msg.OnQuery(tuple(patches), tuple(on_complete_ids)))
 
     def _sync(self, command: msg.Sync) -> None:
         """sync.ts:20-69: optional query refresh, then a pull-only round."""
@@ -243,7 +269,7 @@ class DbWorker:
         if self.sync_lock.is_pending_or_held():
             return
         clock = read_clock(self.db)
-        self.post_sync(
+        self._push(
             msg.SyncRequestInput(
                 messages=(),
                 clock_timestamp=timestamp_to_string(clock.timestamp),
@@ -255,13 +281,13 @@ class DbWorker:
     def _reset_owner(self) -> None:
         """resetOwner.ts:7-21."""
         delete_all_tables(self.db)
-        self.queries_rows_cache.clear()
-        self.on_output(msg.ReloadAllTabs())
+        self._staged_effects.append(self.queries_rows_cache.clear)
+        self._emit(msg.ReloadAllTabs())
 
     def _restore_owner(self, mnemonic: str) -> None:
         """restoreOwner.ts:9-23 — wipe, re-seed identity; history returns
         via the first sync against the relay (SURVEY.md §3.5)."""
         delete_all_tables(self.db)
-        self.queries_rows_cache.clear()
+        self._staged_effects.append(self.queries_rows_cache.clear)
         self.owner = init_db_model(self.db, mnemonic)
-        self.on_output(msg.ReloadAllTabs())
+        self._emit(msg.ReloadAllTabs())

@@ -1,0 +1,13 @@
+"""Relay server: the E2EE-blind sync endpoint plus the TPU batch
+reconcile engine.
+
+Reference: apps/server/src/index.ts — a single `POST /` endpoint
+storing (timestamp, userId, ciphertext) rows and per-user Merkle
+trees; it never sees plaintext. The TPU-native addition
+(`evolu_tpu.server.engine`) reconciles many owners' message batches in
+one device pass, sharded over the mesh (SURVEY.md §2.15).
+"""
+
+from evolu_tpu.server.relay import RelayStore, RelayServer, serve
+
+__all__ = ["RelayStore", "RelayServer", "serve"]

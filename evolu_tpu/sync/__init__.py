@@ -1,0 +1,34 @@
+"""Sync transport: wire protocol, E2EE crypto, and the HTTP client.
+
+Reference: packages/evolu/src/sync.worker.ts (encrypt → protobuf →
+HTTP POST → decrypt), protos/protobuf.proto (the wire contract —
+unchanged here so TypeScript reference clients interoperate), and
+OpenPGP symmetric encryption with the mnemonic as the password.
+
+Crypto stays on the host (SURVEY.md §7): it is not TPU-suitable work.
+"""
+
+from evolu_tpu.sync.protocol import (
+    EncryptedCrdtMessage,
+    SyncRequest,
+    SyncResponse,
+    decode_sync_request,
+    decode_sync_response,
+    encode_sync_request,
+    encode_sync_response,
+)
+from evolu_tpu.sync.crypto import encrypt_symmetric, decrypt_symmetric
+from evolu_tpu.sync.client import SyncTransport
+
+__all__ = [
+    "EncryptedCrdtMessage",
+    "SyncRequest",
+    "SyncResponse",
+    "decode_sync_request",
+    "decode_sync_response",
+    "encode_sync_request",
+    "encode_sync_response",
+    "encrypt_symmetric",
+    "decrypt_symmetric",
+    "SyncTransport",
+]

@@ -1,0 +1,144 @@
+"""The sync transport — SyncWorker analog.
+
+Reference: packages/evolu/src/sync.worker.ts. One input shape (a sync
+request carrying optional fresh messages + the clock), one pipeline
+(sync.worker.ts:177-229): encrypt each message's content → protobuf
+SyncRequest → HTTP POST octet-stream → parse SyncResponse → decrypt →
+hand the result back to the DbWorker as a Receive command.
+
+Network failure is swallowed by design — offline is a normal state,
+recovery is the next sync trigger (sync.worker.ts:217-227). Every
+round runs under the per-database sync lock, making sync mutually
+exclusive across clients of the same database (syncLock.ts:8-12).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from evolu_tpu.core.timestamp import timestamp_from_string
+from evolu_tpu.core.types import CrdtMessage, UnknownError
+from evolu_tpu.runtime.messages import OnError, SyncRequestInput
+from evolu_tpu.runtime.synclock import SyncLock
+from evolu_tpu.sync import protocol
+from evolu_tpu.sync.crypto import decrypt_symmetric, encrypt_symmetric
+from evolu_tpu.utils.config import Config
+
+
+def encrypt_messages(messages, mnemonic: str):
+    """sync.worker.ts:50-91 — per-message protobuf-encode + encrypt;
+    the timestamp stays plaintext (the relay orders and diffs by it)."""
+    out = []
+    for m in messages:
+        content = protocol.encode_content(m.table, m.row, m.column, m.value)
+        out.append(
+            protocol.EncryptedCrdtMessage(m.timestamp, encrypt_symmetric(content, mnemonic))
+        )
+    return tuple(out)
+
+
+def decrypt_messages(messages, mnemonic: str):
+    """sync.worker.ts:135-173."""
+    out = []
+    for m in messages:
+        table, row, column, value = protocol.decode_content(
+            decrypt_symmetric(m.content, mnemonic)
+        )
+        out.append(CrdtMessage(m.timestamp, table, row, column, value))
+    return tuple(out)
+
+
+class SyncTransport:
+    """Owns a transport thread; `request_sync` enqueues a round.
+
+    `on_receive(messages, merkle_tree, previous_diff)` is called with
+    the decrypted response — typically `Evolu.receive`, closing the
+    anti-entropy loop (SURVEY.md §3.3).
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        on_receive: Callable[[tuple, str, Optional[int]], None],
+        sync_lock: Optional[SyncLock] = None,
+        on_error: Optional[Callable[[Exception], None]] = None,
+        http_post: Optional[Callable[[str, bytes], bytes]] = None,
+    ):
+        self.config = config
+        self.on_receive = on_receive
+        self.sync_lock = sync_lock or SyncLock()
+        self.on_error = on_error or (lambda _e: None)
+        self._http_post = http_post or _http_post
+        self._queue: "queue.Queue[object]" = queue.Queue()
+        self._stop = object()
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="evolu-sync")
+        self._thread.start()
+
+    def request_sync(self, request: SyncRequestInput) -> None:
+        self._queue.put(request)
+
+    def stop(self) -> None:
+        self._queue.put(self._stop)
+        self._thread.join()
+
+    def flush(self) -> None:
+        done = threading.Event()
+        self._queue.put(done)
+        done.wait()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is self._stop:
+                return
+            if isinstance(item, threading.Event):
+                item.set()
+                continue
+            with self.sync_lock.hold():
+                self._sync_round(item)
+
+    def _sync_round(self, request: SyncRequestInput) -> None:
+        try:
+            encrypted = encrypt_messages(request.messages, request.owner.mnemonic)
+            node_id = timestamp_from_string(request.clock_timestamp).node
+            body = protocol.encode_sync_request(
+                protocol.SyncRequest(encrypted, request.owner.id, node_id, request.merkle_tree)
+            )
+        except Exception as e:  # noqa: BLE001
+            self.on_error(UnknownError(e))
+            return
+        try:
+            response_bytes = self._http_post(self.config.sync_url, body)
+        except (urllib.error.URLError, OSError):
+            return  # offline is not an error (sync.worker.ts:217-227)
+        try:
+            response = protocol.decode_sync_response(response_bytes)
+            messages = decrypt_messages(response.messages, request.owner.mnemonic)
+            self.on_receive(messages, response.merkle_tree, request.previous_diff)
+        except Exception as e:  # noqa: BLE001
+            self.on_error(UnknownError(e))
+
+
+def _http_post(url: str, body: bytes) -> bytes:
+    req = urllib.request.Request(
+        url, data=body, headers={"Content-Type": "application/octet-stream"}, method="POST"
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.read()
+
+
+def connect(evolu, config: Optional[Config] = None) -> SyncTransport:
+    """Wire a client to its relay: transport → Evolu.receive, and
+    Evolu's post_sync → transport (db.ts:134-156's channel setup)."""
+    transport = SyncTransport(
+        config or evolu.config,
+        on_receive=evolu.receive,
+        sync_lock=evolu.worker.sync_lock,
+        on_error=lambda e: evolu._dispatch_output(OnError(e)),
+    )
+    evolu.attach_transport(transport)
+    return transport

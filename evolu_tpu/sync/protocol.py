@@ -1,0 +1,234 @@
+"""The protobuf wire contract, hand-rolled.
+
+Reference: packages/evolu/protos/protobuf.proto (field numbers are the
+contract — a TypeScript reference client must be able to talk to this
+framework's relay and vice versa):
+
+    CrdtMessageContent { table=1 row=2 column=3
+                         oneof value { stringValue=4 numberValue=5 } }
+    EncryptedCrdtMessage { timestamp=1 content=2 }
+    SyncRequest  { messages=1 userId=2 nodeId=3 merkleTree=4 }
+    SyncResponse { messages=1 merkleTree=2 }
+
+This module implements exactly the proto3 subset those messages need
+(varint, length-delimited, 64-bit) with no codegen dependency.
+
+Float values: the reference's value oneof is string|int32; floats only
+survive its lax TS encoder. Here non-integer numbers travel in an
+extension field `doubleValue=6` (wire type I64) — lossless between
+evolu_tpu peers; a reference TS client skips the unknown field and
+sees null, which is the honest reading of a value its schema cannot
+express.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+from evolu_tpu.core.types import CrdtValue
+
+_INT32_MIN, _INT32_MAX = -(2**31), 2**31 - 1
+
+
+# --- primitive writers ---
+
+
+def _varint(value: int) -> bytes:
+    if value < 0:  # proto3 int32: negatives are 10-byte two's-complement varints
+        value += 1 << 64
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field_number: int, wire_type: int) -> bytes:
+    return _varint((field_number << 3) | wire_type)
+
+
+def _len_delimited(field_number: int, data: bytes) -> bytes:
+    return _tag(field_number, 2) + _varint(len(data)) + data
+
+
+def _string(field_number: int, s: str) -> bytes:
+    return _len_delimited(field_number, s.encode("utf-8"))
+
+
+# --- primitive readers ---
+
+
+def _read_varint(data: bytes, pos: int) -> Tuple[int, int]:
+    result = shift = 0
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated varint")
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint too long")
+
+
+def _read_field(data: bytes, pos: int) -> Tuple[int, int, Union[int, bytes], int]:
+    """→ (field_number, wire_type, value, next_pos). Length-delimited
+    values come back as bytes, varints/fixed as ints."""
+    key, pos = _read_varint(data, pos)
+    field_number, wire_type = key >> 3, key & 7
+    if wire_type == 0:
+        value, pos = _read_varint(data, pos)
+    elif wire_type == 1:
+        value = int.from_bytes(data[pos : pos + 8], "little")
+        pos += 8
+    elif wire_type == 2:
+        length, pos = _read_varint(data, pos)
+        value = data[pos : pos + length]
+        if len(value) != length:
+            raise ValueError("truncated length-delimited field")
+        pos += length
+    elif wire_type == 5:
+        value = int.from_bytes(data[pos : pos + 4], "little")
+        pos += 4
+    else:
+        raise ValueError(f"unsupported wire type {wire_type}")
+    return field_number, wire_type, value, pos
+
+
+# --- CrdtMessageContent (proto:5-13) ---
+
+
+def encode_content(table: str, row: str, column: str, value: CrdtValue) -> bytes:
+    out = _string(1, table) + _string(2, row) + _string(3, column)
+    if value is None:
+        pass  # oneofKind undefined → no value field (sync.worker.ts:40-48)
+    elif isinstance(value, str):
+        out += _string(4, value)
+    elif isinstance(value, bool):  # bools are stored cast to 0/1 upstream
+        out += _tag(5, 0) + _varint(int(value))
+    elif isinstance(value, int) and _INT32_MIN <= value <= _INT32_MAX:
+        out += _tag(5, 0) + _varint(value)
+    elif isinstance(value, int):
+        if not -(2**63) <= value < 2**63:
+            raise TypeError(f"integer exceeds int64: {value!r}")
+        out += _tag(7, 0) + _varint(value)  # int64 extension — exact
+    elif isinstance(value, float):
+        out += _tag(6, 1) + struct.pack("<d", value)
+    else:
+        raise TypeError(f"unencodable CrdtValue: {value!r}")
+    return out
+
+
+def decode_content(data: bytes) -> Tuple[str, str, str, CrdtValue]:
+    table = row = column = ""
+    value: CrdtValue = None
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            table = v.decode("utf-8")
+        elif num == 2:
+            row = v.decode("utf-8")
+        elif num == 3:
+            column = v.decode("utf-8")
+        elif num == 4:
+            value = v.decode("utf-8")
+        elif num == 5:
+            # int32: sign-extended 64-bit varint on the wire; truncate
+            # to int32 like every conformant decoder.
+            value = ((v & 0xFFFFFFFF) ^ 0x80000000) - 0x80000000
+        elif num == 6:
+            value = struct.unpack("<d", int(v).to_bytes(8, "little"))[0]
+        elif num == 7:
+            value = v - (1 << 64) if v >= 1 << 63 else v  # int64 extension
+    return table, row, column, value
+
+
+# --- EncryptedCrdtMessage (proto:15-18) ---
+
+
+@dataclass(frozen=True)
+class EncryptedCrdtMessage:
+    timestamp: str  # stays plaintext — the relay orders/diffs by it
+    content: bytes  # OpenPGP ciphertext of encode_content
+
+
+def encode_encrypted_message(m: EncryptedCrdtMessage) -> bytes:
+    return _string(1, m.timestamp) + _len_delimited(2, m.content)
+
+
+def decode_encrypted_message(data: bytes) -> EncryptedCrdtMessage:
+    timestamp, content = "", b""
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            timestamp = v.decode("utf-8")
+        elif num == 2:
+            content = bytes(v)
+    return EncryptedCrdtMessage(timestamp, content)
+
+
+# --- SyncRequest (proto:20-25) / SyncResponse (proto:27-30) ---
+
+
+@dataclass(frozen=True)
+class SyncRequest:
+    messages: Tuple[EncryptedCrdtMessage, ...]
+    user_id: str
+    node_id: str
+    merkle_tree: str
+
+
+@dataclass(frozen=True)
+class SyncResponse:
+    messages: Tuple[EncryptedCrdtMessage, ...]
+    merkle_tree: str
+
+
+def encode_sync_request(r: SyncRequest) -> bytes:
+    out = b"".join(_len_delimited(1, encode_encrypted_message(m)) for m in r.messages)
+    return out + _string(2, r.user_id) + _string(3, r.node_id) + _string(4, r.merkle_tree)
+
+
+def decode_sync_request(data: bytes) -> SyncRequest:
+    messages: List[EncryptedCrdtMessage] = []
+    user_id = node_id = merkle_tree = ""
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            messages.append(decode_encrypted_message(v))
+        elif num == 2:
+            user_id = v.decode("utf-8")
+        elif num == 3:
+            node_id = v.decode("utf-8")
+        elif num == 4:
+            merkle_tree = v.decode("utf-8")
+    return SyncRequest(tuple(messages), user_id, node_id, merkle_tree)
+
+
+def encode_sync_response(r: SyncResponse) -> bytes:
+    out = b"".join(_len_delimited(1, encode_encrypted_message(m)) for m in r.messages)
+    return out + _string(2, r.merkle_tree)
+
+
+def decode_sync_response(data: bytes) -> SyncResponse:
+    messages: List[EncryptedCrdtMessage] = []
+    merkle_tree = ""
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            messages.append(decode_encrypted_message(v))
+        elif num == 2:
+            merkle_tree = v.decode("utf-8")
+    return SyncResponse(tuple(messages), merkle_tree)

@@ -309,3 +309,44 @@ def test_query_once_does_not_leak_subscription():
         assert len(evolu.get_query_rows(q)) == 1
     finally:
         evolu.dispose()
+
+
+def test_send_failure_does_not_push_to_relay():
+    """A command that fails after apply must roll back without having
+    pushed anything to the transport (push-after-commit discipline)."""
+    evolu = make_client()
+    try:
+        pushed = []
+        evolu.worker.post_sync = lambda r: pushed.append(r)
+        bad = msg.serialize_query("SELECT broken FROM nowhere")
+        evolu.subscribe_query(bad)
+        evolu.worker.flush()
+        evolu.create("todo", {"title": "x"})  # Send: apply ok, _query raises
+        evolu.worker.flush()
+        assert pushed == []  # nothing escaped the rolled-back transaction
+        rows = evolu.db.exec_sql_query('SELECT COUNT(*) AS n FROM "__message"')
+        assert rows[0]["n"] == 0  # local state rolled back consistently
+        assert evolu.get_error() is not None
+    finally:
+        evolu.dispose()
+
+
+def test_unsubscribe_evicts_caches():
+    evolu = make_client()
+    try:
+        q = table("todo").select("id").serialize()
+        unsub = evolu.subscribe_query(q)
+        evolu.create("todo", {"title": "x"})
+        evolu.worker.flush()
+        assert q in evolu.worker.queries_rows_cache
+        unsub()
+        evolu.worker.flush()
+        assert q not in evolu.worker.queries_rows_cache
+        assert q not in evolu._rows_cache
+    finally:
+        evolu.dispose()
+
+
+def test_offset_without_limit_compiles():
+    sql, params = table("todo").offset(3).compile()
+    assert "LIMIT -1 OFFSET ?" in sql and params == [3]

@@ -1,0 +1,226 @@
+"""Sync-layer tests: protobuf wire round-trips, OpenPGP crypto, relay
+store semantics, and full client↔relay↔client convergence over HTTP.
+
+The reference never tests this layer (SURVEY.md §4); the convergence
+test here is the N-replica integration test the build plan requires.
+"""
+
+import threading
+
+import pytest
+
+from evolu_tpu.api import model
+from evolu_tpu.api.query import table
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.runtime.client import create_evolu
+from evolu_tpu.sync import protocol
+from evolu_tpu.sync.client import SyncTransport, connect, decrypt_messages, encrypt_messages
+from evolu_tpu.sync.crypto import PgpError, decrypt_symmetric, encrypt_symmetric
+from evolu_tpu.server.relay import RelayServer, RelayStore
+from evolu_tpu.utils.config import Config
+
+TODO_SCHEMA = {"todo": ("title", "isCompleted", *model.COMMON_COLUMNS)}
+TS = "2024-01-15T10:30:00.123Z-0001-89e3b4f11a2c5d70"
+
+
+# --- protocol ---
+
+
+@pytest.mark.parametrize(
+    "value",
+    ["hello", "", "ünïcode ✓", 0, 1, -1, 2**31 - 1, -(2**31), None, 3.25, -1e300],
+)
+def test_content_roundtrip(value):
+    data = protocol.encode_content("todo", "row1", "title", value)
+    assert protocol.decode_content(data) == ("todo", "row1", "title", value)
+
+
+def test_sync_request_roundtrip():
+    msgs = (
+        protocol.EncryptedCrdtMessage(TS, b"\x01\x02\x03"),
+        protocol.EncryptedCrdtMessage(TS.replace("00.123", "59.999"), b""),
+    )
+    req = protocol.SyncRequest(msgs, "owner123", "89e3b4f11a2c5d70", '{"hash":1}')
+    assert protocol.decode_sync_request(protocol.encode_sync_request(req)) == req
+
+
+def test_sync_response_roundtrip():
+    resp = protocol.SyncResponse(
+        (protocol.EncryptedCrdtMessage(TS, b"\xff" * 300),), '{"hash":-5}'
+    )
+    assert protocol.decode_sync_response(protocol.encode_sync_response(resp)) == resp
+
+
+def test_protocol_interop_with_google_protobuf():
+    """Cross-check our hand-rolled encoder against the protoc runtime
+    parsing the reference's .proto schema shape."""
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    pool = descriptor_pool.DescriptorPool()
+    f = descriptor_pb2.FileDescriptorProto()
+    f.name = "t.proto"
+    f.syntax = "proto3"
+    m = f.message_type.add()
+    m.name = "CrdtMessageContent"
+    for i, (name, type_) in enumerate(
+        [("table", 9), ("row", 9), ("column", 9), ("stringValue", 9), ("numberValue", 5)],
+        start=1,
+    ):
+        fld = m.field.add()
+        fld.name, fld.number, fld.type, fld.label = name, i, type_, 1
+    pool.Add(f)
+    cls = message_factory.GetMessageClass(pool.FindMessageTypeByName("CrdtMessageContent"))
+    parsed = cls.FromString(protocol.encode_content("todo", "r", "c", -42))
+    assert (parsed.table, parsed.row, parsed.column, parsed.numberValue) == ("todo", "r", "c", -42)
+    # And decode protoc-encoded bytes with our decoder.
+    theirs = cls(table="x", row="y", column="z", stringValue="v").SerializeToString()
+    assert protocol.decode_content(theirs) == ("x", "y", "z", "v")
+
+
+# --- crypto ---
+
+
+def test_encrypt_decrypt_roundtrip():
+    pt = protocol.encode_content("todo", "row", "title", "secret value")
+    ct = encrypt_symmetric(pt, "drastic monkey fiber")
+    assert ct != pt and pt not in ct
+    assert decrypt_symmetric(ct, "drastic monkey fiber") == pt
+
+
+def test_wrong_password_fails():
+    ct = encrypt_symmetric(b"data", "right password")
+    with pytest.raises(PgpError):
+        decrypt_symmetric(ct, "wrong password")
+
+
+def test_ciphertext_is_nondeterministic():
+    assert encrypt_symmetric(b"x", "p") != encrypt_symmetric(b"x", "p")
+
+
+def test_mdc_tamper_detected():
+    ct = bytearray(encrypt_symmetric(b"payload", "p"))
+    ct[-5] ^= 0xFF
+    with pytest.raises(PgpError):
+        decrypt_symmetric(bytes(ct), "p")
+
+
+def test_large_payload_roundtrip():
+    pt = b"\x00\x01" * 10000
+    assert decrypt_symmetric(encrypt_symmetric(pt, "p"), "p") == pt
+
+
+def test_encrypt_decrypt_messages_pipeline():
+    msgs = (
+        CrdtMessage(TS, "todo", "r1", "title", "hello"),
+        CrdtMessage(TS, "todo", "r1", "isCompleted", 1),
+        CrdtMessage(TS, "todo", "r1", "note", None),
+    )
+    enc = encrypt_messages(msgs, "mnemonic words here")
+    assert all(e.timestamp == TS for e in enc)  # timestamps stay plaintext
+    assert decrypt_messages(enc, "mnemonic words here") == msgs
+
+
+# --- relay store ---
+
+
+def _enc(ts, payload=b"c"):
+    return protocol.EncryptedCrdtMessage(ts, payload)
+
+
+def test_relay_add_messages_idempotent():
+    store = RelayStore()
+    t1 = store.add_messages("u1", [_enc(TS)])
+    t2 = store.add_messages("u1", [_enc(TS)])  # duplicate: changes==0, no XOR
+    assert t1 == t2
+
+
+def test_relay_sync_returns_missing_excluding_own_node():
+    store = RelayStore()
+    other = TS.replace("89e3b4f11a2c5d70", "aaaaaaaaaaaaaaaa")
+    store.add_messages("u1", [_enc(TS), _enc(other, b"other")])
+    # Client with empty tree and the first message's node id asks for a diff.
+    from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_string
+
+    req = protocol.SyncRequest((), "u1", "89e3b4f11a2c5d70",
+                               merkle_tree_to_string(create_initial_merkle_tree()))
+    resp = store.sync(req)
+    assert [m.timestamp for m in resp.messages] == [other]  # own node excluded
+
+
+def test_relay_users_are_isolated():
+    store = RelayStore()
+    store.add_messages("u1", [_enc(TS)])
+    from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_string
+
+    req = protocol.SyncRequest((), "u2", "bbbbbbbbbbbbbbbb",
+                               merkle_tree_to_string(create_initial_merkle_tree()))
+    resp = store.sync(req)
+    assert resp.messages == () and resp.merkle_tree == "{}"
+
+
+# --- end-to-end over HTTP ---
+
+
+def _converged(*clients, query):
+    rows = [c.query_once(query) for c in clients]
+    return all(r == rows[0] for r in rows)
+
+
+def test_clients_converge_through_relay():
+    server = RelayServer().start()
+    try:
+        mnemonic = None
+        config = Config(sync_url=server.url)
+        a = create_evolu(TODO_SCHEMA, config=config)
+        b = create_evolu(TODO_SCHEMA, config=config, mnemonic=a.owner.mnemonic)
+        ta, tb = connect(a), connect(b)
+        try:
+            q = table("todo").select("id", "title").order_by("id").serialize()
+            rid = a.create("todo", {"title": "from-a"})
+            b.create("todo", {"title": "from-b"})
+            # Let the push rounds land, then pull until converged.
+            for _ in range(6):
+                a.worker.flush(); ta.flush(); a.worker.flush()
+                b.worker.flush(); tb.flush(); b.worker.flush()
+                a.sync(refresh_queries=False); b.sync(refresh_queries=False)
+            ra, rb = a.query_once(q), b.query_once(q)
+            assert len(ra) == 2 and ra == rb, (ra, rb)
+            assert a.get_error() is None and b.get_error() is None
+            # A third device restores from the mnemonic alone (SURVEY §3.5).
+            c = create_evolu(TODO_SCHEMA, config=config, mnemonic=a.owner.mnemonic)
+            tc = connect(c)
+            c.sync(refresh_queries=False)
+            for _ in range(6):
+                c.worker.flush(); tc.flush(); c.worker.flush()
+                c.sync(refresh_queries=False)
+            assert c.query_once(q) == ra
+            c.dispose()
+        finally:
+            a.dispose(); b.dispose()
+    finally:
+        server.stop()
+
+
+def test_offline_tolerance():
+    """Unreachable relay: no error surfaces; mutations stay local."""
+    config = Config(sync_url="http://127.0.0.1:9")  # discard port, refuses
+    a = create_evolu(TODO_SCHEMA, config=config)
+    transport = connect(a)
+    try:
+        a.create("todo", {"title": "offline"})
+        a.worker.flush()
+        transport.flush()
+        q = table("todo").select("title").serialize()
+        assert [r["title"] for r in a.query_once(q)] == ["offline"]
+        assert a.get_error() is None
+    finally:
+        a.dispose()
+
+
+def test_int64_and_doc_values_roundtrip_exact():
+    for v in (2**53 + 1, -(2**63), 2**63 - 1, 2**31):
+        data = protocol.encode_content("t", "r", "c", v)
+        out = protocol.decode_content(data)[3]
+        assert out == v and isinstance(out, int)
+    with pytest.raises(TypeError):
+        protocol.encode_content("t", "r", "c", 2**64)
