@@ -1,0 +1,103 @@
+"""Benchmark: CRDT messages merged/sec/chip (BASELINE.json metric).
+
+Measures the device merge pipeline that replaces the reference's
+per-message applyMessages loop (SURVEY.md §2.3): batched LWW planning
+(sort + segmented scans) + per-(owner, minute) Merkle XOR deltas +
+batch digest, on a 1M-message batch spread over 1k owners with cell
+contention (the config-3 shape). Inputs are device-resident columnar
+arrays — the framework's device cell-version-cache design keeps them
+there between batches (SURVEY.md §7, "hard parts" #4).
+
+North star (BASELINE.json): ≥50M msgs/sec on v5e-4 = 12.5M/sec/chip;
+`vs_baseline` reports the fraction of that per-chip target.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import statistics
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+N = 1_000_000
+OWNERS = 1_000
+TARGET_PER_CHIP = 12_500_000.0
+
+
+def build_columns(n=N, owners=OWNERS, seed=7):
+    rng = np.random.default_rng(seed)
+    base = 1_700_000_000_000
+    # ~4 messages/cell contention, clustered minutes (realistic sync bursts).
+    cells = max(n // 4, 1)
+    cell_id = rng.integers(0, cells, n).astype(np.int32)
+    owner_of_cell = rng.integers(0, owners, cells).astype(np.int64)
+    owner_ix = owner_of_cell[cell_id]
+    millis = base + rng.integers(0, 86_400_000, n).astype(np.int64)
+    counter = rng.integers(0, 256, n).astype(np.int32)
+    node = rng.integers(1, 2**63, n).astype(np.uint64)
+    k1 = (millis.astype(np.uint64) << np.uint64(16)) | counter.astype(np.uint64)
+    return {
+        "cell_id": cell_id,
+        "k1": k1,
+        "k2": node,
+        "ex_k1": np.zeros(n, np.uint64),
+        "ex_k2": np.zeros(n, np.uint64),
+        "millis": millis,
+        "counter": counter,
+        "node": node,
+        "owner_ix": owner_ix,
+    }
+
+
+def main():
+    from evolu_tpu.parallel.mesh import create_mesh, sharding
+    from evolu_tpu.parallel.reconcile import _compiled_kernel
+
+    mesh = create_mesh()  # all local devices (1 chip under axon)
+    n_dev = mesh.devices.size
+    cols = build_columns()
+    # Owners must not span shards: remap owner→shard-major layout.
+    order = np.argsort(cols["owner_ix"] % n_dev, kind="stable")
+    cols = {k: v[order] for k, v in cols.items()}
+
+    shd = sharding(mesh)
+    names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "millis", "counter", "node", "owner_ix")
+    args = [jax.device_put(cols[k], shd) for k in names]
+    kernel = _compiled_kernel(mesh)
+
+    jax.block_until_ready(kernel(*args))  # compile + warm
+    times = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        jax.block_until_ready(kernel(*args))
+        times.append(time.perf_counter() - t0)
+    p50 = statistics.median(times)
+    per_chip = N / p50 / n_dev
+    print(
+        json.dumps(
+            {
+                "metric": "crdt_messages_merged_per_sec_per_chip",
+                "value": round(per_chip),
+                "unit": "msgs/sec/chip",
+                "vs_baseline": round(per_chip / TARGET_PER_CHIP, 4),
+                "detail": {
+                    "batch": N,
+                    "owners": OWNERS,
+                    "devices": n_dev,
+                    "p50_ms": round(p50 * 1e3, 3),
+                    "platform": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    main()

@@ -27,7 +27,6 @@ Everything here is shape-static and jit-compiled once per bucket size;
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, List, Sequence, Tuple
 
 import jax
@@ -65,9 +64,10 @@ def _segmented_max_scan(flags, k1, k2):
     return m1, m2
 
 
-@functools.partial(jax.jit, static_argnames=("num_segments",))
-def plan_merge(cell_id, k1, k2, ex_k1, ex_k2, num_segments: int):
-    """The device LWW planner.
+def plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments: int):
+    """The device LWW planner (traceable core — also called inside
+    `shard_map` by `evolu_tpu.parallel.reconcile`, where each shard
+    plans its owners' messages independently).
 
     Args (all shape (N,), padding rows use cell_id=_PAD_CELL, keys 0):
       cell_id: int32 interned (table,row,column) id per message.
@@ -120,6 +120,9 @@ def plan_merge(cell_id, k1, k2, ex_k1, ex_k2, num_segments: int):
     xor_mask = jnp.zeros((n,), bool).at[order].set(xor_sorted & (c != _PAD_CELL))
     upsert_mask = jnp.zeros((n,), bool).at[order].set(upsert_sorted)
     return xor_mask, upsert_mask
+
+
+plan_merge = jax.jit(plan_merge_core, static_argnames=("num_segments",))
 
 
 def _bucket_size(n: int) -> int:

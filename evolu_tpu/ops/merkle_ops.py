@@ -32,34 +32,20 @@ from evolu_tpu.ops import with_x64
 from evolu_tpu.ops.encode import timestamp_hashes
 
 
-@with_x64
-@jax.jit
-def merkle_minute_deltas(millis, counter, node, xor_mask):
-    """Per-minute XOR deltas for a timestamp batch.
+def segment_xor_core(keys_i64, hashes_u32, valid):
+    """Sorted segmented-XOR reduce (traceable core).
 
-    Args (shape (N,)): millis int64, counter int32, node uint64,
-      xor_mask bool (False rows contribute nothing — padding or
-      messages whose hash the merge planner excluded).
-
-    Returns (minutes_sorted int32, seg_end bool, seg_xor uint32,
-    seg_valid bool), all (N,), where positions with seg_end give one
-    (minute, xor-delta, any-contributor) triple per distinct minute.
+    Sort rows by int64 key; per distinct key, XOR the hashes of its
+    rows. Invalid rows must already carry hash 0 and the out-of-range
+    sentinel key. Returns (keys_sorted, seg_end, seg_xor, valid_sorted),
+    all (N,); rows where seg_end is True give one (key, xor) per
+    distinct key.
     """
-    n = millis.shape[0]
-    hashes = jnp.where(xor_mask, timestamp_hashes(millis, counter, node), jnp.uint32(0))
-    # JS `((millis/1000/60) | 0)` — float-divide then truncate to int32.
-    # millis >= 0 so floor == trunc; int32 cast wraps like `|0`.
-    minutes = (millis // 60000).astype(jnp.int32)
-    # Park masked-out rows in a sentinel minute so a minute whose every
-    # row is masked doesn't emit a spurious zero-delta node path. The
-    # sentinel lives outside the int32 range (sort key is int64), so it
-    # can never share a segment with a real (wrapped) minute.
-    minutes = jnp.where(xor_mask, minutes.astype(jnp.int64), jnp.int64(1) << 31)
-
-    order = jnp.argsort(minutes)
-    m_sorted = minutes[order]
-    h_sorted = hashes[order]
-    valid_sorted = xor_mask[order]
+    n = keys_i64.shape[0]
+    order = jnp.argsort(keys_i64)
+    m_sorted = keys_i64[order]
+    h_sorted = hashes_u32[order]
+    valid_sorted = valid[order]
 
     prefix = jax.lax.associative_scan(jnp.bitwise_xor, h_sorted)
     seg_end = jnp.concatenate([m_sorted[1:] != m_sorted[:-1], jnp.ones((1,), bool)])
@@ -72,6 +58,33 @@ def merkle_minute_deltas(millis, counter, node, xor_mask):
     prev_end_prefix = jnp.where(prev_end >= 0, prefix[jnp.maximum(prev_end, 0)], jnp.uint32(0))
     seg_xor = prefix ^ prev_end_prefix
     return m_sorted, seg_end, seg_xor, valid_sorted
+
+
+_SENTINEL_KEY = 1 << 62  # Python int: jnp.int64 at import time (outside x64) truncates
+
+
+def js_minutes(millis):
+    """JS `((millis/1000/60) | 0)` — float-divide then truncate to int32.
+    millis >= 0 so floor == trunc; int32 cast wraps like `|0`."""
+    return (millis // 60000).astype(jnp.int32)
+
+
+def minute_deltas_core(millis, counter, node, xor_mask):
+    """Per-minute XOR deltas for a timestamp batch (traceable core).
+
+    Args (shape (N,)): millis int64, counter int32, node uint64,
+      xor_mask bool (False rows contribute nothing — padding or
+      messages whose hash the merge planner excluded).
+
+    Masked rows park in a sentinel key outside the int32 range so they
+    can never share a segment with a real (wrapped) minute.
+    """
+    hashes = jnp.where(xor_mask, timestamp_hashes(millis, counter, node), jnp.uint32(0))
+    keys = jnp.where(xor_mask, js_minutes(millis).astype(jnp.int64), jnp.int64(_SENTINEL_KEY))
+    return segment_xor_core(keys, hashes, xor_mask)
+
+
+merkle_minute_deltas = with_x64(jax.jit(minute_deltas_core))
 
 
 def minute_deltas_to_dict(m_sorted, seg_end, seg_xor, valid_sorted) -> Dict[str, int]:

@@ -1,0 +1,30 @@
+"""Device-mesh parallelism: owners sharded over ICI.
+
+The reference's "distribution" is a star topology of independent
+replicas (SURVEY.md §1); owners never share state, which makes the
+server-side reconcile embarrassingly parallel over owners. This
+package maps that onto a TPU pod the jax way (SURVEY.md §2.15):
+
+- owners are assigned to mesh shards (balanced by message count);
+- each device plans its owners' LWW merges and Merkle deltas locally
+  (`shard_map` over the `owners` axis — no cross-device traffic in
+  the hot loop);
+- per-owner Merkle roots combine across the mesh with an XOR
+  collective (XOR is associative+commutative, so tree digests reduce
+  exactly; `xor_allreduce`).
+"""
+
+from evolu_tpu.parallel.mesh import create_mesh, assign_owners_to_shards
+from evolu_tpu.parallel.reconcile import (
+    reconcile_columns_sharded,
+    reconcile_owner_batches,
+    xor_allreduce,
+)
+
+__all__ = [
+    "create_mesh",
+    "assign_owners_to_shards",
+    "reconcile_columns_sharded",
+    "reconcile_owner_batches",
+    "xor_allreduce",
+]

@@ -1,0 +1,51 @@
+"""Mesh construction and owner→shard assignment.
+
+Owners are the data-parallel unit (each owner's message log and Merkle
+tree are independent by construction — the relay keys everything by
+userId, apps/server/src/index.ts:64-75), so the mesh has one axis,
+`owners`. Multi-host pods get the same axis laid over all devices; XLA
+routes the XOR-combine collectives over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+OWNERS_AXIS = "owners"
+
+
+def create_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
+    """A 1-D mesh over `n_devices` (default: all available)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (OWNERS_AXIS,))
+
+
+def sharding(mesh: Mesh) -> NamedSharding:
+    """Shard a 1-D array's leading axis over the owners axis."""
+    return NamedSharding(mesh, PartitionSpec(OWNERS_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def assign_owners_to_shards(
+    owner_sizes: Dict[str, int], n_shards: int
+) -> List[List[str]]:
+    """Greedy LPT balance: owners (with their message counts) onto
+    shards, heaviest first — owners never split across shards, so all
+    merge/Merkle work stays device-local."""
+    shards: List[List[str]] = [[] for _ in range(n_shards)]
+    loads = [0] * n_shards
+    for owner in sorted(owner_sizes, key=owner_sizes.get, reverse=True):
+        i = loads.index(min(loads))
+        shards[i].append(owner)
+        loads[i] += owner_sizes[owner]
+    return shards

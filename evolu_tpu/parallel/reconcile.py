@@ -1,0 +1,201 @@
+"""Sharded multi-owner reconcile — the pod-scale merge pass.
+
+Replaces the relay's per-user, per-message loop (reference
+apps/server/src/index.ts:148-159) and the client's per-message
+applyMessages loop with ONE device dispatch for a whole fleet of
+owners: owners are assigned to mesh shards (never split), each device
+plans its owners' LWW merges and per-(owner, minute) Merkle XOR
+deltas locally, and the only cross-device traffic is the final XOR
+all-reduce of the batch digest. XOR is associative and commutative,
+so combining per-shard digests over ICI is exact (SURVEY.md §2.15).
+
+Cell ids are interned per owner then offset by a global base, so a
+flat shard holds many owners yet `plan_merge_core`'s cell segmentation
+keeps them apart. The (owner, minute) segment key packs
+`owner_ix << 33 | (wrapped_minute + 2^31)` into int64; the padding
+sentinel (1 << 62) sorts above every real key.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from evolu_tpu.core.merkle import minutes_base3
+from evolu_tpu.core.murmur import to_int32
+from evolu_tpu.core.timestamp import timestamp_from_string
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.ops import with_x64
+from evolu_tpu.ops.encode import node_hex_to_u64, pack_ts_key_host, timestamp_hashes
+from evolu_tpu.ops.merge import _PAD_CELL, messages_to_columns, plan_merge_core
+from evolu_tpu.ops.merkle_ops import js_minutes, segment_xor_core
+from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, sharding
+
+# Python ints, not jnp constants: module import runs outside the x64
+# scope, where jnp.int64 silently truncates to int32.
+_KEY_SENTINEL = 1 << 62
+_MINUTE_BIAS = 1 << 31
+
+
+def xor_allreduce(x, axis_name: str = OWNERS_AXIS):
+    """XOR-combine a per-shard value across the mesh axis.
+
+    XLA has no XOR collective; all_gather + local XOR-reduce is one
+    ICI round and exact for the associative/commutative XOR monoid.
+    """
+    gathered = jax.lax.all_gather(x, axis_name)
+    return jax.lax.reduce(gathered, jnp.uint32(0), jnp.bitwise_xor, (0,))
+
+
+def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node, owner_ix):
+    """Per-shard reconcile: LWW plan + (owner, minute) XOR deltas +
+    shard digest. All inputs are this shard's local (S,) slices."""
+    n = cell_id.shape[0]
+    xor_mask, upsert_mask = plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments=n)
+    hashes = jnp.where(xor_mask, timestamp_hashes(millis, counter, node), jnp.uint32(0))
+    minute = js_minutes(millis).astype(jnp.int64) + jnp.int64(_MINUTE_BIAS)
+    keys = jnp.where(
+        xor_mask, (owner_ix.astype(jnp.int64) << jnp.int64(33)) | minute, jnp.int64(_KEY_SENTINEL)
+    )
+    keys_sorted, seg_end, seg_xor, valid_sorted = segment_xor_core(keys, hashes, xor_mask)
+    digest = xor_allreduce(jax.lax.reduce(hashes, jnp.uint32(0), jnp.bitwise_xor, (0,)))
+    return xor_mask, upsert_mask, keys_sorted, seg_end, seg_xor, valid_sorted, digest
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_kernel(mesh: Mesh):
+    spec = P(OWNERS_AXIS)
+    mapped = shard_map(
+        _shard_kernel,
+        mesh=mesh,
+        in_specs=(spec,) * 9,
+        out_specs=(spec, spec, spec, spec, spec, spec, P()),
+        check_rep=False,
+    )
+    return jax.jit(mapped)
+
+
+@with_x64
+def reconcile_columns_sharded(mesh: Mesh, cols: Dict[str, np.ndarray]):
+    """Run the sharded kernel on flat global columns (length D*S, owner
+    blocks laid out shard-contiguously). Returns device arrays:
+    (xor_mask, upsert_mask, keys_sorted, seg_end, seg_xor, seg_valid,
+    digest)."""
+    shd = sharding(mesh)
+    args = [
+        jax.device_put(cols[k], shd)
+        for k in ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "millis", "counter", "node", "owner_ix")
+    ]
+    return _compiled_kernel(mesh)(*args)
+
+
+def _bucket(n: int, multiple: int) -> int:
+    size = multiple
+    while size < n:
+        size *= 2
+    return size
+
+
+def build_owner_columns(
+    mesh: Mesh,
+    owner_batches: Dict[str, Sequence[CrdtMessage]],
+    existing_winners: Dict[str, Dict[Tuple[str, str, str], str]],
+):
+    """Host-side layout: per-owner columnarization → shard assignment →
+    flat padded global columns + bookkeeping to scatter results back.
+
+    Returns (cols, index) where index maps owner → (global_positions
+    array aligned with that owner's message order, owner_ix).
+    """
+    n_shards = mesh.devices.size
+    owners = list(owner_batches)
+    owner_ix = {o: i for i, o in enumerate(owners)}
+    per_owner = {}
+    cell_base = 0
+    for o in owners:
+        msgs = owner_batches[o]
+        cols = messages_to_columns(msgs, existing_winners.get(o, {}))
+        cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node = cols
+        cell_ids = cell_ids + cell_base
+        cell_base += len(msgs)  # intern ids are < len(msgs)
+        per_owner[o] = (cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node)
+
+    shards = assign_owners_to_shards({o: len(owner_batches[o]) for o in owners}, n_shards)
+    shard_len = max((sum(len(owner_batches[o]) for o in s) for s in shards), default=0)
+    shard_size = _bucket(max(shard_len, 1), 64)
+
+    total = n_shards * shard_size
+    out = {
+        "cell_id": np.full(total, int(_PAD_CELL), np.int32),
+        "k1": np.zeros(total, np.uint64),
+        "k2": np.zeros(total, np.uint64),
+        "ex_k1": np.zeros(total, np.uint64),
+        "ex_k2": np.zeros(total, np.uint64),
+        "millis": np.zeros(total, np.int64),
+        "counter": np.zeros(total, np.int32),
+        "node": np.zeros(total, np.uint64),
+        "owner_ix": np.zeros(total, np.int64),
+    }
+    index: Dict[str, Tuple[np.ndarray, int]] = {}
+    for si, shard in enumerate(shards):
+        pos = si * shard_size
+        for o in shard:
+            cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node = per_owner[o]
+            n = len(cell_ids)
+            sl = slice(pos, pos + n)
+            out["cell_id"][sl] = cell_ids
+            out["k1"][sl], out["k2"][sl] = k1, k2
+            out["ex_k1"][sl], out["ex_k2"][sl] = ex_k1, ex_k2
+            out["millis"][sl], out["counter"][sl], out["node"][sl] = millis, counter, node
+            out["owner_ix"][sl] = owner_ix[o]
+            index[o] = (np.arange(pos, pos + n), owner_ix[o])
+            pos += n
+    return out, index
+
+
+def reconcile_owner_batches(
+    mesh: Mesh,
+    owner_batches: Dict[str, Sequence[CrdtMessage]],
+    existing_winners: Dict[str, Dict[Tuple[str, str, str], str]],
+):
+    """Full multi-owner reconcile: one device dispatch for all owners.
+
+    Returns ({owner: (xor_mask, upserts, minute_deltas)}, digest) with
+    the same per-owner contract as the single-owner planner
+    (`storage.apply.plan_batch` + the host Merkle delta pass), so the
+    caller can apply results to per-owner SQLite stores / trees.
+    """
+    if not owner_batches:
+        return {}, 0
+    cols, index = build_owner_columns(mesh, owner_batches, existing_winners)
+    xor_mask, upsert_mask, keys_sorted, seg_end, seg_xor, seg_valid, digest = (
+        reconcile_columns_sharded(mesh, cols)
+    )
+    xor_mask = np.asarray(xor_mask)
+    upsert_mask = np.asarray(upsert_mask)
+    keys_sorted = np.asarray(keys_sorted)
+    ends = np.asarray(seg_end) & np.asarray(seg_valid)
+    seg_xor = np.asarray(seg_xor)
+
+    # Decode (owner, minute) deltas.
+    deltas_by_ix: Dict[int, Dict[str, int]] = {}
+    for i in np.nonzero(ends)[0]:
+        key = int(keys_sorted[i])
+        o_ix, minute = key >> 33, (key & ((1 << 33) - 1)) - (1 << 31)
+        deltas_by_ix.setdefault(o_ix, {})[minutes_base3(minute * 60000)] = to_int32(
+            int(seg_xor[i])
+        )
+
+    results = {}
+    for owner, (positions, o_ix) in index.items():
+        messages = owner_batches[owner]
+        o_xor = [bool(xor_mask[p]) for p in positions]
+        upserts = [m for j, m in enumerate(messages) if upsert_mask[positions[j]]]
+        results[owner] = (o_xor, upserts, deltas_by_ix.get(o_ix, {}))
+    return results, int(digest)

@@ -102,8 +102,11 @@ class Evolu:
                     self._subscribed[query] = n
                 if listener is not None and listener in self._listeners:
                     self._listeners.remove(listener)
-            if evict:
-                self.worker.post(msg.EvictQueries((query,)))
+                if evict:
+                    # Posted under the lock: a concurrent re-subscribe
+                    # cannot enqueue its initial Query ahead of this
+                    # eviction (which would then wipe a live cache entry).
+                    self.worker.post(msg.EvictQueries((query,)))
 
         return unsubscribe
 

@@ -175,7 +175,14 @@ class DbWorker:
             return
         self.queries_rows_cache.update(self._staged_cache)
         for effect in self._staged_effects:
-            effect()
+            try:
+                effect()
+            except Exception as e:  # noqa: BLE001 - listener raised: must
+                # not kill the worker thread (the command already committed)
+                try:
+                    self.on_output(msg.OnError(e))
+                except Exception:  # noqa: BLE001,S110 - error channel itself broken
+                    pass
 
     # -- commands --
 

@@ -7,10 +7,11 @@ pipeline (index.ts:204-216), same own-message exclusion
 limit (index.ts:222), `GET /ping` health check (index.ts:250-252).
 The server is E2EE-blind: rows are (timestamp, userId, ciphertext).
 
-Unlike the reference's per-message insert loop (index.ts:148-159), the
-store exposes `add_messages` as one executemany + a Merkle delta pass,
-and `RelayStore.reconcile_batch` lets the TPU engine feed many owners
-in one call.
+`add_messages` keeps the reference's per-row insert (it needs per-row
+rowcount for the changes==1 Merkle gate) but aggregates tree updates
+into one delta pass; the batched many-owner path lives in
+`evolu_tpu.server.engine.BatchReconciler`, which set-diffs in bulk SQL
+and hashes on device.
 """
 
 from __future__ import annotations

@@ -113,6 +113,11 @@ class SyncTransport:
             return
         try:
             response_bytes = self._http_post(self.config.sync_url, body)
+        except urllib.error.HTTPError as e:
+            # The server answered: that's a real error (4xx/5xx), not
+            # offline — surface it so divergence isn't silent.
+            self.on_error(UnknownError(e))
+            return
         except (urllib.error.URLError, OSError):
             return  # offline is not an error (sync.worker.ts:217-227)
         try:

@@ -186,9 +186,11 @@ def decrypt_symmetric(message: bytes, password: str) -> bytes:
         if hash_algo != HASH_SHA256:
             raise PgpError(f"unsupported S2K hash {hash_algo}")
         key = _s2k_iterated_salted(password.encode("utf-8"), salt, count_byte, 32)
-    elif s2k_type == 1:
+    elif s2k_type == 1:  # salted: ONE hash of salt‖password (RFC 4880 §3.7.1.2)
         salt = skesk[4:12]
-        key = _s2k_iterated_salted(password.encode("utf-8"), salt, 0, 32)
+        key = hashlib.sha256(salt + password.encode("utf-8")).digest()
+    elif s2k_type == 0:  # simple: hash of the password alone (§3.7.1.1)
+        key = hashlib.sha256(password.encode("utf-8")).digest()
     else:
         raise PgpError(f"unsupported S2K type {s2k_type}")
 
