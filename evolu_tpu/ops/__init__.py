@@ -36,3 +36,13 @@ def with_x64(fn):
             return fn(*args, **kwargs)
 
     return wrapper
+
+
+def bucket_size(n: int, multiple: int = 64) -> int:
+    """Power-of-two batch bucket ≥ max(n, multiple). One policy for
+    every host→device batch (SURVEY.md §7 "dynamic shapes": pad to
+    pow2 buckets so jit compiles once per bucket, not per batch)."""
+    size = multiple
+    while size < n:
+        size *= 2
+    return size

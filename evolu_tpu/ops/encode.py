@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from evolu_tpu.ops import with_x64
-from evolu_tpu.ops.hash import murmur3_32_batch
+from evolu_tpu.ops.hash import murmur3_32_batch, murmur3_32_bytes
 
 TIMESTAMP_STRING_LENGTH = 46
 
@@ -42,22 +42,72 @@ def _civil_from_days(days):
     return y, m, d
 
 
-_ZERO = jnp.uint8(ord("0"))
-_UPPER_A = jnp.uint8(ord("A") - 10)
-_LOWER_A = jnp.uint8(ord("a") - 10)
+_ZERO = jnp.uint32(ord("0"))
+_UPPER_A = jnp.uint32(ord("A") - 10)
+_LOWER_A = jnp.uint32(ord("a") - 10)
 
 
 def _digits(x, n: int):
-    """x → list of n ASCII decimal digit arrays, most significant first."""
+    """x (uint32) → list of n ASCII decimal digit uint32 arrays, most
+    significant first."""
     out = []
     for i in range(n - 1, -1, -1):
-        out.append((x // (10**i) % 10).astype(jnp.uint8) + _ZERO)
+        out.append((x // jnp.uint32(10**i)) % jnp.uint32(10) + _ZERO)
     return out
 
 
 def _hex_nibble(x, upper: bool):
-    x = x.astype(jnp.uint8)
+    x = x.astype(jnp.uint32)
     return jnp.where(x < 10, x + _ZERO, x + (_UPPER_A if upper else _LOWER_A))
+
+
+def _timestamp_bytes_u32(millis, counter, node):
+    """The 46 canonical-string bytes as a list of 46 uint32 arrays
+    (`YYYY-MM-DDTHH:mm:ss.sssZ-CCCC-n*16`, timestamp.ts:43-48).
+
+    Only two int64 divmods touch the raw millis; everything after is
+    uint32 so XLA keeps the whole computation in one fused elementwise
+    pass (no 64-bit emulation in the digit/hex extraction).
+    """
+    millis = jnp.asarray(millis, jnp.int64)
+    counter = jnp.asarray(counter, jnp.int32)
+    node = jnp.asarray(node, jnp.uint64)
+    ms = (millis % 1000).astype(jnp.uint32)
+    secs = millis // 1000
+    days = (secs // 86400).astype(jnp.int32)
+    sod = (secs % 86400).astype(jnp.uint32)
+    hh, mm, ss = sod // 3600, (sod // 60) % 60, sod % 60
+    y, mo, d = _civil_from_days(days)
+    y, mo, d = y.astype(jnp.uint32), mo.astype(jnp.uint32), d.astype(jnp.uint32)
+
+    cols = []
+    cols += _digits(y, 4)
+    dash = jnp.full_like(cols[0], ord("-"))
+    cols.append(dash)
+    cols += _digits(mo, 2)
+    cols.append(dash)
+    cols += _digits(d, 2)
+    cols.append(jnp.full_like(cols[0], ord("T")))
+    cols += _digits(hh, 2)
+    colon = jnp.full_like(cols[0], ord(":"))
+    cols.append(colon)
+    cols += _digits(mm, 2)
+    cols.append(colon)
+    cols += _digits(ss, 2)
+    cols.append(jnp.full_like(cols[0], ord(".")))
+    cols += _digits(ms, 3)
+    cols.append(jnp.full_like(cols[0], ord("Z")))
+    cols.append(dash)
+    c32 = counter.astype(jnp.uint32)
+    for shift in (12, 8, 4, 0):
+        cols.append(_hex_nibble((c32 >> shift) & 0xF, upper=True))
+    cols.append(dash)
+    n_hi = (node >> jnp.uint64(32)).astype(jnp.uint32)
+    n_lo = node.astype(jnp.uint32)
+    for half in (n_hi, n_lo):
+        for shift in (28, 24, 20, 16, 12, 8, 4, 0):
+            cols.append(_hex_nibble((half >> shift) & 0xF, upper=False))
+    return cols
 
 
 @with_x64
@@ -69,47 +119,19 @@ def render_timestamp_strings(millis, counter, node) -> jnp.ndarray:
     reference encoding (timestamp.ts:43-48) whose byte order the LWW
     comparisons rely on.
     """
-    millis = jnp.asarray(millis, jnp.int64)
-    counter = jnp.asarray(counter, jnp.int32)
-    node = jnp.asarray(node, jnp.uint64)
-    ms = millis % 1000
-    secs = millis // 1000
-    days = secs // 86400
-    sod = secs % 86400
-    hh, mm, ss = sod // 3600, (sod // 60) % 60, sod % 60
-    y, mo, d = _civil_from_days(days)
-
-    cols = []
-    cols += _digits(y, 4)
-    cols.append(jnp.full_like(cols[0], ord("-")))
-    cols += _digits(mo, 2)
-    cols.append(jnp.full_like(cols[0], ord("-")))
-    cols += _digits(d, 2)
-    cols.append(jnp.full_like(cols[0], ord("T")))
-    cols += _digits(hh, 2)
-    cols.append(jnp.full_like(cols[0], ord(":")))
-    cols += _digits(mm, 2)
-    cols.append(jnp.full_like(cols[0], ord(":")))
-    cols += _digits(ss, 2)
-    cols.append(jnp.full_like(cols[0], ord(".")))
-    cols += _digits(ms, 3)
-    cols.append(jnp.full_like(cols[0], ord("Z")))
-    cols.append(jnp.full_like(cols[0], ord("-")))
-    c32 = counter.astype(jnp.uint32)
-    for shift in (12, 8, 4, 0):
-        cols.append(_hex_nibble((c32 >> shift) & 0xF, upper=True))
-    cols.append(jnp.full_like(cols[0], ord("-")))
-    n64 = node.astype(jnp.uint64)
-    for shift in range(60, -4, -4):
-        cols.append(_hex_nibble((n64 >> jnp.uint64(shift)) & jnp.uint64(0xF), upper=False))
+    cols = [c.astype(jnp.uint8) for c in _timestamp_bytes_u32(millis, counter, node)]
     return jnp.stack(cols, axis=1)
 
 
 @with_x64
 def timestamp_hashes(millis, counter, node) -> jnp.ndarray:
     """Batched `timestampToHash` (timestamp.ts:87-88): murmur3-32 of the
-    canonical string, computed fully on device. → (N,) uint32."""
-    return murmur3_32_batch(render_timestamp_strings(millis, counter, node))
+    canonical string, computed fully on device — the string bytes stay
+    as fused register columns, never materialized as an (N, 46) matrix.
+    → (N,) uint32."""
+    return murmur3_32_bytes(
+        _timestamp_bytes_u32(millis, counter, node), TIMESTAMP_STRING_LENGTH
+    )
 
 
 @with_x64

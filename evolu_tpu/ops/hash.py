@@ -29,24 +29,26 @@ def _mix_k(k):
     return k * _C2
 
 
-def murmur3_32_batch(data: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
-    """murmur3-32 of each row of a (N, L) uint8 array → (N,) uint32.
+def murmur3_32_bytes(bytes_u32, length: int, seed: int = 0) -> jnp.ndarray:
+    """murmur3-32 over `length` bytes given as a list of `length` uint32
+    arrays (one array per byte position, values 0..255) → (N,) uint32.
 
-    L is static; rows are full strings (no per-row lengths — the CRDT
-    only hashes canonical 46-char timestamp strings).
+    Keeping the bytes as separate register-resident columns (instead of
+    a materialized (N, L) uint8 matrix) lets XLA fuse the whole hash
+    into one elementwise kernel — no lane-padded byte matrix in HBM,
+    no strided column gathers.
     """
-    n_rows, length = data.shape
-    data = data.astype(jnp.uint32)
-    h = jnp.full((n_rows,), seed, dtype=jnp.uint32)
+    assert len(bytes_u32) == length
+    h = jnp.full_like(bytes_u32[0], seed)
 
     n_blocks = length // 4
     for i in range(n_blocks):
         b = i * 4
         k = (
-            data[:, b]
-            | (data[:, b + 1] << jnp.uint32(8))
-            | (data[:, b + 2] << jnp.uint32(16))
-            | (data[:, b + 3] << jnp.uint32(24))
+            bytes_u32[b]
+            | (bytes_u32[b + 1] << jnp.uint32(8))
+            | (bytes_u32[b + 2] << jnp.uint32(16))
+            | (bytes_u32[b + 3] << jnp.uint32(24))
         )
         h = h ^ _mix_k(k)
         h = _rotl(h, 13)
@@ -54,13 +56,13 @@ def murmur3_32_batch(data: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
 
     tail = length & 3
     if tail:
-        k = jnp.zeros((n_rows,), dtype=jnp.uint32)
+        k = jnp.zeros_like(h)
         base = n_blocks * 4
         if tail >= 3:
-            k = k ^ (data[:, base + 2] << jnp.uint32(16))
+            k = k ^ (bytes_u32[base + 2] << jnp.uint32(16))
         if tail >= 2:
-            k = k ^ (data[:, base + 1] << jnp.uint32(8))
-        k = k ^ data[:, base]
+            k = k ^ (bytes_u32[base + 1] << jnp.uint32(8))
+        k = k ^ bytes_u32[base]
         h = h ^ _mix_k(k)
 
     h = h ^ jnp.uint32(length)
@@ -70,3 +72,14 @@ def murmur3_32_batch(data: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
     h = h * jnp.uint32(0xC2B2AE35)
     h = h ^ (h >> jnp.uint32(16))
     return h
+
+
+def murmur3_32_batch(data: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """murmur3-32 of each row of a (N, L) uint8 array → (N,) uint32.
+
+    L is static; rows are full strings (no per-row lengths — the CRDT
+    only hashes canonical 46-char timestamp strings).
+    """
+    _, length = data.shape
+    data = data.astype(jnp.uint32)
+    return murmur3_32_bytes([data[:, i] for i in range(length)], length, seed)

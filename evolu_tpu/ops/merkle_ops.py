@@ -32,41 +32,75 @@ from evolu_tpu.ops import with_x64
 from evolu_tpu.ops.encode import timestamp_hashes
 
 
-def segment_xor_core(keys_i64, hashes_u32, valid):
-    """Sorted segmented-XOR reduce (traceable core).
+_SENTINEL_HI = 0x7FFFFFFF  # int32 max: masked rows sort after every real key
 
-    Sort rows by int64 key; per distinct key, XOR the hashes of its
-    rows. Invalid rows must already carry hash 0 and the out-of-range
-    sentinel key. Returns (keys_sorted, seg_end, seg_xor, valid_sorted),
-    all (N,); rows where seg_end is True give one (key, xor) per
-    distinct key.
+
+def segment_xor2_core(hi_i32, lo_i32, hashes_u32, valid):
+    """Sorted segmented-XOR reduce over an (hi, lo) int32 key pair
+    (traceable core).
+
+    Sort rows lexicographically by (hi, lo) — 32-bit keys, so the TPU
+    sort never touches emulated 64-bit compares — carrying the hash and
+    valid payloads through the sort (no post-sort gathers). Per
+    distinct key pair, XOR the hashes of its rows. Masked rows must
+    carry hash 0 and hi = _SENTINEL_HI. Returns (hi_sorted, lo_sorted,
+    seg_end, seg_xor, valid_sorted), all (N,); rows where seg_end is
+    True give one (key, xor) per distinct key.
     """
-    n = keys_i64.shape[0]
-    order = jnp.argsort(keys_i64)
-    m_sorted = keys_i64[order]
-    h_sorted = hashes_u32[order]
-    valid_sorted = valid[order]
+    n = hi_i32.shape[0]
+    hi_s, lo_s, h_sorted, valid_sorted = jax.lax.sort(
+        (hi_i32, lo_i32, hashes_u32, valid), num_keys=2
+    )
 
     prefix = jax.lax.associative_scan(jnp.bitwise_xor, h_sorted)
-    seg_end = jnp.concatenate([m_sorted[1:] != m_sorted[:-1], jnp.ones((1,), bool)])
+    seg_end = jnp.concatenate(
+        [(hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1]), jnp.ones((1,), bool)]
+    )
     # XOR of a segment = prefix at its end ^ prefix at the previous
     # segment's end. Propagate "index of previous segment end" forward
     # with a running max (-1 = no previous segment).
-    idx = jnp.arange(n)
+    idx = jnp.arange(n, dtype=jnp.int32)
     seg_first = jnp.concatenate([jnp.zeros((1,), bool), seg_end[:-1]])
     prev_end = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_first, idx - 1, -1))
     prev_end_prefix = jnp.where(prev_end >= 0, prefix[jnp.maximum(prev_end, 0)], jnp.uint32(0))
     seg_xor = prefix ^ prev_end_prefix
-    return m_sorted, seg_end, seg_xor, valid_sorted
-
-
-_SENTINEL_KEY = 1 << 62  # Python int: jnp.int64 at import time (outside x64) truncates
+    return hi_s, lo_s, seg_end, seg_xor, valid_sorted
 
 
 def js_minutes(millis):
     """JS `((millis/1000/60) | 0)` — float-divide then truncate to int32.
     millis >= 0 so floor == trunc; int32 cast wraps like `|0`."""
     return (millis // 60000).astype(jnp.int32)
+
+
+def owner_minute_segments(owner_ix, millis, hashes_u32, valid):
+    """Segmented XOR over (owner, minute) as an int32 key pair — owner
+    in the hi key (sentinel int32-max for masked rows), JS-wrapped
+    minute in the lo key — keeping the sort fully 32-bit. Shared by the
+    client reconcile kernel and the server Merkle kernel.
+
+    Returns (owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted).
+    """
+    hi = jnp.where(valid, owner_ix.astype(jnp.int32), jnp.int32(_SENTINEL_HI))
+    lo = jnp.where(valid, js_minutes(millis), jnp.int32(0))
+    return segment_xor2_core(hi, lo, hashes_u32, valid)
+
+
+def decode_owner_minute_deltas(
+    owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted
+) -> Dict[int, Dict[str, int]]:
+    """Host side: `owner_minute_segments` outputs → {owner_ix:
+    {base3-minute-key: signed-int32 delta}} consumable by
+    `core.merkle.apply_prefix_xors`."""
+    owner_sorted = np.asarray(owner_sorted)
+    minute_sorted = np.asarray(minute_sorted)
+    ends = np.asarray(seg_end) & np.asarray(valid_sorted)
+    xs = np.asarray(seg_xor)
+    out: Dict[int, Dict[str, int]] = {}
+    for i in np.nonzero(ends)[0]:
+        o_ix, minute = int(owner_sorted[i]), int(minute_sorted[i])
+        out.setdefault(o_ix, {})[minutes_base3(minute * 60000)] = to_int32(int(xs[i]))
+    return out
 
 
 def minute_deltas_core(millis, counter, node, xor_mask):
@@ -76,12 +110,14 @@ def minute_deltas_core(millis, counter, node, xor_mask):
       xor_mask bool (False rows contribute nothing — padding or
       messages whose hash the merge planner excluded).
 
-    Masked rows park in a sentinel key outside the int32 range so they
-    can never share a segment with a real (wrapped) minute.
+    Masked rows park under the hi-key sentinel so they sort after (and
+    never share a segment with) any real (wrapped) minute.
     """
     hashes = jnp.where(xor_mask, timestamp_hashes(millis, counter, node), jnp.uint32(0))
-    keys = jnp.where(xor_mask, js_minutes(millis).astype(jnp.int64), jnp.int64(_SENTINEL_KEY))
-    return segment_xor_core(keys, hashes, xor_mask)
+    hi = jnp.where(xor_mask, jnp.int32(0), jnp.int32(_SENTINEL_HI))
+    lo = jnp.where(xor_mask, js_minutes(millis), jnp.int32(0))
+    _, lo_s, seg_end, seg_xor, valid_sorted = segment_xor2_core(hi, lo, hashes, xor_mask)
+    return lo_s.astype(jnp.int64), seg_end, seg_xor, valid_sorted
 
 
 merkle_minute_deltas = with_x64(jax.jit(minute_deltas_core))

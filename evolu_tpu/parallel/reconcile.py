@@ -11,36 +11,30 @@ so combining per-shard digests over ICI is exact (SURVEY.md §2.15).
 
 Cell ids are interned per owner then offset by a global base, so a
 flat shard holds many owners yet `plan_merge_core`'s cell segmentation
-keeps them apart. The (owner, minute) segment key packs
-`owner_ix << 33 | (wrapped_minute + 2^31)` into int64; the padding
-sentinel (1 << 62) sorts above every real key.
+keeps them apart. The (owner, minute) segment key is an int32 pair
+(owner in the hi key, JS-wrapped minute in the lo key; masked rows
+park under the int32-max hi sentinel) so the segmented sort stays
+fully 32-bit.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
 
-from evolu_tpu.core.merkle import minutes_base3
-from evolu_tpu.core.murmur import to_int32
-from evolu_tpu.core.timestamp import timestamp_from_string
 from evolu_tpu.core.types import CrdtMessage
-from evolu_tpu.ops import with_x64
-from evolu_tpu.ops.encode import node_hex_to_u64, pack_ts_key_host, timestamp_hashes
+from evolu_tpu.ops import bucket_size, with_x64
+from evolu_tpu.ops.encode import timestamp_hashes
 from evolu_tpu.ops.merge import _PAD_CELL, messages_to_columns, plan_merge_core
-from evolu_tpu.ops.merkle_ops import js_minutes, segment_xor_core
+from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, sharding
 
-# Python ints, not jnp constants: module import runs outside the x64
-# scope, where jnp.int64 silently truncates to int32.
-_KEY_SENTINEL = 1 << 62
-_MINUTE_BIAS = 1 << 31
 
 
 def xor_allreduce(x, axis_name: str = OWNERS_AXIS):
@@ -55,17 +49,19 @@ def xor_allreduce(x, axis_name: str = OWNERS_AXIS):
 
 def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node, owner_ix):
     """Per-shard reconcile: LWW plan + (owner, minute) XOR deltas +
-    shard digest. All inputs are this shard's local (S,) slices."""
+    shard digest. All inputs are this shard's local (S,) slices.
+
+    The (owner, minute) segment key is an int32 pair — owner in the hi
+    key (sentinel int32-max for masked rows), JS-wrapped minute in the
+    lo key — so the segmented XOR sort stays fully 32-bit."""
     n = cell_id.shape[0]
     xor_mask, upsert_mask = plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments=n)
     hashes = jnp.where(xor_mask, timestamp_hashes(millis, counter, node), jnp.uint32(0))
-    minute = js_minutes(millis).astype(jnp.int64) + jnp.int64(_MINUTE_BIAS)
-    keys = jnp.where(
-        xor_mask, (owner_ix.astype(jnp.int64) << jnp.int64(33)) | minute, jnp.int64(_KEY_SENTINEL)
+    owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted = owner_minute_segments(
+        owner_ix, millis, hashes, xor_mask
     )
-    keys_sorted, seg_end, seg_xor, valid_sorted = segment_xor_core(keys, hashes, xor_mask)
     digest = xor_allreduce(jax.lax.reduce(hashes, jnp.uint32(0), jnp.bitwise_xor, (0,)))
-    return xor_mask, upsert_mask, keys_sorted, seg_end, seg_xor, valid_sorted, digest
+    return xor_mask, upsert_mask, owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, digest
 
 
 @functools.lru_cache(maxsize=None)
@@ -75,8 +71,8 @@ def _compiled_kernel(mesh: Mesh):
         _shard_kernel,
         mesh=mesh,
         in_specs=(spec,) * 9,
-        out_specs=(spec, spec, spec, spec, spec, spec, P()),
-        check_rep=False,
+        out_specs=(spec, spec, spec, spec, spec, spec, spec, P()),
+        check_vma=False,
     )
     return jax.jit(mapped)
 
@@ -85,21 +81,14 @@ def _compiled_kernel(mesh: Mesh):
 def reconcile_columns_sharded(mesh: Mesh, cols: Dict[str, np.ndarray]):
     """Run the sharded kernel on flat global columns (length D*S, owner
     blocks laid out shard-contiguously). Returns device arrays:
-    (xor_mask, upsert_mask, keys_sorted, seg_end, seg_xor, seg_valid,
-    digest)."""
+    (xor_mask, upsert_mask, owner_sorted, minute_sorted, seg_end,
+    seg_xor, seg_valid, digest)."""
     shd = sharding(mesh)
     args = [
         jax.device_put(cols[k], shd)
         for k in ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "millis", "counter", "node", "owner_ix")
     ]
     return _compiled_kernel(mesh)(*args)
-
-
-def _bucket(n: int, multiple: int) -> int:
-    size = multiple
-    while size < n:
-        size *= 2
-    return size
 
 
 def build_owner_columns(
@@ -128,7 +117,7 @@ def build_owner_columns(
 
     shards = assign_owners_to_shards({o: len(owner_batches[o]) for o in owners}, n_shards)
     shard_len = max((sum(len(owner_batches[o]) for o in s) for s in shards), default=0)
-    shard_size = _bucket(max(shard_len, 1), 64)
+    shard_size = bucket_size(max(shard_len, 1))
 
     total = n_shards * shard_size
     out = {
@@ -174,23 +163,14 @@ def reconcile_owner_batches(
     if not owner_batches:
         return {}, 0
     cols, index = build_owner_columns(mesh, owner_batches, existing_winners)
-    xor_mask, upsert_mask, keys_sorted, seg_end, seg_xor, seg_valid, digest = (
+    xor_mask, upsert_mask, owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid, digest = (
         reconcile_columns_sharded(mesh, cols)
     )
     xor_mask = np.asarray(xor_mask)
     upsert_mask = np.asarray(upsert_mask)
-    keys_sorted = np.asarray(keys_sorted)
-    ends = np.asarray(seg_end) & np.asarray(seg_valid)
-    seg_xor = np.asarray(seg_xor)
-
-    # Decode (owner, minute) deltas.
-    deltas_by_ix: Dict[int, Dict[str, int]] = {}
-    for i in np.nonzero(ends)[0]:
-        key = int(keys_sorted[i])
-        o_ix, minute = key >> 33, (key & ((1 << 33) - 1)) - (1 << 31)
-        deltas_by_ix.setdefault(o_ix, {})[minutes_base3(minute * 60000)] = to_int32(
-            int(seg_xor[i])
-        )
+    deltas_by_ix = decode_owner_minute_deltas(
+        owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid
+    )
 
     results = {}
     for owner, (positions, o_ix) in index.items():

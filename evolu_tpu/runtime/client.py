@@ -20,7 +20,7 @@ import datetime
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Set
 
-from evolu_tpu.api.model import sqlite_value
+from evolu_tpu.api.model import COMMON_COLUMNS, sqlite_value
 from evolu_tpu.core.ids import create_id
 from evolu_tpu.core.types import NewCrdtMessage, Owner, TableDefinition
 from evolu_tpu.runtime import messages as msg
@@ -75,14 +75,37 @@ class Evolu:
 
     def update_db_schema(self, schema: Dict[str, Sequence[str]]) -> None:
         """createHooks.ts:26 → updateDbSchema command. `schema` maps table
-        name → app columns (id + common columns are implicit)."""
-        tds = tuple(TableDefinition.of(name, cols) for name, cols in schema.items())
+        name → app columns; `id` and the common columns (createdAt,
+        createdBy, updatedAt, isDeleted) are appended here, mirroring
+        dbSchemaToTableDefinitions (db.ts:210-221)."""
+        tds = tuple(
+            TableDefinition.of(
+                name,
+                tuple(c for c in cols if c != "id")
+                + tuple(c for c in COMMON_COLUMNS if c not in cols),
+            )
+            for name, cols in schema.items()
+        )
         self.worker.post(msg.UpdateDbSchema(tds))
 
     # -- reactive queries --
 
-    def subscribe_query(self, query: str, listener: Optional[Callable[[], None]] = None):
-        """Subscribe a SqlQueryString; returns unsubscribe (db.ts:241-266)."""
+    @staticmethod
+    def _normalize_query(query) -> str:
+        """Accept a QueryBuilder, raw SQL, or an already-serialized
+        SqlQueryString; always key caches/subscriptions by the
+        serialized form (types.ts:115-124)."""
+        serialize = getattr(query, "serialize", None)
+        if callable(serialize):
+            return serialize()
+        s = str(query)
+        if s.lstrip().startswith("{"):
+            return s
+        return msg.serialize_query(s)
+
+    def subscribe_query(self, query, listener: Optional[Callable[[], None]] = None):
+        """Subscribe a query; returns unsubscribe (db.ts:241-266)."""
+        query = self._normalize_query(query)
         with self._lock:
             fresh = query not in self._subscribed
             self._subscribed[query] = self._subscribed.get(query, 0) + 1
@@ -122,13 +145,14 @@ class Evolu:
 
         return unlisten
 
-    def get_query_rows(self, query: str) -> List[dict]:
+    def get_query_rows(self, query) -> List[dict]:
         """Current rows for a subscribed query (db.ts:231-234). Row objects
         are identity-stable across unrelated updates."""
+        query = self._normalize_query(query)
         with self._lock:
             return self._rows_cache.get(query, [])
 
-    def query_once(self, query: str) -> List[dict]:
+    def query_once(self, query) -> List[dict]:
         """One-shot read-through (no subscription): runs on the worker
         thread to respect the single-writer discipline."""
         unsubscribe = self.subscribe_query(query)

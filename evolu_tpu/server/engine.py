@@ -8,8 +8,8 @@ across 1k owners), and:
 1. set-diffs incoming timestamps against storage in bulk SQL (the
    INSERT OR IGNORE dedup, batched via a temp-table join);
 2. hashes every new timestamp and reduces per-(owner, minute) XOR
-   deltas on device (`segment_xor_core` over owner∥minute keys,
-   sharded over the mesh — owners never split);
+   deltas on device (`owner_minute_segments` over int32 owner/minute
+   key pairs, sharded over the mesh — owners never split);
 3. applies the deltas to each owner's sparse tree, persists, and
    answers each request with the standard diff response.
 
@@ -26,27 +26,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
-from evolu_tpu.core.merkle import apply_prefix_xors, merkle_tree_to_string, minutes_base3
-from evolu_tpu.core.murmur import to_int32
+from evolu_tpu.core.merkle import apply_prefix_xors, merkle_tree_to_string
 from evolu_tpu.core.timestamp import timestamp_from_string
-from evolu_tpu.ops import with_x64
+from evolu_tpu.ops import bucket_size, with_x64
 from evolu_tpu.ops.encode import node_hex_to_u64, timestamp_hashes
-from evolu_tpu.ops.merkle_ops import js_minutes, segment_xor_core
+from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, create_mesh, sharding
-from evolu_tpu.parallel.reconcile import _KEY_SENTINEL, _MINUTE_BIAS, xor_allreduce
+from evolu_tpu.parallel.reconcile import xor_allreduce
 from evolu_tpu.server.relay import RelayStore
 from evolu_tpu.sync import protocol
 
 
 def _merkle_shard_kernel(millis, counter, node, valid, owner_ix):
+    """Per-shard (owner, minute) XOR deltas + allreduced batch digest
+    (`owner_minute_segments` is shared with the client reconcile
+    kernel, parallel/reconcile.py)."""
     hashes = jnp.where(valid, timestamp_hashes(millis, counter, node), jnp.uint32(0))
-    minute = js_minutes(millis).astype(jnp.int64) + jnp.int64(_MINUTE_BIAS)
-    keys = jnp.where(
-        valid, (owner_ix.astype(jnp.int64) << jnp.int64(33)) | minute, jnp.int64(_KEY_SENTINEL)
-    )
-    out = segment_xor_core(keys, hashes, valid)
+    out = owner_minute_segments(owner_ix, millis, hashes, valid)
     digest = xor_allreduce(jax.lax.reduce(hashes, jnp.uint32(0), jnp.bitwise_xor, (0,)))
     return (*out, digest)
 
@@ -59,17 +57,10 @@ def _compiled_merkle_kernel(mesh: Mesh):
             _merkle_shard_kernel,
             mesh=mesh,
             in_specs=(spec,) * 5,
-            out_specs=(spec, spec, spec, spec, P()),
-            check_rep=False,
+            out_specs=(spec, spec, spec, spec, spec, P()),
+            check_vma=False,
         )
     )
-
-
-def _bucket(n: int, multiple: int = 64) -> int:
-    size = multiple
-    while size < n:
-        size *= 2
-    return size
 
 
 @with_x64
@@ -82,7 +73,7 @@ def owner_minute_deltas(
     owner_ix = {o: i for i, o in enumerate(owners)}
     shards = assign_owners_to_shards({o: len(owner_rows[o]) for o in owners}, mesh.devices.size)
     shard_len = max((sum(len(owner_rows[o]) for o in s) for s in shards), default=0)
-    shard_size = _bucket(max(shard_len, 1))
+    shard_size = bucket_size(max(shard_len, 1))
     total = mesh.devices.size * shard_size
 
     millis = np.zeros(total, np.int64)
@@ -103,16 +94,14 @@ def owner_minute_deltas(
 
     shd = sharding(mesh)
     args = [jax.device_put(a, shd) for a in (millis, counter, node, valid, oix)]
-    keys_sorted, seg_end, seg_xor, valid_sorted, digest = _compiled_merkle_kernel(mesh)(*args)
+    owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, digest = (
+        _compiled_merkle_kernel(mesh)(*args)
+    )
 
-    keys_sorted = np.asarray(keys_sorted)
-    ends = np.asarray(seg_end) & np.asarray(valid_sorted)
-    xs = np.asarray(seg_xor)
+    by_ix = decode_owner_minute_deltas(owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted)
     deltas: Dict[str, Dict[str, int]] = {o: {} for o in owners}
-    for i in np.nonzero(ends)[0]:
-        key = int(keys_sorted[i])
-        o_ix, minute = key >> 33, (key & ((1 << 33) - 1)) - (1 << 31)
-        deltas[owners[o_ix]][minutes_base3(minute * 60000)] = to_int32(int(xs[i]))
+    for o_ix, d in by_ix.items():
+        deltas[owners[o_ix]] = d
     return deltas, int(digest)
 
 

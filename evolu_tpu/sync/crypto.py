@@ -187,9 +187,13 @@ def decrypt_symmetric(message: bytes, password: str) -> bytes:
             raise PgpError(f"unsupported S2K hash {hash_algo}")
         key = _s2k_iterated_salted(password.encode("utf-8"), salt, count_byte, 32)
     elif s2k_type == 1:  # salted: ONE hash of salt‖password (RFC 4880 §3.7.1.2)
+        if skesk[3] != HASH_SHA256:
+            raise PgpError(f"unsupported S2K hash {skesk[3]}")
         salt = skesk[4:12]
         key = hashlib.sha256(salt + password.encode("utf-8")).digest()
     elif s2k_type == 0:  # simple: hash of the password alone (§3.7.1.1)
+        if skesk[3] != HASH_SHA256:
+            raise PgpError(f"unsupported S2K hash {skesk[3]}")
         key = hashlib.sha256(password.encode("utf-8")).digest()
     else:
         raise PgpError(f"unsupported S2K type {s2k_type}")

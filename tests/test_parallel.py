@@ -80,7 +80,6 @@ def test_sharded_reconcile_matches_host_oracle():
                 k = minutes_base3(ts.millis)
                 exp_deltas[k] = to_int32(exp_deltas.get(k, 0) ^ timestamp_to_hash(ts))
                 expected_digest ^= timestamp_to_hash(ts) & 0xFFFFFFFF
-        exp_deltas = {k: v for k, v in exp_deltas.items() if True}
         assert deltas == exp_deltas, owner
     assert digest == expected_digest
 

@@ -350,3 +350,41 @@ def test_unsubscribe_evicts_caches():
 def test_offset_without_limit_compiles():
     sql, params = table("todo").offset(3).compile()
     assert "LIMIT -1 OFFSET ?" in sql and params == [3]
+
+
+def test_schema_without_common_columns_gets_them_appended():
+    # Regression: the client must append id/common columns to the DDL the
+    # way dbSchemaToTableDefinitions does (db.ts:210-221) — an app schema
+    # lists only its own columns.
+    evolu = create_evolu({"todo": ("title",)})
+    try:
+        rid = evolu.create("todo", {"title": "x"})
+        evolu.worker.flush()
+        rows = evolu.query_once('SELECT "id","title","createdAt","createdBy" FROM "todo"')
+        assert rows and rows[0]["id"] == rid and rows[0]["createdAt"]
+        evolu.update("todo", rid, {"isDeleted": True})
+        evolu.worker.flush()
+        rows = evolu.query_once('SELECT "isDeleted","updatedAt" FROM "todo"')
+        assert rows[0]["isDeleted"] == 1 and rows[0]["updatedAt"]
+    finally:
+        evolu.dispose()
+
+
+def test_queries_accept_raw_sql_and_builders():
+    # Regression: subscribe/query_once/get_query_rows accept raw SQL and
+    # QueryBuilder objects, not just pre-serialized SqlQueryStrings, and
+    # all three key the same cache entry.
+    evolu = make_client()
+    try:
+        evolu.create("todo", {"title": "x"})
+        evolu.worker.flush()
+        raw = 'SELECT "title" FROM "todo"'
+        assert [r["title"] for r in evolu.query_once(raw)] == ["x"]
+        builder = table("todo").select("title")
+        assert [r["title"] for r in evolu.query_once(builder)] == ["x"]
+        unsub = evolu.subscribe_query(raw)
+        evolu.worker.flush()
+        assert evolu.get_query_rows(raw) == evolu.get_query_rows(builder.serialize())
+        unsub()
+    finally:
+        evolu.dispose()

@@ -268,3 +268,16 @@ def test_s2k_salted_and_simple_types():
     enc = crypto._aes_cfb(key).encryptor()
     seipd = crypto._new_packet(18, b"\x01" + enc.update(body + b"\xd3\x14" + mdc) + enc.finalize())
     assert crypto.decrypt_symmetric(skesk + seipd, "pw") == pt
+
+    # Type 0 (simple): key = sha256(password), no salt in the SKESK.
+    key0 = hashlib.sha256(b"pw").digest()
+    skesk0 = crypto._new_packet(3, bytes([4, crypto.SYM_AES256, 0, crypto.HASH_SHA256]))
+    enc0 = crypto._aes_cfb(key0).encryptor()
+    seipd0 = crypto._new_packet(18, b"\x01" + enc0.update(body + b"\xd3\x14" + mdc) + enc0.finalize())
+    assert crypto.decrypt_symmetric(skesk0 + seipd0, "pw") == pt
+
+    # Both branches reject a non-SHA256 hash algorithm declaration.
+    import pytest as _pytest
+    bad = crypto._new_packet(3, bytes([4, crypto.SYM_AES256, 1, 2]) + salt)  # SHA-1
+    with _pytest.raises(crypto.PgpError, match="S2K hash"):
+        crypto.decrypt_symmetric(bad + seipd, "pw")
