@@ -26,7 +26,7 @@ from evolu_tpu.core.types import NewCrdtMessage, Owner, TableDefinition
 from evolu_tpu.runtime import messages as msg
 from evolu_tpu.runtime.jsonpatch import apply_patch
 from evolu_tpu.runtime.worker import DbWorker
-from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.storage.native import open_database
 from evolu_tpu.utils.config import Config
 
 
@@ -46,9 +46,12 @@ class Evolu:
         config: Optional[Config] = None,
         mnemonic: Optional[str] = None,
         now_iso: Callable[[], str] = _now_iso,
+        backend: str = "auto",
     ):
         self.config = config or Config()
-        self.db = PySqliteDatabase(db_path)
+        # "auto" = the C++ SQLite host layer when buildable (SURVEY.md
+        # §2.14), else the stdlib backend — identical end state either way.
+        self.db = open_database(db_path, backend)
         self._now_iso = now_iso
         self._lock = threading.RLock()
         self._rows_cache: Dict[str, List[dict]] = {}  # queriesRowsCacheRef (db.ts:55)

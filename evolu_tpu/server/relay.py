@@ -36,7 +36,7 @@ from evolu_tpu.core.timestamp import (
     timestamp_to_hash,
     timestamp_to_string,
 )
-from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.storage.native import open_database
 from evolu_tpu.sync import protocol
 
 MAX_BODY_BYTES = 20 * 1024 * 1024  # index.ts:222
@@ -45,8 +45,8 @@ MAX_BODY_BYTES = 20 * 1024 * 1024  # index.ts:222
 class RelayStore:
     """Message + Merkle storage for many users (index.ts:60-105)."""
 
-    def __init__(self, path: str = ":memory:"):
-        self.db = PySqliteDatabase(path)
+    def __init__(self, path: str = ":memory:", backend: str = "auto"):
+        self.db = open_database(path, backend)
         self.db.exec(
             'CREATE TABLE IF NOT EXISTS "message" ('
             '"timestamp" TEXT, "userId" TEXT, "content" BLOB, '
@@ -76,13 +76,23 @@ class RelayStore:
         with self.db.transaction():
             tree = self.get_merkle_tree(user_id)
             deltas: Dict[str, int] = {}
-            for m in messages:
-                inserted = self.db.run(
-                    'INSERT OR IGNORE INTO "message" ("timestamp", "userId", "content") '
-                    "VALUES (?, ?, ?)",
-                    (m.timestamp, user_id, m.content),
+            if hasattr(self.db, "relay_insert"):
+                # C++ backend: bulk insert with per-row was-new flags.
+                new_flags = self.db.relay_insert(
+                    [(m.timestamp, user_id, m.content) for m in messages]
                 )
-                if inserted == 1:
+            else:
+                new_flags = [
+                    self.db.run(
+                        'INSERT OR IGNORE INTO "message" ("timestamp", "userId", "content") '
+                        "VALUES (?, ?, ?)",
+                        (m.timestamp, user_id, m.content),
+                    )
+                    == 1
+                    for m in messages
+                ]
+            for m, was_new in zip(messages, new_flags):
+                if was_new:
                     t = timestamp_from_string(m.timestamp)
                     key = minutes_base3(t.millis)
                     deltas[key] = to_int32(deltas.get(key, 0) ^ timestamp_to_hash(t))

@@ -52,7 +52,19 @@ def _upsert_sql(table: str, column: str) -> str:
 def apply_messages_sequential(
     db: PySqliteDatabase, merkle_tree: dict, messages: Sequence[CrdtMessage]
 ) -> dict:
-    """The reference loop, message by message. O(n) SQL round trips."""
+    """The reference loop, message by message.
+
+    On the C++ backend the whole loop (winner check, upsert, insert)
+    runs as one native call returning the XOR mask; on the Python
+    backend it is O(n) SQL round trips."""
+    if hasattr(db, "apply_sequential"):
+        xor_mask = db.apply_sequential(messages)
+        for m, flagged in zip(messages, xor_mask):
+            if flagged:
+                merkle_tree = insert_into_merkle_tree(
+                    timestamp_from_string(m.timestamp), merkle_tree
+                )
+        return merkle_tree
     for m in messages:
         rows = db.exec_sql_query(_SELECT_WINNER, (m.table, m.row, m.column))
         t = rows[0]["timestamp"] if rows else None
@@ -75,6 +87,10 @@ def fetch_existing_winners(
     cells = list(cells)
     if not cells:
         return {}
+    if hasattr(db, "fetch_winners"):
+        # C++ backend: per-cell indexed lookups in one native call.
+        winners = db.fetch_winners(cells)
+        return {c: w for c, w in zip(cells, winners) if w is not None}
     with db.transaction():
         db.exec('CREATE TEMP TABLE IF NOT EXISTS "__cells" ("t" BLOB, "r" BLOB, "c" BLOB)')
         db.run('DELETE FROM "__cells"')
@@ -152,15 +168,27 @@ def apply_messages(
                 key = minutes_base3(ts.millis)
                 deltas[key] = to_int32(deltas.get(key, 0) ^ timestamp_to_hash(ts))
 
-        # App tables: only the final winner per cell touches the row.
-        for m in upserts:
-            db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
+        if hasattr(db, "apply_planned"):
+            # C++ backend: upserts + bulk __message insert in one call.
+            # The mask is keyed by cell+timestamp, not object identity, so
+            # planners may rebuild message objects. A duplicate timestamp
+            # flags both copies — the second upsert is an identical
+            # idempotent statement, so the end state is unchanged.
+            winner_keys = {(m.table, m.row, m.column, m.timestamp) for m in upserts}
+            db.apply_planned(
+                messages,
+                [(m.table, m.row, m.column, m.timestamp) in winner_keys for m in messages],
+            )
+        else:
+            # App tables: only the final winner per cell touches the row.
+            for m in upserts:
+                db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
 
-        # __message: bulk insert, PK dedup handles duplicates.
-        db.run_many(
-            _INSERT_MESSAGE,
-            [(m.timestamp, m.table, m.row, m.column, m.value) for m in messages],
-        )
+            # __message: bulk insert, PK dedup handles duplicates.
+            db.run_many(
+                _INSERT_MESSAGE,
+                [(m.timestamp, m.table, m.row, m.column, m.value) for m in messages],
+            )
 
     # One sparse-tree pass (pure, cannot fail after commit).
     return apply_prefix_xors(merkle_tree, deltas)

@@ -1,0 +1,414 @@
+"""ctypes binding for the C++ SQLite host layer (native/evolu_host.cpp).
+
+`CppSqliteDatabase` implements the same backend boundary as
+`PySqliteDatabase` (the reference's `Database` interface,
+types.ts:162-176) over our C++ library, which drives the real SQLite C
+API directly. The merge hot path — the reference's per-message
+applyMessages loop — runs as ONE C call per batch
+(`apply_sequential` / `apply_planned`), with winner lookups, app-table
+upserts and `__message` inserts all inside C++ (SURVEY.md §2.14, §7
+step 3).
+
+The library is built on demand with `make` (g++ + libsqlite3.so.0 are
+baked into the image); if the build is impossible the loader returns
+None and callers fall back to the Python backend — behavior, end
+state, and error surface are identical either way (property-tested in
+tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from contextlib import contextmanager
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from evolu_tpu.core.types import UnknownError
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libevolu_host.so")
+
+_SQLITE_ROW = 100
+_SQLITE_DONE = 101
+
+# column types
+_T_INT, _T_FLOAT, _T_TEXT, _T_BLOB, _T_NULL = 1, 2, 3, 4, 5
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_failed = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    p, i, i64, d, s, u8p, i32p, i64p, dp = (
+        c.c_void_p, c.c_int, c.c_int64, c.c_double, c.c_char_p,
+        c.POINTER(c.c_uint8), c.POINTER(c.c_int32), c.POINTER(c.c_int64),
+        c.POINTER(c.c_double),
+    )
+    sp = c.POINTER(s)
+    lib.eh_open.restype = p
+    lib.eh_open.argtypes = [s]
+    lib.eh_close.argtypes = [p]
+    lib.eh_errmsg.restype = s
+    lib.eh_errmsg.argtypes = [p]
+    lib.eh_exec.argtypes = [p, s]
+    lib.eh_changes.argtypes = [p]
+    lib.eh_total_changes.argtypes = [p]
+    lib.eh_prepare.restype = p
+    lib.eh_prepare.argtypes = [p, s]
+    lib.eh_finalize.argtypes = [p]
+    lib.eh_step.argtypes = [p]
+    lib.eh_reset.argtypes = [p]
+    lib.eh_bind.argtypes = [p, i, i, i64, d, s, i]
+    lib.eh_column_count.argtypes = [p]
+    lib.eh_column_name.restype = s
+    lib.eh_column_name.argtypes = [p, i]
+    lib.eh_column_type.argtypes = [p, i]
+    lib.eh_column_int64.restype = i64
+    lib.eh_column_int64.argtypes = [p, i]
+    lib.eh_column_double.restype = d
+    lib.eh_column_double.argtypes = [p, i]
+    lib.eh_column_text.restype = p  # read via column_bytes + string_at (NUL-safe)
+    lib.eh_column_text.argtypes = [p, i]
+    lib.eh_column_blob.restype = p
+    lib.eh_column_blob.argtypes = [p, i]
+    lib.eh_column_bytes.argtypes = [p, i]
+    lib.eh_fetch_winners.argtypes = [p, i64, sp, sp, sp, c.c_char_p, i64]
+    lib.eh_apply_sequential.argtypes = [p, i64, sp, sp, sp, sp, i32p, i64p, dp, sp, i32p, u8p]
+    lib.eh_apply_planned.argtypes = [p, i64, sp, sp, sp, sp, i32p, i64p, dp, sp, i32p, u8p]
+    lib.eh_relay_insert.argtypes = [p, i64, sp, sp, sp, i32p, u8p]
+    return lib
+
+
+def load_library() -> Optional[ctypes.CDLL]:
+    """The shared library, building it on first use; None if unavailable."""
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                    capture_output=True, timeout=120,
+                )
+            except Exception:
+                _lib_failed = True
+                return None
+        try:
+            _lib = _configure(ctypes.CDLL(_LIB_PATH))
+        except OSError:
+            _lib_failed = True
+            return None
+        return _lib
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def _encode_value(v) -> Tuple[int, int, float, Optional[bytes], int]:
+    """Python value → (kind, int64, double, bytes, blob_len)."""
+    if v is None:
+        return 0, 0, 0.0, None, 0
+    if isinstance(v, bool):
+        return 1, int(v), 0.0, None, 0
+    if isinstance(v, int):
+        return 1, v, 0.0, None, 0
+    if isinstance(v, float):
+        return 2, 0, v, None, 0
+    if isinstance(v, bytes):
+        return 4, 0, 0.0, v, len(v)
+    enc = str(v).encode("utf-8")
+    return 3, 0, 0.0, enc, len(enc)
+
+
+def _columnar_values(values) -> Tuple:
+    n = len(values)
+    kinds = (ctypes.c_int32 * n)()
+    ivals = (ctypes.c_int64 * n)()
+    dvals = (ctypes.c_double * n)()
+    svals = (ctypes.c_char_p * n)()
+    blens = (ctypes.c_int32 * n)()
+    for j, v in enumerate(values):
+        k, iv, dv, sv, bl = _encode_value(v)
+        kinds[j], ivals[j], dvals[j], svals[j], blens[j] = k, iv, dv, sv, bl
+    return kinds, ivals, dvals, svals, blens
+
+
+def _str_array(items: Sequence[str]):
+    arr = (ctypes.c_char_p * len(items))()
+    for j, x in enumerate(items):
+        arr[j] = x.encode("utf-8") if isinstance(x, str) else x
+    return arr
+
+
+class CppSqliteDatabase:
+    """Single-writer SQLite handle over the C++ host layer.
+
+    Drop-in for `PySqliteDatabase`: exec / exec_script / exec_sql_query /
+    run / run_many / changes / transaction / close, plus the batched
+    native hot paths (`apply_sequential`, `apply_planned`,
+    `fetch_winners`, `relay_insert`).
+    """
+
+    def __init__(self, path: str = ":memory:"):
+        lib = load_library()
+        if lib is None:
+            raise UnknownError("native host library unavailable")
+        self._lib = lib
+        self._db = lib.eh_open(path.encode("utf-8"))
+        if not self._db:
+            raise UnknownError(f"cannot open database {path!r}")
+        self._lock = threading.RLock()
+        self._in_txn = False
+        self.path = path
+
+    # -- internals --
+
+    def _err(self) -> UnknownError:
+        msg = self._lib.eh_errmsg(self._db)
+        return UnknownError(msg.decode("utf-8", "replace") if msg else "sqlite error")
+
+    def _read_row(self, st) -> Tuple:
+        lib = self._lib
+        ncol = lib.eh_column_count(st)
+        out = []
+        for i in range(ncol):
+            t = lib.eh_column_type(st, i)
+            if t == _T_INT:
+                out.append(lib.eh_column_int64(st, i))
+            elif t == _T_FLOAT:
+                out.append(lib.eh_column_double(st, i))
+            elif t == _T_TEXT:
+                nb = lib.eh_column_bytes(st, i)
+                ptr = lib.eh_column_text(st, i)
+                out.append(ctypes.string_at(ptr, nb).decode("utf-8") if ptr else "")
+            elif t == _T_BLOB:
+                nb = lib.eh_column_bytes(st, i)
+                ptr = lib.eh_column_blob(st, i)
+                out.append(ctypes.string_at(ptr, nb) if ptr else b"")
+            else:
+                out.append(None)
+        return tuple(out)
+
+    def _execute(self, sql: str, parameters: Sequence = ()) -> Tuple[List[Tuple], List[str]]:
+        lib = self._lib
+        st = lib.eh_prepare(self._db, sql.encode("utf-8"))
+        if not st:
+            raise self._err()
+        try:
+            for j, v in enumerate(parameters):
+                k, iv, dv, sv, bl = _encode_value(v)
+                if lib.eh_bind(st, j + 1, k, iv, dv, sv, bl) != 0:
+                    raise self._err()
+            cols: List[str] = []
+            rows: List[Tuple] = []
+            first = True
+            while True:
+                rc = lib.eh_step(st)
+                if rc == _SQLITE_ROW:
+                    if first:
+                        cols = [
+                            (lib.eh_column_name(st, i) or b"").decode("utf-8")
+                            for i in range(lib.eh_column_count(st))
+                        ]
+                        first = False
+                    rows.append(self._read_row(st))
+                elif rc == _SQLITE_DONE:
+                    if first:
+                        cols = [
+                            (lib.eh_column_name(st, i) or b"").decode("utf-8")
+                            for i in range(lib.eh_column_count(st))
+                        ]
+                    break
+                else:
+                    raise self._err()
+            return rows, cols
+        finally:
+            lib.eh_finalize(st)
+
+    # -- Database interface (types.ts:162-176) --
+
+    def exec(self, sql: str) -> List[Tuple]:
+        with self._lock:
+            rows, _ = self._execute(sql)
+            return rows
+
+    def exec_script(self, sql: str) -> None:
+        with self._lock:
+            if self._in_txn:
+                raise UnknownError("exec_script inside an open transaction")
+            if self._lib.eh_exec(self._db, sql.encode("utf-8")) != 0:
+                raise self._err()
+
+    def exec_sql_query(self, sql: str, parameters: Sequence = ()) -> List[dict]:
+        with self._lock:
+            rows, cols = self._execute(sql, parameters)
+            return [dict(zip(cols, r)) for r in rows]
+
+    def run(self, sql: str, parameters: Sequence = ()) -> int:
+        with self._lock:
+            before = self._lib.eh_total_changes(self._db)
+            self._execute(sql, parameters)
+            return self._lib.eh_total_changes(self._db) - before
+
+    def run_many(self, sql: str, rows: Iterable[Sequence]) -> int:
+        lib = self._lib
+        with self._lock:
+            st = lib.eh_prepare(self._db, sql.encode("utf-8"))
+            if not st:
+                raise self._err()
+            before = lib.eh_total_changes(self._db)
+            try:
+                for row in rows:
+                    for j, v in enumerate(row):
+                        k, iv, dv, sv, bl = _encode_value(v)
+                        if lib.eh_bind(st, j + 1, k, iv, dv, sv, bl) != 0:
+                            raise self._err()
+                    rc = lib.eh_step(st)
+                    if rc not in (_SQLITE_DONE, _SQLITE_ROW):
+                        raise self._err()
+                    lib.eh_reset(st)
+            finally:
+                lib.eh_finalize(st)
+            return lib.eh_total_changes(self._db) - before
+
+    def changes(self) -> int:
+        with self._lock:
+            return self._lib.eh_total_changes(self._db)
+
+    @contextmanager
+    def transaction(self):
+        with self._lock:
+            if self._in_txn:
+                yield self
+                return
+            if self._lib.eh_exec(self._db, b"BEGIN") != 0:
+                raise self._err()
+            self._in_txn = True
+            try:
+                yield self
+            except BaseException:
+                self._lib.eh_exec(self._db, b"ROLLBACK")
+                raise
+            else:
+                if self._lib.eh_exec(self._db, b"COMMIT") != 0:
+                    raise self._err()
+            finally:
+                self._in_txn = False
+
+    def close(self) -> None:
+        with self._lock:
+            if self._db:
+                self._lib.eh_close(self._db)
+                self._db = None
+
+    # -- native hot paths --
+
+    def fetch_winners(
+        self, cells: Sequence[Tuple[str, str, str]]
+    ) -> List[Optional[str]]:
+        """Winner timestamp per cell (None = no stored winner)."""
+        n = len(cells)
+        if n == 0:
+            return []
+        cap = 64
+        out = ctypes.create_string_buffer(n * cap)
+        with self._lock:
+            rc = self._lib.eh_fetch_winners(
+                self._db, n,
+                _str_array([c[0] for c in cells]),
+                _str_array([c[1] for c in cells]),
+                _str_array([c[2] for c in cells]),
+                out, cap,
+            )
+        if rc != 0:
+            raise self._err()
+        res: List[Optional[str]] = []
+        for i in range(n):
+            raw = out.raw[i * cap : (i + 1) * cap].split(b"\0", 1)[0]
+            res.append(raw.decode("utf-8") if raw else None)
+        return res
+
+    def apply_sequential(self, messages) -> List[bool]:
+        """applyMessages.ts:78-124 for a whole batch in one C call;
+        returns the per-message Merkle-XOR mask. Caller manages the
+        transaction."""
+        n = len(messages)
+        if n == 0:
+            return []
+        kinds, ivals, dvals, svals, blens = _columnar_values([m.value for m in messages])
+        out = (ctypes.c_uint8 * n)()
+        with self._lock:
+            rc = self._lib.eh_apply_sequential(
+                self._db, n,
+                _str_array([m.timestamp for m in messages]),
+                _str_array([m.table for m in messages]),
+                _str_array([m.row for m in messages]),
+                _str_array([m.column for m in messages]),
+                kinds, ivals, dvals, svals, blens, out,
+            )
+        if rc != 0:
+            raise self._err()
+        return [bool(x) for x in out]
+
+    def apply_planned(self, messages, upsert_mask: Sequence[bool]) -> None:
+        """Apply a planner-computed upsert mask + bulk __message insert
+        in one C call. Caller manages the transaction."""
+        n = len(messages)
+        if n == 0:
+            return
+        kinds, ivals, dvals, svals, blens = _columnar_values([m.value for m in messages])
+        mask = (ctypes.c_uint8 * n)(*[1 if b else 0 for b in upsert_mask])
+        with self._lock:
+            rc = self._lib.eh_apply_planned(
+                self._db, n,
+                _str_array([m.timestamp for m in messages]),
+                _str_array([m.table for m in messages]),
+                _str_array([m.row for m in messages]),
+                _str_array([m.column for m in messages]),
+                kinds, ivals, dvals, svals, blens, mask,
+            )
+        if rc != 0:
+            raise self._err()
+
+    def relay_insert(self, rows: Sequence[Tuple[str, str, bytes]]) -> List[bool]:
+        """Bulk INSERT OR IGNORE into the relay's message table; returns
+        per-row was-new flags (index.ts:148-159 changes()==1 semantics)."""
+        n = len(rows)
+        if n == 0:
+            return []
+        contents = (ctypes.c_char_p * n)()
+        lens = (ctypes.c_int32 * n)()
+        for j, (_, _, content) in enumerate(rows):
+            contents[j] = content
+            lens[j] = len(content)
+        out = (ctypes.c_uint8 * n)()
+        with self._lock:
+            rc = self._lib.eh_relay_insert(
+                self._db, n,
+                _str_array([r[0] for r in rows]),
+                _str_array([r[1] for r in rows]),
+                contents, lens, out,
+            )
+        if rc != 0:
+            raise self._err()
+        return [bool(x) for x in out]
+
+
+def open_database(path: str = ":memory:", backend: str = "auto"):
+    """Open the storage backend: "native" (C++ layer), "python"
+    (stdlib sqlite3), or "auto" (native when buildable)."""
+    from evolu_tpu.storage.sqlite import PySqliteDatabase
+
+    if backend == "python":
+        return PySqliteDatabase(path)
+    if backend == "native":
+        return CppSqliteDatabase(path)
+    if native_available():
+        return CppSqliteDatabase(path)
+    return PySqliteDatabase(path)

@@ -1,0 +1,353 @@
+// evolu_host — C++ SQLite host layer for the TPU framework.
+//
+// The reference's only native code is SQLite itself (vendored twice:
+// wa-sqlite in the browser, better-sqlite3 on the server — SURVEY.md
+// §2.14). This library plays the same role for the Python runtime: the
+// storage engine is the real SQLite C library driven from C++, and the
+// merge hot path — the reference's per-message applyMessages loop
+// (packages/evolu/src/applyMessages.ts:26-131) — runs entirely inside
+// one C call per batch, with prepared-statement caching like the
+// reference's per-SQL cache (applyMessages.ts:46-73).
+//
+// The image ships libsqlite3.so.0 but no sqlite3.h, so the handful of
+// C-API entry points used here are declared directly; the SQLite C ABI
+// is stable and these signatures match https://sqlite.org/c3ref.
+//
+// Exported surface (C ABI, driven from Python via ctypes):
+//   eh_open/eh_close/eh_errmsg/eh_exec/eh_changes/eh_total_changes
+//   eh_prepare/eh_finalize/eh_bind_*/eh_step/eh_reset/eh_column_*
+//   eh_fetch_winners   — batched per-cell winner lookup
+//   eh_apply_sequential — the reference loop (winner check + app-table
+//                         upsert + __message insert), masks out
+//   eh_apply_planned   — apply a device-computed plan (upsert mask)
+//
+// Value passing: each message value arrives as (kind, int64, double,
+// text, blob_len) where kind ∈ {0:null, 1:int64, 2:double, 3:text,
+// 4:blob} — no string round-trip for numerics, preserving SQLite
+// storage classes byte-for-byte vs the Python backend.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// --- SQLite C ABI (subset) ---
+typedef struct sqlite3 sqlite3;
+typedef struct sqlite3_stmt sqlite3_stmt;
+typedef int64_t sqlite3_int64;
+
+int sqlite3_open_v2(const char *filename, sqlite3 **db, int flags, const char *vfs);
+int sqlite3_close_v2(sqlite3 *);
+int sqlite3_exec(sqlite3 *, const char *sql, int (*cb)(void *, int, char **, char **),
+                 void *, char **errmsg);
+void sqlite3_free(void *);
+int sqlite3_prepare_v2(sqlite3 *, const char *sql, int nbyte, sqlite3_stmt **, const char **tail);
+int sqlite3_finalize(sqlite3_stmt *);
+int sqlite3_step(sqlite3_stmt *);
+int sqlite3_reset(sqlite3_stmt *);
+int sqlite3_clear_bindings(sqlite3_stmt *);
+int sqlite3_bind_null(sqlite3_stmt *, int);
+int sqlite3_bind_int64(sqlite3_stmt *, int, sqlite3_int64);
+int sqlite3_bind_double(sqlite3_stmt *, int, double);
+int sqlite3_bind_text(sqlite3_stmt *, int, const char *, int n, void (*)(void *));
+int sqlite3_bind_blob(sqlite3_stmt *, int, const void *, int n, void (*)(void *));
+int sqlite3_column_count(sqlite3_stmt *);
+const char *sqlite3_column_name(sqlite3_stmt *, int);
+int sqlite3_column_type(sqlite3_stmt *, int);
+sqlite3_int64 sqlite3_column_int64(sqlite3_stmt *, int);
+double sqlite3_column_double(sqlite3_stmt *, int);
+const unsigned char *sqlite3_column_text(sqlite3_stmt *, int);
+const void *sqlite3_column_blob(sqlite3_stmt *, int);
+int sqlite3_column_bytes(sqlite3_stmt *, int);
+int sqlite3_changes(sqlite3 *);
+int sqlite3_total_changes(sqlite3 *);
+const char *sqlite3_errmsg(sqlite3 *);
+
+}  // extern "C"
+
+#define SQLITE_OK 0
+#define SQLITE_ROW 100
+#define SQLITE_DONE 101
+#define SQLITE_OPEN_READWRITE 0x00000002
+#define SQLITE_OPEN_CREATE 0x00000004
+#define SQLITE_OPEN_URI 0x00000040
+#define SQLITE_TRANSIENT ((void (*)(void *))(intptr_t)-1)
+
+namespace {
+
+// Bind one (kind, int, real, text/blob bytes, byte_len) value at `pos`.
+// TEXT uses the explicit byte length too — values may contain NUL
+// bytes, which must round-trip identically to the Python backend.
+int bind_value(sqlite3_stmt *st, int pos, int kind, int64_t iv, double dv,
+               const char *sv, int byte_len) {
+  switch (kind) {
+    case 1: return sqlite3_bind_int64(st, pos, iv);
+    case 2: return sqlite3_bind_double(st, pos, dv);
+    case 3: return sqlite3_bind_text(st, pos, sv, byte_len, SQLITE_TRANSIENT);
+    case 4: return sqlite3_bind_blob(st, pos, sv, byte_len, SQLITE_TRANSIENT);
+    default: return sqlite3_bind_null(st, pos);
+  }
+}
+
+// Per-batch prepared-statement cache keyed by SQL — the reference's
+// cacheGet/cacheRelease (applyMessages.ts:46-73), scoped to one call.
+struct StmtCache {
+  sqlite3 *db;
+  std::map<std::string, sqlite3_stmt *> cache;
+  explicit StmtCache(sqlite3 *d) : db(d) {}
+  ~StmtCache() {
+    for (auto &kv : cache) sqlite3_finalize(kv.second);
+  }
+  sqlite3_stmt *get(const std::string &sql) {
+    auto it = cache.find(sql);
+    if (it != cache.end()) return it->second;
+    sqlite3_stmt *st = nullptr;
+    if (sqlite3_prepare_v2(db, sql.c_str(), -1, &st, nullptr) != SQLITE_OK) return nullptr;
+    cache.emplace(sql, st);
+    return st;
+  }
+};
+
+std::string quote_ident(const char *name) {
+  // "name" with embedded quotes doubled (identifiers come from the
+  // app schema; quoting matches the Python backend's _upsert_sql).
+  std::string out = "\"";
+  for (const char *p = name; *p; ++p) {
+    out += *p;
+    if (*p == '"') out += '"';
+  }
+  out += '"';
+  return out;
+}
+
+std::string upsert_sql(const char *table, const char *column) {
+  // applyMessages.ts:92-103
+  std::string t = quote_ident(table), c = quote_ident(column);
+  return "INSERT INTO " + t + " (\"id\", " + c + ") VALUES (?, ?) "
+         "ON CONFLICT DO UPDATE SET " + c + " = ?";
+}
+
+constexpr const char *kSelectWinner =
+    "SELECT \"timestamp\" FROM \"__message\" "
+    "WHERE \"table\" = ? AND \"row\" = ? AND \"column\" = ? "
+    "ORDER BY \"timestamp\" DESC LIMIT 1";
+
+constexpr const char *kInsertMessage =
+    "INSERT INTO \"__message\" (\"timestamp\", \"table\", \"row\", \"column\", \"value\") "
+    "VALUES (?, ?, ?, ?, ?) ON CONFLICT DO NOTHING";
+
+int step_done(sqlite3_stmt *st) {
+  int rc = sqlite3_step(st);
+  sqlite3_reset(st);
+  sqlite3_clear_bindings(st);
+  return rc == SQLITE_DONE || rc == SQLITE_ROW ? SQLITE_OK : rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+sqlite3 *eh_open(const char *path) {
+  sqlite3 *db = nullptr;
+  int flags = SQLITE_OPEN_READWRITE | SQLITE_OPEN_CREATE | SQLITE_OPEN_URI;
+  if (sqlite3_open_v2(path, &db, flags, nullptr) != SQLITE_OK) {
+    if (db) sqlite3_close_v2(db);
+    return nullptr;
+  }
+  return db;
+}
+
+int eh_close(sqlite3 *db) { return sqlite3_close_v2(db); }
+
+const char *eh_errmsg(sqlite3 *db) { return sqlite3_errmsg(db); }
+
+int eh_exec(sqlite3 *db, const char *sql) {
+  return sqlite3_exec(db, sql, nullptr, nullptr, nullptr);
+}
+
+int eh_changes(sqlite3 *db) { return sqlite3_changes(db); }
+int eh_total_changes(sqlite3 *db) { return sqlite3_total_changes(db); }
+
+// --- generic prepared-statement surface (cold paths, driven from Python) ---
+
+sqlite3_stmt *eh_prepare(sqlite3 *db, const char *sql) {
+  sqlite3_stmt *st = nullptr;
+  if (sqlite3_prepare_v2(db, sql, -1, &st, nullptr) != SQLITE_OK) return nullptr;
+  return st;
+}
+
+int eh_finalize(sqlite3_stmt *st) { return sqlite3_finalize(st); }
+int eh_step(sqlite3_stmt *st) { return sqlite3_step(st); }
+int eh_reset(sqlite3_stmt *st) {
+  int rc = sqlite3_reset(st);
+  sqlite3_clear_bindings(st);
+  return rc;
+}
+
+int eh_bind(sqlite3_stmt *st, int pos, int kind, int64_t iv, double dv,
+            const char *sv, int blob_len) {
+  return bind_value(st, pos, kind, iv, dv, sv, blob_len);
+}
+
+int eh_column_count(sqlite3_stmt *st) { return sqlite3_column_count(st); }
+const char *eh_column_name(sqlite3_stmt *st, int i) { return sqlite3_column_name(st, i); }
+int eh_column_type(sqlite3_stmt *st, int i) { return sqlite3_column_type(st, i); }
+int64_t eh_column_int64(sqlite3_stmt *st, int i) { return sqlite3_column_int64(st, i); }
+double eh_column_double(sqlite3_stmt *st, int i) { return sqlite3_column_double(st, i); }
+const unsigned char *eh_column_text(sqlite3_stmt *st, int i) { return sqlite3_column_text(st, i); }
+const void *eh_column_blob(sqlite3_stmt *st, int i) { return sqlite3_column_blob(st, i); }
+int eh_column_bytes(sqlite3_stmt *st, int i) { return sqlite3_column_bytes(st, i); }
+
+// --- hot path 1: batched winner lookup ---
+//
+// For each distinct cell i, writes the current winner timestamp into
+// out[i] (caller-provided buffer of size out_cap, 0-terminated; empty
+// string = no winner). Timestamps are 46 ASCII chars, so out_cap=47.
+int eh_fetch_winners(sqlite3 *db, int64_t n, const char *const *tables,
+                     const char *const *rows, const char *const *cols,
+                     char *out, int64_t out_cap) {
+  sqlite3_stmt *st = nullptr;
+  if (sqlite3_prepare_v2(db, kSelectWinner, -1, &st, nullptr) != SQLITE_OK) return 1;
+  for (int64_t i = 0; i < n; ++i) {
+    sqlite3_bind_text(st, 1, tables[i], -1, SQLITE_TRANSIENT);
+    sqlite3_bind_text(st, 2, rows[i], -1, SQLITE_TRANSIENT);
+    sqlite3_bind_text(st, 3, cols[i], -1, SQLITE_TRANSIENT);
+    int rc = sqlite3_step(st);
+    char *dst = out + i * out_cap;
+    if (rc == SQLITE_ROW) {
+      const unsigned char *t = sqlite3_column_text(st, 0);
+      std::strncpy(dst, reinterpret_cast<const char *>(t), out_cap - 1);
+      dst[out_cap - 1] = '\0';
+    } else if (rc == SQLITE_DONE) {
+      dst[0] = '\0';
+    } else {
+      sqlite3_finalize(st);
+      return 1;
+    }
+    sqlite3_reset(st);
+    sqlite3_clear_bindings(st);
+  }
+  sqlite3_finalize(st);
+  return 0;
+}
+
+// --- hot path 2: the reference loop, one C call per batch ---
+//
+// Exactly applyMessages.ts:78-124 per message, inside the caller's
+// transaction: winner SELECT; upsert the app table when the message
+// beats it; INSERT OR NOTHING into __message and flag the Merkle XOR
+// when the winner differs. out_xor[i]=1 marks messages whose hash the
+// caller XORs into the tree (host-side sparse trie update).
+int eh_apply_sequential(sqlite3 *db, int64_t n, const char *const *timestamps,
+                        const char *const *tables, const char *const *rows,
+                        const char *const *cols, const int32_t *kinds,
+                        const int64_t *ivals, const double *dvals,
+                        const char *const *svals, const int32_t *blob_lens,
+                        uint8_t *out_xor) {
+  StmtCache cache(db);
+  sqlite3_stmt *sel = cache.get(kSelectWinner);
+  sqlite3_stmt *ins = cache.get(kInsertMessage);
+  if (!sel || !ins) return 1;
+
+  for (int64_t i = 0; i < n; ++i) {
+    sqlite3_bind_text(sel, 1, tables[i], -1, SQLITE_TRANSIENT);
+    sqlite3_bind_text(sel, 2, rows[i], -1, SQLITE_TRANSIENT);
+    sqlite3_bind_text(sel, 3, cols[i], -1, SQLITE_TRANSIENT);
+    int rc = sqlite3_step(sel);
+    bool has_winner = rc == SQLITE_ROW;
+    if (!has_winner && rc != SQLITE_DONE) return 1;
+    std::string winner;
+    if (has_winner)
+      winner = reinterpret_cast<const char *>(sqlite3_column_text(sel, 0));
+    sqlite3_reset(sel);
+    sqlite3_clear_bindings(sel);
+
+    bool newer = !has_winner || winner.compare(timestamps[i]) < 0;
+    if (newer) {  // applyMessages.ts:92-103
+      sqlite3_stmt *up = cache.get(upsert_sql(tables[i], cols[i]));
+      if (!up) return 1;
+      sqlite3_bind_text(up, 1, rows[i], -1, SQLITE_TRANSIENT);
+      bind_value(up, 2, kinds[i], ivals[i], dvals[i], svals[i], blob_lens[i]);
+      bind_value(up, 3, kinds[i], ivals[i], dvals[i], svals[i], blob_lens[i]);
+      if (step_done(up) != SQLITE_OK) return 1;
+    }
+    bool differs = !has_winner || winner.compare(timestamps[i]) != 0;
+    out_xor[i] = differs ? 1 : 0;
+    if (differs) {  // applyMessages.ts:104-122
+      sqlite3_bind_text(ins, 1, timestamps[i], -1, SQLITE_TRANSIENT);
+      sqlite3_bind_text(ins, 2, tables[i], -1, SQLITE_TRANSIENT);
+      sqlite3_bind_text(ins, 3, rows[i], -1, SQLITE_TRANSIENT);
+      sqlite3_bind_text(ins, 4, cols[i], -1, SQLITE_TRANSIENT);
+      bind_value(ins, 5, kinds[i], ivals[i], dvals[i], svals[i], blob_lens[i]);
+      if (step_done(ins) != SQLITE_OK) return 1;
+    }
+  }
+  return 0;
+}
+
+// --- hot path 3: apply a device-computed plan ---
+//
+// The TPU planner already decided the final winner per cell
+// (upsert_mask) and the Merkle XOR set; this applies the SQL side —
+// upserts for flagged rows, then the bulk __message insert for ALL
+// rows (PK dedup) — inside the caller's transaction.
+int eh_apply_planned(sqlite3 *db, int64_t n, const char *const *timestamps,
+                     const char *const *tables, const char *const *rows,
+                     const char *const *cols, const int32_t *kinds,
+                     const int64_t *ivals, const double *dvals,
+                     const char *const *svals, const int32_t *blob_lens,
+                     const uint8_t *upsert_mask) {
+  StmtCache cache(db);
+  sqlite3_stmt *ins = cache.get(kInsertMessage);
+  if (!ins) return 1;
+  for (int64_t i = 0; i < n; ++i) {
+    if (upsert_mask[i]) {
+      sqlite3_stmt *up = cache.get(upsert_sql(tables[i], cols[i]));
+      if (!up) return 1;
+      sqlite3_bind_text(up, 1, rows[i], -1, SQLITE_TRANSIENT);
+      bind_value(up, 2, kinds[i], ivals[i], dvals[i], svals[i], blob_lens[i]);
+      bind_value(up, 3, kinds[i], ivals[i], dvals[i], svals[i], blob_lens[i]);
+      if (step_done(up) != SQLITE_OK) return 1;
+    }
+    sqlite3_bind_text(ins, 1, timestamps[i], -1, SQLITE_TRANSIENT);
+    sqlite3_bind_text(ins, 2, tables[i], -1, SQLITE_TRANSIENT);
+    sqlite3_bind_text(ins, 3, rows[i], -1, SQLITE_TRANSIENT);
+    sqlite3_bind_text(ins, 4, cols[i], -1, SQLITE_TRANSIENT);
+    bind_value(ins, 5, kinds[i], ivals[i], dvals[i], svals[i], blob_lens[i]);
+    if (step_done(ins) != SQLITE_OK) return 1;
+  }
+  return 0;
+}
+
+// --- relay hot path: bulk (timestamp, userId, content) insert with
+// per-row "was new" flags (INSERT OR IGNORE changes()==1 semantics,
+// apps/server/src/index.ts:148-159). content is a blob. ---
+int eh_relay_insert(sqlite3 *db, int64_t n, const char *const *timestamps,
+                    const char *const *user_ids, const char *const *contents,
+                    const int32_t *content_lens, uint8_t *out_new) {
+  sqlite3_stmt *st = nullptr;
+  const char *sql =
+      "INSERT OR IGNORE INTO \"message\" (\"timestamp\", \"userId\", \"content\") "
+      "VALUES (?, ?, ?)";
+  if (sqlite3_prepare_v2(db, sql, -1, &st, nullptr) != SQLITE_OK) return 1;
+  for (int64_t i = 0; i < n; ++i) {
+    sqlite3_bind_text(st, 1, timestamps[i], -1, SQLITE_TRANSIENT);
+    sqlite3_bind_text(st, 2, user_ids[i], -1, SQLITE_TRANSIENT);
+    sqlite3_bind_blob(st, 3, contents[i], content_lens[i], SQLITE_TRANSIENT);
+    int rc = sqlite3_step(st);
+    sqlite3_reset(st);
+    sqlite3_clear_bindings(st);
+    if (rc != SQLITE_DONE) {
+      sqlite3_finalize(st);
+      return 1;
+    }
+    out_new[i] = sqlite3_changes(db) == 1 ? 1 : 0;
+  }
+  sqlite3_finalize(st);
+  return 0;
+}
+
+}  // extern "C"
