@@ -37,6 +37,7 @@ from evolu_tpu.core.timestamp import timestamp_from_string
 from evolu_tpu.core.types import CrdtMessage
 from evolu_tpu.ops import bucket_size, with_x64
 from evolu_tpu.ops.encode import node_hex_to_u64, pack_ts_key_host
+from evolu_tpu.utils.log import span
 
 _PAD_CELL = jnp.int32(0x7FFFFFFF)
 
@@ -200,6 +201,12 @@ def plan_batch_device(
     n = len(messages)
     if n == 0:
         return [], []
+    with span("kernel:merge", "plan_batch_device", n=n):
+        return _plan_batch_device_timed(messages, existing_winners)
+
+
+def _plan_batch_device_timed(messages, existing_winners):
+    n = len(messages)
     cell_ids, k1, k2, ex_k1, ex_k2, *_ = messages_to_columns(messages, existing_winners)
     (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns([cell_ids, k1, k2, ex_k1, ex_k2], n)
     xor_mask, upsert_mask = plan_merge(

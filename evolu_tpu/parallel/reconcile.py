@@ -34,6 +34,7 @@ from evolu_tpu.ops.encode import timestamp_hashes
 from evolu_tpu.ops.merge import _PAD_CELL, messages_to_columns, plan_merge_core
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, sharding
+from evolu_tpu.utils.log import span
 
 
 
@@ -162,6 +163,13 @@ def reconcile_owner_batches(
     """
     if not owner_batches:
         return {}, 0
+    n_msgs = sum(len(v) for v in owner_batches.values())
+    with span("kernel:reconcile", "reconcile_owner_batches",
+              owners=len(owner_batches), n=n_msgs):
+        return _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners)
+
+
+def _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners):
     cols, index = build_owner_columns(mesh, owner_batches, existing_winners)
     xor_mask, upsert_mask, owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid, digest = (
         reconcile_columns_sharded(mesh, cols)

@@ -39,6 +39,7 @@ from evolu_tpu.storage.clock import read_clock, update_clock
 from evolu_tpu.storage.schema import delete_all_tables, init_db_model, update_db_schema
 from evolu_tpu.storage.sqlite import PySqliteDatabase
 from evolu_tpu.utils.config import Config
+from evolu_tpu.utils.log import logger
 
 
 def _now_millis() -> int:
@@ -96,7 +97,9 @@ class DbWorker:
 
     def start(self, mnemonic: Optional[str] = None) -> Owner:
         """Init: bootstrap the db model in one transaction and emit
-        OnInit with the owner (db.worker.ts:77-137)."""
+        OnInit with the owner (db.worker.ts:77-137). Applies the config's
+        log setting to the module logger (setConfig, db.worker.ts:103)."""
+        logger.configure(self.config.log)
         with self.db.transaction():
             self.owner = init_db_model(self.db, mnemonic)
         self.on_output(msg.OnInit(self.owner))

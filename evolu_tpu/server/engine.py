@@ -36,6 +36,7 @@ from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_se
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, create_mesh, sharding
 from evolu_tpu.parallel.reconcile import xor_allreduce
 from evolu_tpu.server.relay import RelayStore
+from evolu_tpu.utils.log import span
 from evolu_tpu.sync import protocol
 
 
@@ -69,6 +70,13 @@ def owner_minute_deltas(
 ) -> Tuple[Dict[str, Dict[str, int]], int]:
     """Device pass: {owner: [timestamp strings]} → per-owner
     {minute-key: xor delta} plus the global batch digest."""
+    owners = list(owner_rows)
+    with span("kernel:merkle", "owner_minute_deltas", owners=len(owners),
+              n=sum(len(v) for v in owner_rows.values())):
+        return _owner_minute_deltas_timed(mesh, owner_rows)
+
+
+def _owner_minute_deltas_timed(mesh, owner_rows):
     owners = list(owner_rows)
     owner_ix = {o: i for i, o in enumerate(owners)}
     shards = assign_owners_to_shards({o: len(owner_rows[o]) for o in owners}, mesh.devices.size)

@@ -4,11 +4,13 @@ The `Database` interface is the backend boundary the reference exposes
 (types.ts:162-176); the TPU merge engine plugs in above it — kernels
 decide winners/masks, storage applies them transactionally. Two
 implementations: `sqlite.PySqliteDatabase` (stdlib sqlite3 — the real
-SQLite C library) and the native C++ host layer in `storage/native`
-(bulk columnar apply, used by the server reconcile path).
+SQLite C library) and `native.CppSqliteDatabase` (the C++ host layer
+driving the SQLite C API, with the batched apply hot paths);
+`open_database` selects between them.
 """
 
-from evolu_tpu.storage.sqlite import PySqliteDatabase, open_database
+from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.storage.native import CppSqliteDatabase, native_available, open_database
 from evolu_tpu.storage.schema import (
     init_db_model,
     update_db_schema,
@@ -20,6 +22,8 @@ from evolu_tpu.storage.apply import apply_messages
 
 __all__ = [
     "PySqliteDatabase",
+    "CppSqliteDatabase",
+    "native_available",
     "open_database",
     "init_db_model",
     "update_db_schema",

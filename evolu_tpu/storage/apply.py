@@ -170,15 +170,19 @@ def apply_messages(
 
         if hasattr(db, "apply_planned"):
             # C++ backend: upserts + bulk __message insert in one call.
-            # The mask is keyed by cell+timestamp, not object identity, so
-            # planners may rebuild message objects. A duplicate timestamp
-            # flags both copies — the second upsert is an identical
-            # idempotent statement, so the end state is unchanged.
-            winner_keys = {(m.table, m.row, m.column, m.timestamp) for m in upserts}
-            db.apply_planned(
-                messages,
-                [(m.table, m.row, m.column, m.timestamp) in winner_keys for m in messages],
-            )
+            # The mask is keyed by cell+timestamp (planners may rebuild
+            # message objects), flagging only the FIRST occurrence of
+            # each winner key — a duplicate timestamp with a different
+            # value must not upsert twice, or the end state would
+            # diverge from the Python path, which applies the planner's
+            # single chosen winner.
+            pending = {(m.table, m.row, m.column, m.timestamp) for m in upserts}
+            mask = []
+            for m in messages:
+                key = (m.table, m.row, m.column, m.timestamp)
+                mask.append(key in pending)
+                pending.discard(key)
+            db.apply_planned(messages, mask)
         else:
             # App tables: only the final winner per cell touches the row.
             for m in upserts:

@@ -59,6 +59,8 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.eh_total_changes.argtypes = [p]
     lib.eh_prepare.restype = p
     lib.eh_prepare.argtypes = [p, s]
+    lib.eh_prepare_single.restype = p
+    lib.eh_prepare_single.argtypes = [p, s, c.POINTER(c.c_int)]
     lib.eh_finalize.argtypes = [p]
     lib.eh_step.argtypes = [p]
     lib.eh_reset.argtypes = [p]
@@ -169,6 +171,10 @@ class CppSqliteDatabase:
 
     # -- internals --
 
+    def _check_open(self) -> None:
+        if not self._db:
+            raise UnknownError("Cannot operate on a closed database.")
+
     def _err(self) -> UnknownError:
         msg = self._lib.eh_errmsg(self._db)
         return UnknownError(msg.decode("utf-8", "replace") if msg else "sqlite error")
@@ -197,9 +203,14 @@ class CppSqliteDatabase:
 
     def _execute(self, sql: str, parameters: Sequence = ()) -> Tuple[List[Tuple], List[str]]:
         lib = self._lib
-        st = lib.eh_prepare(self._db, sql.encode("utf-8"))
+        self._check_open()
+        tail = ctypes.c_int(0)
+        st = lib.eh_prepare_single(self._db, sql.encode("utf-8"), ctypes.byref(tail))
         if not st:
             raise self._err()
+        if tail.value:
+            lib.eh_finalize(st)
+            raise UnknownError("You can only execute one statement at a time.")
         try:
             for j, v in enumerate(parameters):
                 k, iv, dv, sv, bl = _encode_value(v)
@@ -240,6 +251,7 @@ class CppSqliteDatabase:
 
     def exec_script(self, sql: str) -> None:
         with self._lock:
+            self._check_open()
             if self._in_txn:
                 raise UnknownError("exec_script inside an open transaction")
             if self._lib.eh_exec(self._db, sql.encode("utf-8")) != 0:
@@ -259,6 +271,7 @@ class CppSqliteDatabase:
     def run_many(self, sql: str, rows: Iterable[Sequence]) -> int:
         lib = self._lib
         with self._lock:
+            self._check_open()
             st = lib.eh_prepare(self._db, sql.encode("utf-8"))
             if not st:
                 raise self._err()
@@ -279,11 +292,13 @@ class CppSqliteDatabase:
 
     def changes(self) -> int:
         with self._lock:
+            self._check_open()
             return self._lib.eh_total_changes(self._db)
 
     @contextmanager
     def transaction(self):
         with self._lock:
+            self._check_open()
             if self._in_txn:
                 yield self
                 return
@@ -319,6 +334,7 @@ class CppSqliteDatabase:
         cap = 64
         out = ctypes.create_string_buffer(n * cap)
         with self._lock:
+            self._check_open()
             rc = self._lib.eh_fetch_winners(
                 self._db, n,
                 _str_array([c[0] for c in cells]),
@@ -344,6 +360,7 @@ class CppSqliteDatabase:
         kinds, ivals, dvals, svals, blens = _columnar_values([m.value for m in messages])
         out = (ctypes.c_uint8 * n)()
         with self._lock:
+            self._check_open()
             rc = self._lib.eh_apply_sequential(
                 self._db, n,
                 _str_array([m.timestamp for m in messages]),
@@ -365,6 +382,7 @@ class CppSqliteDatabase:
         kinds, ivals, dvals, svals, blens = _columnar_values([m.value for m in messages])
         mask = (ctypes.c_uint8 * n)(*[1 if b else 0 for b in upsert_mask])
         with self._lock:
+            self._check_open()
             rc = self._lib.eh_apply_planned(
                 self._db, n,
                 _str_array([m.timestamp for m in messages]),
@@ -389,6 +407,7 @@ class CppSqliteDatabase:
             lens[j] = len(content)
         out = (ctypes.c_uint8 * n)()
         with self._lock:
+            self._check_open()
             rc = self._lib.eh_relay_insert(
                 self._db, n,
                 _str_array([r[0] for r in rows]),

@@ -105,5 +105,4 @@ class PySqliteDatabase:
             self._conn.close()
 
 
-def open_database(path: str = ":memory:") -> PySqliteDatabase:
-    return PySqliteDatabase(path)
+

@@ -27,6 +27,7 @@ from evolu_tpu.runtime.synclock import SyncLock
 from evolu_tpu.sync import protocol
 from evolu_tpu.sync.crypto import decrypt_symmetric, encrypt_symmetric
 from evolu_tpu.utils.config import Config
+from evolu_tpu.utils.log import log
 
 
 def encrypt_messages(messages, mnemonic: str):
@@ -111,6 +112,8 @@ class SyncTransport:
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))
             return
+        log("sync:request", url=self.config.sync_url,
+            messages=len(request.messages), bytes=len(body))
         try:
             response_bytes = self._http_post(self.config.sync_url, body)
         except urllib.error.HTTPError as e:
@@ -123,6 +126,7 @@ class SyncTransport:
         try:
             response = protocol.decode_sync_response(response_bytes)
             messages = decrypt_messages(response.messages, request.owner.mnemonic)
+            log("sync:response", messages=len(messages), bytes=len(response_bytes))
             self.on_receive(messages, response.merkle_tree, request.previous_diff)
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))

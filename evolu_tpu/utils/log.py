@@ -1,0 +1,135 @@
+"""Structured logging + kernel timing (reference: packages/evolu/src/log.ts).
+
+The reference gates console logs on `config.log` with targets
+`clock:read | clock:update | sync:request | sync:response | dev`
+(types.ts:21-26) and carries a commented-out duration profiler
+(log.ts:16-37). This module keeps the exact target names and gating
+semantics (`log: true` enables all targets; a string or list enables a
+subset), and realizes the profiler as `span(target)` — a context
+manager recording wall-clock durations, used for per-kernel timing
+(SURVEY.md §5 "structured event log + per-kernel timing keyed by the
+same target names").
+
+Events also land in a bounded in-memory ring (`recent_events`) so
+tests and embedders can observe the runtime without scraping stdout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple, Union
+
+# Reference targets (types.ts:21-26) + TPU-native kernel targets.
+TARGETS = (
+    "clock:read",
+    "clock:update",
+    "sync:request",
+    "sync:response",
+    "dev",
+    "kernel:merge",
+    "kernel:merkle",
+    "kernel:reconcile",
+)
+
+
+@dataclass
+class LogEvent:
+    target: str
+    message: str
+    t: float
+    duration_ms: Optional[float] = None
+    fields: Dict[str, object] = field(default_factory=dict)
+
+
+class Logger:
+    """Target-gated logger with a bounded event ring.
+
+    `enabled` follows config.log semantics: True = every target,
+    False = nothing, str/list = those targets only (log.ts:5-14).
+    """
+
+    def __init__(self, enabled: Union[bool, str, List[str]] = False, capacity: int = 1024):
+        self._lock = threading.Lock()
+        self._ring: Deque[LogEvent] = deque(maxlen=capacity)
+        # target -> (count, total_ms, max_ms): O(1) running aggregates,
+        # never a per-call list (long-lived workers span per batch).
+        self._durations: Dict[str, Tuple[int, float, float]] = {}
+        self.configure(enabled)
+
+    def configure(self, enabled: Union[bool, str, List[str]]) -> None:
+        if isinstance(enabled, str):
+            enabled = [enabled]
+        self._enabled = enabled
+
+    def is_enabled(self, target: str) -> bool:
+        if self._enabled is True:
+            return True
+        if not self._enabled:
+            return False
+        return target in self._enabled
+
+    def log(self, target: str, message: str = "", **fields) -> None:
+        """log(target)(message) analog (log.ts:5-14): console + ring."""
+        if not self.is_enabled(target):
+            return
+        ev = LogEvent(target=target, message=message, t=time.time(), fields=fields)
+        with self._lock:
+            self._ring.append(ev)
+        extra = (" " + " ".join(f"{k}={v}" for k, v in fields.items())) if fields else ""
+        print(f"[{target}] {message}{extra}")
+
+    @contextmanager
+    def span(self, target: str, message: str = "", **fields):
+        """Duration measurement (the reference's commented-out
+        createLogDuration, log.ts:16-37). Records even when console
+        output for the target is disabled so kernel timings are always
+        queryable via `duration_stats`."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                cnt, tot, mx = self._durations.get(target, (0, 0.0, 0.0))
+                self._durations[target] = (cnt + 1, tot + ms, max(mx, ms))
+                self._ring.append(
+                    LogEvent(target=target, message=message, t=time.time(),
+                             duration_ms=ms, fields=fields)
+                )
+            if self.is_enabled(target):
+                extra = (" " + " ".join(f"{k}={v}" for k, v in fields.items())) if fields else ""
+                print(f"[{target}] {message} {ms:.3f}ms{extra}")
+
+    def recent_events(self, target: Optional[str] = None) -> List[LogEvent]:
+        with self._lock:
+            evs = list(self._ring)
+        if target is None:
+            return evs
+        return [e for e in evs if e.target == target]
+
+    def duration_stats(self, target: str) -> Optional[Tuple[int, float, float]]:
+        """(count, total_ms, max_ms) for a span target, or None."""
+        with self._lock:
+            return self._durations.get(target)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._durations.clear()
+
+
+# Module-level default, mirroring the reference's module singleton. The
+# runtime re-configures it from Config at init (setConfig analog).
+logger = Logger()
+
+
+def log(target: str, message: str = "", **fields) -> None:
+    logger.log(target, message, **fields)
+
+
+def span(target: str, message: str = "", **fields):
+    return logger.span(target, message, **fields)

@@ -179,6 +179,25 @@ sqlite3_stmt *eh_prepare(sqlite3 *db, const char *sql) {
   return st;
 }
 
+// Like eh_prepare but rejects trailing statements: *tail_nonempty is
+// set when anything but whitespace/semicolons follows the first
+// statement (PySqliteDatabase's execute raises there too).
+sqlite3_stmt *eh_prepare_single(sqlite3 *db, const char *sql, int *tail_nonempty) {
+  sqlite3_stmt *st = nullptr;
+  const char *tail = nullptr;
+  *tail_nonempty = 0;
+  if (sqlite3_prepare_v2(db, sql, -1, &st, &tail) != SQLITE_OK) return nullptr;
+  if (tail) {
+    for (const char *p = tail; *p; ++p) {
+      if (*p != ' ' && *p != '\t' && *p != '\n' && *p != '\r' && *p != ';') {
+        *tail_nonempty = 1;
+        break;
+      }
+    }
+  }
+  return st;
+}
+
 int eh_finalize(sqlite3_stmt *st) { return sqlite3_finalize(st); }
 int eh_step(sqlite3_stmt *st) { return sqlite3_step(st); }
 int eh_reset(sqlite3_stmt *st) {

@@ -174,3 +174,43 @@ def test_end_to_end_client_on_native_backend(tmp_path):
         assert rows == [{"id": rid, "title": "native"}]
     finally:
         e.dispose()
+
+
+def test_closed_database_raises_not_crashes():
+    from evolu_tpu.core.types import UnknownError
+
+    db = CppSqliteDatabase()
+    db.close()
+    with pytest.raises(UnknownError, match="closed"):
+        db.exec("SELECT 1")
+    with pytest.raises(UnknownError, match="closed"):
+        with db.transaction():
+            pass
+    db.close()  # double close is a no-op
+
+
+def test_multi_statement_exec_raises_like_python():
+    db = CppSqliteDatabase()
+    db.exec('CREATE TABLE "a" ("x")')
+    db.exec('CREATE TABLE "b" ("x")')
+    with pytest.raises(Exception, match="one statement"):
+        db.exec('DELETE FROM "a"; DELETE FROM "b"')
+    # trailing whitespace/semicolons are fine
+    assert db.exec("SELECT 1 ;  ") == [(1,)]
+    db.close()
+
+
+def test_duplicate_timestamp_distinct_values_backend_parity():
+    # A hostile peer sends two messages with the SAME timestamp for the
+    # same cell but different values: both backends must end identically.
+    t = ts(1_700_000_000_000)
+    msgs = [
+        CrdtMessage(t, "todo", "r1", "title", "A"),
+        CrdtMessage(t, "todo", "r1", "title", "B"),
+    ]
+    cpp, py = CppSqliteDatabase(), PySqliteDatabase()
+    bootstrap(cpp), bootstrap(py)
+    apply_messages(cpp, {}, msgs)
+    apply_messages(py, {}, msgs)
+    assert dump(cpp) == dump(py)
+    cpp.close(), py.close()
