@@ -264,6 +264,7 @@ class CppSqliteDatabase:
 
     def run(self, sql: str, parameters: Sequence = ()) -> int:
         with self._lock:
+            self._check_open()
             before = self._lib.eh_total_changes(self._db)
             self._execute(sql, parameters)
             return self._lib.eh_total_changes(self._db) - before

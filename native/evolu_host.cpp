@@ -187,12 +187,21 @@ sqlite3_stmt *eh_prepare_single(sqlite3 *db, const char *sql, int *tail_nonempty
   const char *tail = nullptr;
   *tail_nonempty = 0;
   if (sqlite3_prepare_v2(db, sql, -1, &st, &tail) != SQLITE_OK) return nullptr;
-  if (tail) {
-    for (const char *p = tail; *p; ++p) {
-      if (*p != ' ' && *p != '\t' && *p != '\n' && *p != '\r' && *p != ';') {
-        *tail_nonempty = 1;
-        break;
-      }
+  // Skip whitespace, ';', and SQL comments ("--...\n", "/*...*/") —
+  // Python's sqlite3.execute accepts those after the statement too.
+  const char *p = tail ? tail : "";
+  while (*p) {
+    if (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r' || *p == ';') {
+      ++p;
+    } else if (p[0] == '-' && p[1] == '-') {
+      while (*p && *p != '\n') ++p;
+    } else if (p[0] == '/' && p[1] == '*') {
+      p += 2;
+      while (*p && !(p[0] == '*' && p[1] == '/')) ++p;
+      if (*p) p += 2;
+    } else {
+      *tail_nonempty = 1;
+      break;
     }
   }
   return st;

@@ -214,3 +214,19 @@ def test_duplicate_timestamp_distinct_values_backend_parity():
     apply_messages(py, {}, msgs)
     assert dump(cpp) == dump(py)
     cpp.close(), py.close()
+
+
+def test_run_on_closed_database_raises():
+    from evolu_tpu.core.types import UnknownError
+
+    db = CppSqliteDatabase()
+    db.close()
+    with pytest.raises(UnknownError, match="closed"):
+        db.run("SELECT 1")
+
+
+def test_trailing_comments_accepted_like_python():
+    db = CppSqliteDatabase()
+    assert db.exec("SELECT 1; -- done") == [(1,)]
+    assert db.exec("SELECT 2; /* trailing\n block */ ;") == [(2,)]
+    db.close()
