@@ -64,6 +64,7 @@ class Evolu:
         # not capture (or, if aborted, discard) another thread's mutations.
         self._batch = threading.local()
         self._on_reload: Optional[Callable[[], None]] = None
+        self._reload_watcher = None  # started by on_reload(cross_process=True)
         self._transport = None  # set by attach_transport
         self.worker = DbWorker(
             self.db,
@@ -303,9 +304,28 @@ class Evolu:
             raise UnknownError(f"invalid mnemonic")
         self.worker.post(msg.RestoreOwner(mnemonic))
 
-    def on_reload(self, callback: Callable[[], None]) -> None:
-        """reloadAllTabs analog (reloadAllTabs.ts:6-14)."""
+    def on_reload(self, callback: Callable[[], None], cross_process: bool = True) -> None:
+        """reloadAllTabs analog (reloadAllTabs.ts:6-14): fires after this
+        replica's resetOwner/restoreOwner, and — when `cross_process` and
+        the DB is file-backed — when another process sharing the same DB
+        file signals one (the localStorage storage-event analog)."""
         self._on_reload = callback
+        if cross_process and self._reload_watcher is None and self.db.path != ":memory:":
+            from evolu_tpu.utils.reload import ReloadWatcher
+
+            self._reload_watcher = ReloadWatcher(self.db.path, lambda: self._fire_reload())
+
+    def _fire_reload(self) -> None:
+        """Another process reset/restored the shared DB file: re-run
+        every subscribed query (the worker recomputes against the new
+        file state and posts patches, which notify listeners — same
+        flow as OnReceive), then the embedder callback."""
+        with self._lock:
+            queries = tuple(self._subscribed)
+        if queries:
+            self.worker.post(msg.Query(queries))
+        if self._on_reload is not None:
+            self._on_reload()
 
     # -- errors (error.ts:8-22) --
 
@@ -344,6 +364,15 @@ class Evolu:
             with self._lock:
                 self._rows_cache.clear()
                 self.owner = self.worker.owner
+            # Signal other processes sharing this DB file, then fire the
+            # local callback (reloadAllTabs.ts does both: localStorage
+            # ping + own location.assign). Our own watcher must skip the
+            # nonce — the callback already fires here.
+            from evolu_tpu.utils.reload import notify_reload
+
+            nonce = notify_reload(self.db.path)
+            if self._reload_watcher is not None:
+                self._reload_watcher.ignore(nonce)
             if self._on_reload is not None:
                 self._on_reload()
         elif isinstance(output, msg.OnInit):
@@ -367,6 +396,8 @@ class Evolu:
 
     def dispose(self) -> None:
         self.worker.stop()
+        if self._reload_watcher is not None:
+            self._reload_watcher.stop()
         if self._transport is not None and hasattr(self._transport, "stop"):
             self._transport.stop()
         self.db.close()

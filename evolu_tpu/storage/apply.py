@@ -196,3 +196,24 @@ def apply_messages(
 
     # One sparse-tree pass (pure, cannot fail after commit).
     return apply_prefix_xors(merkle_tree, deltas)
+
+
+def apply_messages_chunked(
+    db: PySqliteDatabase,
+    merkle_tree: dict,
+    messages: Sequence[CrdtMessage],
+    chunk_size: int = 1 << 20,
+    planner=None,
+) -> dict:
+    """Blockwise apply for batches too large for one device dispatch.
+
+    The LWW contraction is associative: each chunk's winners become the
+    next chunk's stored winners (fetched fresh from SQLite), so folding
+    chunks left-to-right is state-identical to one giant batch — the
+    "blockwise accumulation over message chunks" strategy for batches
+    exceeding HBM (SURVEY.md §5 long-context analog). Each chunk commits
+    its own transaction, bounding both device and transaction memory.
+    """
+    for i in range(0, len(messages), chunk_size):
+        merkle_tree = apply_messages(db, merkle_tree, messages[i : i + chunk_size], planner)
+    return merkle_tree

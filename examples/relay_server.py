@@ -1,0 +1,44 @@
+"""Deployable relay server (reference: examples/server-nodejs/src/index.ts).
+
+A single HTTP endpoint `POST /` taking a protobuf SyncRequest and
+returning a SyncResponse, plus `GET /ping`; storage is one SQLite file.
+The relay is E2EE-blind — it sees timestamps and ciphertext only.
+
+    python examples/relay_server.py [--db relay.db] [--port 4000]
+
+PORT may also come from the environment (index.ts:254-256).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from evolu_tpu.server.relay import RelayServer, RelayStore
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--db", default="relay.db")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=int(os.environ.get("PORT", 4000)))
+    args = ap.parse_args()
+
+    server = RelayServer(RelayStore(args.db), host=args.host, port=args.port)
+    server.start()
+    print(f"relay listening on {server.url} (db: {args.db})")
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,249 @@
+"""N-replica convergence + high-contention tie-break properties.
+
+The reference never tests multi-node convergence (SURVEY.md §4 "the
+multi-node story is untested"); these tests close that gap against the
+BASELINE configs:
+
+- config 1: two replicas, todo schema, 1k CrdtMessages through the
+  full client+relay stack — byte-identical SQLite end state.
+- config 4: 64 replicas editing the same 100 rows — HLC (counter,
+  node) tie-break exactness; every delivery order converges to the
+  oracle's winner.
+- property: applying one message SET in any order/partition yields an
+  identical end state (the LWW CRDT property the whole design rests
+  on), on both storage backends and with the device planner.
+"""
+
+import os
+import random
+
+import pytest
+
+from evolu_tpu.core.timestamp import (
+    Timestamp,
+    receive_timestamp,
+    send_timestamp,
+    timestamp_to_string,
+)
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.core.merkle import merkle_tree_to_string
+from evolu_tpu.storage.apply import apply_messages, apply_messages_sequential
+from evolu_tpu.storage.native import native_available, open_database
+from evolu_tpu.storage.schema import init_db_model
+
+
+def fresh_db(backend="python"):
+    db = open_database(backend=backend)
+    init_db_model(db, mnemonic=None)
+    db.exec(
+        'CREATE TABLE IF NOT EXISTS "todo" ('
+        '"id" TEXT PRIMARY KEY, "title" BLOB, "n" BLOB)'
+    )
+    return db
+
+
+def dump(db):
+    return {
+        "todo": db.exec('SELECT * FROM "todo" ORDER BY "id"'),
+        "__message": db.exec('SELECT * FROM "__message" ORDER BY "timestamp"'),
+    }
+
+
+def make_contention_workload(n_replicas=64, n_rows=100, writes_per_replica=40, seed=4):
+    """Config 4: every replica hammers the same rows; HLC clocks advance
+    per replica with realistic receive() merges so counters collide."""
+    rng = random.Random(seed)
+    base = 1_700_000_000_000
+    clocks = [Timestamp(base, 0, f"{i:016x}") for i in range(n_replicas)]
+    messages = []
+    for step in range(writes_per_replica):
+        order = list(range(n_replicas))
+        rng.shuffle(order)
+        for r in order:
+            # Frozen wall clock ⇒ counters increment ⇒ (counter, node)
+            # tie-breaks dominate (the config-4 stress).
+            now = base + (step // 8)
+            clocks[r] = send_timestamp(clocks[r], now=now)
+            row = f"row{rng.randrange(n_rows)}"
+            messages.append(
+                CrdtMessage(
+                    timestamp_to_string(clocks[r]), "todo", row,
+                    rng.choice(["title", "n"]),
+                    f"r{r}s{step}",
+                )
+            )
+            # Occasionally gossip clocks so replicas entangle.
+            if rng.random() < 0.1:
+                other = rng.randrange(n_replicas)
+                if other != r:
+                    clocks[other] = receive_timestamp(
+                        clocks[other], clocks[r], now=now
+                    )
+    return messages
+
+
+def lww_oracle(messages):
+    """Pure-Python ground truth: winner per cell = max timestamp string."""
+    winners = {}
+    for m in messages:
+        cell = (m.table, m.row, m.column)
+        cur = winners.get(cell)
+        if cur is None or cur.timestamp < m.timestamp:
+            winners[cell] = m
+    return {cell: m.value for cell, m in winners.items()}
+
+
+def db_cells(db):
+    out = {}
+    for row in db.exec_sql_query('SELECT "id", "title", "n" FROM "todo"'):
+        for col in ("title", "n"):
+            if row[col] is not None:
+                out[("todo", row["id"], col)] = row[col]
+    return out
+
+
+def test_config4_high_contention_64_replicas_100_rows():
+    messages = make_contention_workload()
+    oracle = lww_oracle(messages)
+
+    # Three adversarial delivery orders, two backends.
+    rng = random.Random(99)
+    orders = [
+        list(messages),
+        list(reversed(messages)),
+        rng.sample(messages, len(messages)),
+    ]
+    backends = ["python"] + (["native"] if native_available() else [])
+    dumps = []
+    for backend in backends:
+        for order in orders:
+            db = fresh_db(backend)
+            apply_messages(db, {}, order)
+            assert db_cells(db) == oracle
+            d = dump(db)
+            # __message content must also be identical (same set stored).
+            dumps.append(d["__message"])
+            db.close()
+    assert all(d == dumps[0] for d in dumps), "replicas diverged on __message"
+
+
+def test_convergence_under_partitioned_delivery():
+    """Split the message set into random partitions applied as separate
+    batches in different orders — state must still converge (models
+    incremental anti-entropy rounds)."""
+    messages = make_contention_workload(n_replicas=8, n_rows=20, writes_per_replica=25)
+    oracle = lww_oracle(messages)
+    rng = random.Random(5)
+    final_dumps = []
+    for trial in range(4):
+        order = rng.sample(messages, len(messages))
+        db = fresh_db()
+        tree = {}
+        i = 0
+        while i < len(order):
+            k = rng.randrange(1, 60)
+            tree = apply_messages(db, tree, order[i : i + k])
+            i += k
+        assert db_cells(db) == oracle, trial
+        final_dumps.append((dump(db), merkle_tree_to_string(tree)))
+        db.close()
+    trees = {t for _, t in final_dumps}
+    assert len(trees) == 1, "merkle trees diverged across delivery orders"
+    assert all(d == final_dumps[0][0] for d, _ in final_dumps)
+
+
+def test_device_planner_matches_oracle_under_contention():
+    """The TPU planner path (plan_batch_device) on the config-4 workload
+    must produce the sequential oracle's exact end state."""
+    from evolu_tpu.ops.merge import plan_batch_device
+
+    messages = make_contention_workload(n_replicas=16, n_rows=10, writes_per_replica=12)
+    a = fresh_db()
+    with a.transaction():
+        apply_messages_sequential(a, {}, messages)
+    b = fresh_db()
+    apply_messages(b, {}, messages, planner=plan_batch_device)
+    assert dump(a) == dump(b)
+    a.close(), b.close()
+
+
+def test_config1_two_replicas_1k_messages_full_stack(tmp_path):
+    """Config 1 shape: two clients, todo schema, ~1k messages through
+    the real relay; byte-identical SQLite end state on both replicas."""
+    from evolu_tpu.runtime.client import Evolu
+    from evolu_tpu.server.relay import RelayServer, RelayStore
+    from evolu_tpu.sync.client import connect
+    from evolu_tpu.utils.config import Config
+
+    server = RelayServer(RelayStore(str(tmp_path / "relay.db"))).start()
+    try:
+        cfg = Config(sync_url=server.url + "/")
+        schema = {"todo": ("title", "n")}
+        a = Evolu(db_path=str(tmp_path / "a.db"), config=cfg)
+        a.update_db_schema(schema)
+        connect(a)
+        b = Evolu(db_path=str(tmp_path / "b.db"), config=cfg, mnemonic=a.owner.mnemonic)
+        b.update_db_schema(schema)
+        connect(b)
+
+        rng = random.Random(11)
+        ids = []
+        # ~1k messages: 180 creates (x3 cols incl auto) + updates (x2).
+        for i in range(180):
+            client = a if rng.random() < 0.5 else b
+            with client.batching():
+                ids.append(client.create("todo", {"title": f"t{i}", "n": i}))
+        def settle():
+            for _ in range(6):
+                for c in (a, b):
+                    c.sync()
+                    c.worker.flush(); c._transport.flush(); c.worker.flush()
+        settle()
+        for i in range(200):
+            client = a if rng.random() < 0.5 else b
+            client.update("todo", rng.choice(ids), {"n": 1000 + i})
+        settle()
+
+        dump_a = a.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+        dump_b = b.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+        assert len(dump_a) >= 900
+        assert dump_a == dump_b, "replicas not byte-identical"
+        rows_a = a.db.exec('SELECT * FROM "todo" ORDER BY "id"')
+        rows_b = b.db.exec('SELECT * FROM "todo" ORDER BY "id"')
+        assert rows_a == rows_b
+        a.dispose(), b.dispose()
+    finally:
+        server.stop()
+
+
+def test_chunked_apply_matches_single_batch():
+    from evolu_tpu.storage.apply import apply_messages_chunked
+
+    messages = make_contention_workload(n_replicas=6, n_rows=15, writes_per_replica=20)
+    a, b = fresh_db(), fresh_db()
+    tree_a = apply_messages(a, {}, messages)
+    tree_b = apply_messages_chunked(b, {}, messages, chunk_size=37)
+    assert dump(a) == dump(b)
+    assert merkle_tree_to_string(tree_a) == merkle_tree_to_string(tree_b)
+    a.close(), b.close()
+
+
+def test_chunked_apply_failure_carries_partial_tree():
+    from evolu_tpu.storage.apply import ChunkedApplyError, apply_messages_chunked
+
+    good = make_contention_workload(n_replicas=4, n_rows=5, writes_per_replica=5)
+    bad = CrdtMessage("not-a-timestamp", "todo", "r", "title", "x")
+    db = fresh_db()
+    seen = []
+    with pytest.raises(ChunkedApplyError) as ei:
+        apply_messages_chunked(
+            db, {}, good + [bad], chunk_size=len(good),
+            on_chunk=lambda tree, n: seen.append(n),
+        )
+    err = ei.value
+    # First chunk committed and reported; its deltas survive in the error.
+    assert seen == [len(good)] and err.applied == len(good)
+    fresh = fresh_db()
+    expect = apply_messages(fresh, {}, good)
+    assert merkle_tree_to_string(err.partial_tree) == merkle_tree_to_string(expect)
+    db.close(), fresh.close()

@@ -388,3 +388,38 @@ def test_queries_accept_raw_sql_and_builders():
         unsub()
     finally:
         evolu.dispose()
+
+
+def test_cross_process_reload_signal(tmp_path):
+    import threading
+
+    from evolu_tpu.utils.reload import ReloadWatcher, notify_reload
+
+    db_path = str(tmp_path / "shared.db")
+    fired = threading.Event()
+    w = ReloadWatcher(db_path, fired.set, interval=0.05)
+    try:
+        notify_reload(db_path)
+        assert fired.wait(2.0), "watcher did not observe the signal"
+    finally:
+        w.stop()
+
+
+def test_restore_owner_signals_other_processes(tmp_path):
+    import threading
+
+    from evolu_tpu.utils.reload import ReloadWatcher
+
+    db_path = str(tmp_path / "client.db")
+    evolu = create_evolu(TODO_SCHEMA, db_path=db_path)
+    try:
+        fired = threading.Event()
+        w = ReloadWatcher(db_path, fired.set, interval=0.05)
+        try:
+            evolu.restore_owner(evolu.owner.mnemonic)
+            evolu.worker.flush()
+            assert fired.wait(2.0), "restore_owner did not bump the reload signal"
+        finally:
+            w.stop()
+    finally:
+        evolu.dispose()
