@@ -198,12 +198,26 @@ def apply_messages(
     return apply_prefix_xors(merkle_tree, deltas)
 
 
+class ChunkedApplyError(Exception):
+    """A chunk failed after earlier chunks committed. `partial_tree`
+    reflects every committed chunk and `applied` counts committed
+    messages — the caller MUST persist `partial_tree` (e.g. to the
+    clock) or the digest permanently diverges from the stored rows."""
+
+    def __init__(self, partial_tree: dict, applied: int, cause: BaseException):
+        super().__init__(f"chunked apply failed after {applied} messages: {cause}")
+        self.partial_tree = partial_tree
+        self.applied = applied
+        self.__cause__ = cause
+
+
 def apply_messages_chunked(
     db: PySqliteDatabase,
     merkle_tree: dict,
     messages: Sequence[CrdtMessage],
     chunk_size: int = 1 << 20,
     planner=None,
+    on_chunk=None,
 ) -> dict:
     """Blockwise apply for batches too large for one device dispatch.
 
@@ -213,7 +227,21 @@ def apply_messages_chunked(
     "blockwise accumulation over message chunks" strategy for batches
     exceeding HBM (SURVEY.md §5 long-context analog). Each chunk commits
     its own transaction, bounding both device and transaction memory.
+
+    `on_chunk(tree, applied_count)` runs after each committed chunk so
+    callers can persist the tree incrementally; if a later chunk fails,
+    `ChunkedApplyError` carries the partial tree covering everything
+    committed so far (unlike `apply_messages`, failure here is not
+    all-or-nothing — earlier chunks stay committed).
     """
+    applied = 0
     for i in range(0, len(messages), chunk_size):
-        merkle_tree = apply_messages(db, merkle_tree, messages[i : i + chunk_size], planner)
+        chunk = messages[i : i + chunk_size]
+        try:
+            merkle_tree = apply_messages(db, merkle_tree, chunk, planner)
+        except Exception as e:
+            raise ChunkedApplyError(merkle_tree, applied, e) from e
+        applied += len(chunk)
+        if on_chunk is not None:
+            on_chunk(merkle_tree, applied)
     return merkle_tree
