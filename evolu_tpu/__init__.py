@@ -51,6 +51,32 @@ from evolu_tpu.core.types import (
 from evolu_tpu.core.ids import create_id, create_node_id, mnemonic_to_owner_id
 from evolu_tpu.utils.config import Config
 
+
+def __getattr__(name):
+    # Runtime/API surface re-exported lazily: importing the bare core
+    # package must not pull in jax (the kernels) or start threads.
+    lazy = {
+        "Evolu": ("evolu_tpu.runtime.client", "Evolu"),
+        "create_evolu": ("evolu_tpu.runtime.client", "create_evolu"),
+        "create_hooks": ("evolu_tpu.api.hooks", "create_hooks"),
+        "Hooks": ("evolu_tpu.api.hooks", "Hooks"),
+        "QueryView": ("evolu_tpu.api.hooks", "QueryView"),
+        "QueryBuilder": ("evolu_tpu.api.query", "QueryBuilder"),
+        "table": ("evolu_tpu.api.query", "table"),
+        "model": ("evolu_tpu.api", "model"),
+        "connect": ("evolu_tpu.sync.client", "connect"),
+        "RelayServer": ("evolu_tpu.server.relay", "RelayServer"),
+        "RelayStore": ("evolu_tpu.server.relay", "RelayStore"),
+        "generate_mnemonic": ("evolu_tpu.core.mnemonic", "generate_mnemonic"),
+        "validate_mnemonic": ("evolu_tpu.core.mnemonic", "validate_mnemonic"),
+    }
+    if name in lazy:
+        import importlib
+
+        module, attr = lazy[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'evolu_tpu' has no attribute {name!r}")
+
 __version__ = "0.1.0"
 
 __all__ = [
@@ -80,4 +106,17 @@ __all__ = [
     "mnemonic_to_owner_id",
     "Config",
     "__version__",
+    "Evolu",
+    "create_evolu",
+    "create_hooks",
+    "Hooks",
+    "QueryView",
+    "QueryBuilder",
+    "table",
+    "model",
+    "connect",
+    "RelayServer",
+    "RelayStore",
+    "generate_mnemonic",
+    "validate_mnemonic",
 ]

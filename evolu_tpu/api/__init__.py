@@ -11,4 +11,14 @@ package's query builder and model validators.
 from evolu_tpu.api import model
 from evolu_tpu.api.query import QueryBuilder, table
 
-__all__ = ["model", "QueryBuilder", "table"]
+__all__ = ["model", "QueryBuilder", "table", "Hooks", "QueryView", "create_hooks"]
+
+
+def __getattr__(name):
+    # hooks imports the runtime, which imports api.model — loading hooks
+    # lazily keeps `import evolu_tpu.runtime` acyclic.
+    if name in ("Hooks", "QueryView", "create_hooks"):
+        from evolu_tpu.api import hooks
+
+        return getattr(hooks, name)
+    raise AttributeError(f"module 'evolu_tpu.api' has no attribute {name!r}")

@@ -243,5 +243,11 @@ def apply_messages_chunked(
             raise ChunkedApplyError(merkle_tree, applied, e) from e
         applied += len(chunk)
         if on_chunk is not None:
-            on_chunk(merkle_tree, applied)
+            try:
+                on_chunk(merkle_tree, applied)
+            except Exception as e:
+                # The chunk IS committed; the caller still needs the tree
+                # covering it, so persistence-callback failures use the
+                # same partial-tree contract.
+                raise ChunkedApplyError(merkle_tree, applied, e) from e
     return merkle_tree

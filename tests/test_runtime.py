@@ -423,3 +423,28 @@ def test_restore_owner_signals_other_processes(tmp_path):
             w.stop()
     finally:
         evolu.dispose()
+
+
+def test_create_hooks_analog():
+    from evolu_tpu.api.hooks import create_hooks
+
+    hooks = create_hooks({"todo": ("title", "isCompleted")})
+    try:
+        assert not hooks.use_evolu_first_data_are_loaded()
+        view = hooks.use_query(lambda t: t("todo").select("title").order_by("createdAt"))
+        changes = []
+        unsub = view.subscribe(lambda: changes.append(list(view.rows)))
+        mutate = hooks.use_mutation()
+        mutate("todo", {"title": "a"})
+        hooks.evolu.worker.flush()
+        assert view.rows == [{"title": "a"}]
+        assert hooks.use_evolu_first_data_are_loaded()
+        assert changes and changes[-1] == [{"title": "a"}]
+        unsub()
+        mutate("todo", {"title": "b"})
+        hooks.evolu.worker.flush()
+        assert len(view.rows) == 2 and len(changes) == 1  # unsubscribed
+        assert hooks.use_owner() is hooks.evolu.owner
+        view.dispose()
+    finally:
+        hooks.evolu.dispose()
