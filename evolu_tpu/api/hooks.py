@@ -95,5 +95,9 @@ def create_hooks(schema, **evolu_kwargs) -> Hooks:
     from evolu_tpu.runtime.client import Evolu
 
     evolu = Evolu(**evolu_kwargs)
-    evolu.update_db_schema(schema)
-    return Hooks(evolu)
+    try:
+        evolu.update_db_schema(schema)
+        return Hooks(evolu)
+    except BaseException:
+        evolu.dispose()
+        raise

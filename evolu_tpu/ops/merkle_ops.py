@@ -91,7 +91,12 @@ def decode_owner_minute_deltas(
 ) -> Dict[int, Dict[str, int]]:
     """Host side: `owner_minute_segments` outputs → {owner_ix:
     {base3-minute-key: signed-int32 delta}} consumable by
-    `core.merkle.apply_prefix_xors`."""
+    `core.merkle.apply_prefix_xors`.
+
+    Repeated (owner, minute) keys XOR-combine: the owner-fleet layout
+    never splits an owner so keys are unique there, but the hot-owner
+    cell sharding produces one partial delta per shard per minute and
+    relies on the XOR merge being exact (associative/commutative)."""
     owner_sorted = np.asarray(owner_sorted)
     minute_sorted = np.asarray(minute_sorted)
     ends = np.asarray(seg_end) & np.asarray(valid_sorted)
@@ -99,7 +104,9 @@ def decode_owner_minute_deltas(
     out: Dict[int, Dict[str, int]] = {}
     for i in np.nonzero(ends)[0]:
         o_ix, minute = int(owner_sorted[i]), int(minute_sorted[i])
-        out.setdefault(o_ix, {})[minutes_base3(minute * 60000)] = to_int32(int(xs[i]))
+        key = minutes_base3(minute * 60000)
+        d = out.setdefault(o_ix, {})
+        d[key] = to_int32(d.get(key, 0) ^ int(xs[i]))
     return out
 
 

@@ -1,0 +1,136 @@
+"""Cell-range sharding for one hot owner.
+
+`parallel.reconcile` never splits an owner across shards — right for
+fleets of owners, wrong when ONE owner's batch exceeds a single
+device. Per-cell LWW merges are independent, so a hot owner's batch
+shards by cell id instead (SURVEY.md §5: "within one hot owner, by
+cell-id ranges after the radix sort"): each device plans a contiguous
+range of interned cell ids, per-minute Merkle XOR deltas are computed per shard and
+XOR-combined across shards (XOR is associative/commutative, so
+per-shard per-minute partial deltas merge exactly), and the batch
+digest is XOR-allreduced over ICI.
+
+Contract matches the single-device planner: masks in original batch
+order, {base3-minute-key: delta} dict, uint32 digest.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import functools
+
+from evolu_tpu.ops import bucket_size, with_x64
+from evolu_tpu.ops.encode import timestamp_hashes
+from evolu_tpu.ops.merge import _PAD_CELL, plan_merge_core
+from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
+from evolu_tpu.parallel.mesh import OWNERS_AXIS, sharding
+from evolu_tpu.parallel.reconcile import xor_allreduce
+from evolu_tpu.utils.log import span
+
+
+def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node):
+    n = cell_id.shape[0]
+    xor_mask, upsert_mask = plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments=n)
+    hashes = jnp.where(xor_mask, timestamp_hashes(millis, counter, node), jnp.uint32(0))
+    # hi key = 0 for every real row (single owner); segments = minutes.
+    zero_owner = jnp.zeros((), jnp.int32)
+    _, minute_sorted, seg_end, seg_xor, valid_sorted = owner_minute_segments(
+        zero_owner, millis, hashes, xor_mask
+    )
+    digest = xor_allreduce(jax.lax.reduce(hashes, jnp.uint32(0), jnp.bitwise_xor, (0,)))
+    return xor_mask, upsert_mask, minute_sorted, seg_end, seg_xor, valid_sorted, digest
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled_kernel(mesh: Mesh):
+    spec = P(OWNERS_AXIS)
+    return jax.jit(
+        shard_map(
+            _shard_kernel,
+            mesh=mesh,
+            in_specs=(spec,) * 8,
+            out_specs=(spec, spec, spec, spec, spec, spec, P()),
+            check_vma=False,
+        )
+    )
+
+
+@with_x64
+def reconcile_hot_owner(
+    mesh: Mesh,
+    cell_id: np.ndarray,
+    k1: np.ndarray,
+    k2: np.ndarray,
+    ex_k1: np.ndarray,
+    ex_k2: np.ndarray,
+    millis: np.ndarray,
+    counter: np.ndarray,
+    node: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray, Dict[str, int], int]:
+    """One owner's columnar batch, cells sharded over the mesh.
+
+    Returns (xor_mask, upsert_mask, minute_deltas, digest) with masks in
+    original batch order — identical to running `plan_merge_core` +
+    minute deltas on one device (property-tested in tests).
+    """
+    n = len(cell_id)
+    n_dev = mesh.devices.size
+    with span("kernel:reconcile", "reconcile_hot_owner", n=n, devices=n_dev):
+        # Assign cells (not rows) to shards so every message of a cell
+        # lands on the same device. Interned cell ids are dense
+        # (0..num_cells-1), so contiguous ranges balance well.
+        num_cells = int(cell_id.max()) + 1 if n else 1
+        shard_of = (cell_id.astype(np.int64) * n_dev) // num_cells
+        order = np.argsort(shard_of, kind="stable")
+        loads = np.bincount(shard_of, minlength=n_dev)
+        chunk = bucket_size(int(loads.max()) if n else 1)
+        total = n_dev * chunk
+
+        cols = {
+            "cell_id": np.full(total, int(_PAD_CELL), np.int32),
+            "k1": np.zeros(total, np.uint64),
+            "k2": np.zeros(total, np.uint64),
+            "ex_k1": np.zeros(total, np.uint64),
+            "ex_k2": np.zeros(total, np.uint64),
+            "millis": np.zeros(total, np.int64),
+            "counter": np.zeros(total, np.int32),
+            "node": np.zeros(total, np.uint64),
+        }
+        src = {"cell_id": cell_id, "k1": k1, "k2": k2, "ex_k1": ex_k1,
+               "ex_k2": ex_k2, "millis": millis, "counter": counter, "node": node}
+        # positions[i] = where original row i lives in the flat layout
+        positions = np.empty(n, np.int64)
+        start = 0
+        for d in range(n_dev):
+            rows = order[start : start + loads[d]]
+            dst = np.arange(d * chunk, d * chunk + loads[d])
+            positions[rows] = dst
+            for name, a in src.items():
+                cols[name][dst] = a[rows]
+            start += loads[d]
+
+        shd = sharding(mesh)
+        args = [jax.device_put(cols[k], shd) for k in
+                ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "millis", "counter", "node")]
+        xor_f, upsert_f, minute_sorted, seg_end, seg_xor, valid, digest = (
+            _compiled_kernel(mesh)(*args)
+        )
+
+        xor_mask = np.asarray(xor_f)[positions]
+        upsert_mask = np.asarray(upsert_f)[positions]
+
+        # XOR-combine per-minute deltas across shards (exact: XOR
+        # monoid; the shared decoder merges repeated minute keys).
+        minute_sorted = np.asarray(minute_sorted)
+        by_owner = decode_owner_minute_deltas(
+            np.zeros_like(minute_sorted), minute_sorted, seg_end, seg_xor, valid
+        )
+        deltas: Dict[str, int] = by_owner.get(0, {})
+        return xor_mask, upsert_mask, deltas, int(digest)

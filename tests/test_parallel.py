@@ -211,3 +211,60 @@ def test_batch_reconciler_idempotent_and_cross_device_fetch():
     r2 = _sync_req("u1", "e" * 16)
     (resp,) = engine.reconcile([r2])
     assert len(resp.messages) == len(msgs)
+
+
+def test_hot_owner_cell_sharding_matches_single_device():
+    """One hot owner's batch sharded by cell ranges over 8 devices must
+    produce the single-device planner's exact masks, minute deltas, and
+    digest (SURVEY.md §5 hot-owner strategy)."""
+    import numpy as np
+
+    from evolu_tpu.core.merkle import minutes_base3
+    from evolu_tpu.core.murmur import to_int32
+    from evolu_tpu.ops.encode import timestamp_hashes
+    from evolu_tpu.ops.merge import plan_merge_core
+    from evolu_tpu.ops.merkle_ops import merkle_minute_deltas, minute_deltas_to_dict
+    from evolu_tpu.parallel.hot_owner import reconcile_hot_owner
+    from evolu_tpu.parallel.mesh import create_mesh
+
+    rng = np.random.default_rng(13)
+    n = 3000
+    base = 1_700_000_000_000
+    cell_id = rng.integers(0, 400, n).astype(np.int32)
+    millis = base + rng.integers(0, 10 * 60_000, n).astype(np.int64)
+    counter = rng.integers(0, 16, n).astype(np.int32)
+    node = rng.integers(1, 2**63, n).astype(np.uint64)
+    k1 = (millis.astype(np.uint64) << np.uint64(16)) | counter.astype(np.uint64)
+    k2 = node.copy()
+    ex_k1 = np.zeros(n, np.uint64)
+    ex_k2 = np.zeros(n, np.uint64)
+    # Some cells have a stored winner mid-range.
+    with_winner = cell_id % 3 == 0
+    ex_k1[with_winner] = ((base + 5 * 60_000) << 16)
+    ex_k2[with_winner] = 7
+
+    mesh = create_mesh(8)
+    got_xor, got_upsert, got_deltas, got_digest = reconcile_hot_owner(
+        mesh, cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node
+    )
+
+    import jax
+
+    import jax.numpy as jnp
+
+    with jax.enable_x64(True):
+        args = tuple(jnp.asarray(a) for a in (cell_id, k1, k2, ex_k1, ex_k2))
+        exp_xor, exp_upsert = (
+            np.asarray(a) for a in plan_merge_core(*args, num_segments=n)
+        )
+        exp_deltas = minute_deltas_to_dict(
+            *merkle_minute_deltas(millis, counter, node, exp_xor)
+        )
+        hashes = np.asarray(timestamp_hashes(millis, counter, node))
+    np.testing.assert_array_equal(got_xor, exp_xor)
+    np.testing.assert_array_equal(got_upsert, exp_upsert)
+    assert got_deltas == exp_deltas
+    exp_digest = 0
+    for i in np.nonzero(exp_xor)[0]:
+        exp_digest ^= int(hashes[i])
+    assert got_digest == exp_digest
