@@ -148,6 +148,18 @@ def pack_ts_keys(millis, counter) -> jnp.ndarray:
     return (millis.astype(jnp.uint64) << jnp.uint64(16)) | counter.astype(jnp.uint64)
 
 
+@with_x64
+def unpack_ts_keys(k1):
+    """Inverse of `pack_ts_keys`: uint64 key → (millis int64,
+    counter int32). Owns the bit layout together with pack_ts_keys —
+    kernels recovering sorted timestamp columns from sort keys use
+    this instead of inlining shifts."""
+    k1 = jnp.asarray(k1, jnp.uint64)
+    millis = (k1 >> jnp.uint64(16)).astype(jnp.int64)
+    counter = (k1 & jnp.uint64(0xFFFF)).astype(jnp.int32)
+    return millis, counter
+
+
 def pack_ts_key_host(millis, counter):
     """Host twin of `pack_ts_keys` — same bit layout, numpy or Python ints.
 

@@ -69,37 +69,42 @@ def _segmented_max_scan(flags, k1, k2, reverse: bool = False):
     return m1, m2
 
 
-def plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments: int):
-    """The device LWW planner (traceable core — also called inside
-    `shard_map` by `evolu_tpu.parallel.reconcile`, where each shard
-    plans its owners' messages independently).
+def plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras=()):
+    """The device LWW planner in SORTED order (traceable core).
+
+    Sorts by (cell, batch order) and returns the masks in that sorted
+    order together with the permutation `i_s` (original index of each
+    sorted row), skipping the restoring sort — downstream device work
+    (hashing, minute segments) runs directly on the sorted rows and the
+    host unpermutes the two bool masks with one vectorized numpy
+    scatter. `extras` are additional per-row arrays carried through the
+    sort as payload operands and returned sorted.
 
     Args (all shape (N,), padding rows use cell_id=_PAD_CELL, keys 0):
       cell_id: int32 interned (table,row,column) id per message.
       k1, k2: uint64 HLC sort keys per message.
       ex_k1, ex_k2: uint64 stored-winner keys for the message's cell
         ((0,0) = no stored winner).
-      num_segments: static upper bound on distinct cells (unused by the
-        scan formulation; kept for signature stability).
 
-    Returns (xor_mask, upsert_mask) bools in original batch order.
+    Returns (xor_sorted, upsert_sorted, i_s, s1, s2, extras_sorted);
+    s1/s2 are the sorted HLC keys, from which callers recover the
+    sorted timestamp columns without extra payloads: millis = s1 >> 16,
+    counter = s1 & 0xFFFF, node = s2.
 
-    TPU notes: everything is one 32-bit-key sort + two segmented scans
-    + one restoring sort. No scatters and no segment_max/min (XLA
-    lowers those to serialized scatter updates on TPU — ~100ms+ per
-    call at N=1M vs ~15ms for a sort), and no post-sort gathers (the
-    HLC/winner keys ride through the sort as payload operands, ~8x
-    cheaper than four u64 gathers at N=1M).
+    TPU notes: one 32-bit-key sort + two segmented scans. No scatters
+    and no segment_max/min (XLA lowers those to serialized scatter
+    updates on TPU — ~100ms+ per call at N=1M vs ~15ms for a sort),
+    and no post-sort gathers (all per-row data rides through the sort
+    as payload operands, ~8x cheaper than u64 gathers at N=1M).
     """
-    del num_segments
     n = cell_id.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
 
-    # Sort by (cell, batch order), carrying the original index (for the
-    # restoring sort) and all per-row keys as payloads.
-    c, i_s, s1, s2, e1, e2 = jax.lax.sort(
-        (cell_id, idx, k1, k2, ex_k1, ex_k2), num_keys=2
+    sorted_ops = jax.lax.sort(
+        (cell_id, idx, k1, k2, ex_k1, ex_k2) + tuple(extras), num_keys=2
     )
+    c, i_s, s1, s2, e1, e2 = sorted_ops[:6]
+    extras_sorted = sorted_ops[6:]
 
     seg_start = jnp.concatenate([jnp.ones((1,), bool), c[1:] != c[:-1]])
 
@@ -128,9 +133,40 @@ def plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments: int):
     real = c != _PAD_CELL
     upsert_sorted = first_eligible & beats & real
     xor_sorted = xor_sorted & real
+    return xor_sorted, upsert_sorted, i_s, s1, s2, extras_sorted
 
-    # Restore original batch order with a sort by original index
-    # (a bitonic sort beats a 1M-element scatter on TPU).
+
+def unpermute_masks(xor_sorted, upsert_sorted, i_s, block_size: int = 0):
+    """Host side: sorted-order masks + permutation → original batch
+    order. With `block_size` > 0 the arrays are concatenated per-shard
+    blocks whose `i_s` values are shard-local (the shard_map layout);
+    each block unpermutes within its own span."""
+    xor_sorted = np.asarray(xor_sorted)
+    upsert_sorted = np.asarray(upsert_sorted)
+    i_s = np.asarray(i_s).astype(np.int64)
+    if block_size:
+        base = (np.arange(len(i_s), dtype=np.int64) // block_size) * block_size
+        i_s = i_s + base
+    xor_mask = np.empty_like(xor_sorted)
+    upsert_mask = np.empty_like(upsert_sorted)
+    xor_mask[i_s] = xor_sorted
+    upsert_mask[i_s] = upsert_sorted
+    return xor_mask, upsert_mask
+
+
+def plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments: int):
+    """Original-order planner: `plan_merge_sorted_core` plus a device
+    restoring sort. Kept for callers that need device-resident masks in
+    batch order; the shard kernels use the sorted variant and let the
+    host unpermute (saves a 1M-row sort per batch).
+
+    Returns (xor_mask, upsert_mask) bools in original batch order.
+    """
+    del num_segments
+    xor_sorted, upsert_sorted, i_s, _, _, _ = plan_merge_sorted_core(
+        cell_id, k1, k2, ex_k1, ex_k2
+    )
+    # A bitonic sort beats a 1M-element scatter on TPU.
     _, xor_mask, upsert_mask = jax.lax.sort(
         (i_s, xor_sorted, upsert_sorted), num_keys=1
     )

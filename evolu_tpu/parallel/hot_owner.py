@@ -27,8 +27,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 import functools
 
 from evolu_tpu.ops import bucket_size, with_x64
-from evolu_tpu.ops.encode import timestamp_hashes
-from evolu_tpu.ops.merge import _PAD_CELL, plan_merge_core
+from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
+from evolu_tpu.ops.merge import _PAD_CELL, plan_merge_sorted_core, unpermute_masks
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, sharding
 from evolu_tpu.parallel.reconcile import xor_allreduce
@@ -36,16 +36,17 @@ from evolu_tpu.utils.log import span
 
 
 def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node):
-    n = cell_id.shape[0]
-    xor_mask, upsert_mask = plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments=n)
-    hashes = jnp.where(xor_mask, timestamp_hashes(millis, counter, node), jnp.uint32(0))
+    del millis, counter, node  # recovered from the sorted HLC keys
+    xor_s, upsert_s, i_s, s1, s2, _ = plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2)
+    millis_s, counter_s = unpack_ts_keys(s1)
+    hashes = jnp.where(xor_s, timestamp_hashes(millis_s, counter_s, s2), jnp.uint32(0))
     # hi key = 0 for every real row (single owner); segments = minutes.
     zero_owner = jnp.zeros((), jnp.int32)
     _, minute_sorted, seg_end, seg_xor, valid_sorted = owner_minute_segments(
-        zero_owner, millis, hashes, xor_mask
+        zero_owner, millis_s, hashes, xor_s
     )
     digest = xor_allreduce(jax.lax.reduce(hashes, jnp.uint32(0), jnp.bitwise_xor, (0,)))
-    return xor_mask, upsert_mask, minute_sorted, seg_end, seg_xor, valid_sorted, digest
+    return xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid_sorted, digest
 
 
 @functools.lru_cache(maxsize=None)
@@ -56,7 +57,7 @@ def _compiled_kernel(mesh: Mesh):
             _shard_kernel,
             mesh=mesh,
             in_specs=(spec,) * 8,
-            out_specs=(spec, spec, spec, spec, spec, spec, P()),
+            out_specs=(spec,) * 7 + (P(),),
             check_vma=False,
         )
     )
@@ -119,12 +120,13 @@ def reconcile_hot_owner(
         shd = sharding(mesh)
         args = [jax.device_put(cols[k], shd) for k in
                 ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "millis", "counter", "node")]
-        xor_f, upsert_f, minute_sorted, seg_end, seg_xor, valid, digest = (
+        xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid, digest = (
             _compiled_kernel(mesh)(*args)
         )
 
-        xor_mask = np.asarray(xor_f)[positions]
-        upsert_mask = np.asarray(upsert_f)[positions]
+        xor_flat, upsert_flat = unpermute_masks(xor_s, upsert_s, i_s, block_size=chunk)
+        xor_mask = xor_flat[positions]
+        upsert_mask = upsert_flat[positions]
 
         # XOR-combine per-minute deltas across shards (exact: XOR
         # monoid; the shared decoder merges repeated minute keys).

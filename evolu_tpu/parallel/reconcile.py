@@ -30,8 +30,8 @@ from jax import shard_map
 
 from evolu_tpu.core.types import CrdtMessage
 from evolu_tpu.ops import bucket_size, with_x64
-from evolu_tpu.ops.encode import timestamp_hashes
-from evolu_tpu.ops.merge import _PAD_CELL, messages_to_columns, plan_merge_core
+from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
+from evolu_tpu.ops.merge import _PAD_CELL, messages_to_columns, plan_merge_sorted_core, unpermute_masks
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, sharding
 from evolu_tpu.utils.log import span
@@ -52,17 +52,29 @@ def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node, owner_ix
     """Per-shard reconcile: LWW plan + (owner, minute) XOR deltas +
     shard digest. All inputs are this shard's local (S,) slices.
 
-    The (owner, minute) segment key is an int32 pair — owner in the hi
-    key (sentinel int32-max for masked rows), JS-wrapped minute in the
-    lo key — so the segmented XOR sort stays fully 32-bit."""
-    n = cell_id.shape[0]
-    xor_mask, upsert_mask = plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments=n)
-    hashes = jnp.where(xor_mask, timestamp_hashes(millis, counter, node), jnp.uint32(0))
+    The whole shard pipeline runs in cell-sorted order: the sorted HLC
+    keys give back the timestamp columns (millis = s1 >> 16, counter =
+    s1 & 0xFFFF, node = s2), only owner_ix rides as an extra payload,
+    hashing and the (owner, minute) segmented XOR consume the sorted
+    rows directly, and the two bool masks return to the host with
+    `i_s` for a vectorized numpy unpermute — no device restoring
+    sort."""
+    del millis, counter, node  # all recovered from the sorted keys
+    xor_s, upsert_s, i_s, s1, s2, (owner_s,) = plan_merge_sorted_core(
+        cell_id, k1, k2, ex_k1, ex_k2, extras=(owner_ix.astype(jnp.int32),)
+    )
+    millis_s, counter_s = unpack_ts_keys(s1)
+    hashes = jnp.where(
+        xor_s, timestamp_hashes(millis_s, counter_s, s2), jnp.uint32(0)
+    )
     owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted = owner_minute_segments(
-        owner_ix, millis, hashes, xor_mask
+        owner_s, millis_s, hashes, xor_s
     )
     digest = xor_allreduce(jax.lax.reduce(hashes, jnp.uint32(0), jnp.bitwise_xor, (0,)))
-    return xor_mask, upsert_mask, owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, digest
+    return (
+        xor_s, upsert_s, i_s,
+        owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, digest,
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -72,7 +84,7 @@ def _compiled_kernel(mesh: Mesh):
         _shard_kernel,
         mesh=mesh,
         in_specs=(spec,) * 9,
-        out_specs=(spec, spec, spec, spec, spec, spec, spec, P()),
+        out_specs=(spec,) * 8 + (P(),),
         check_vma=False,
     )
     return jax.jit(mapped)
@@ -82,8 +94,10 @@ def _compiled_kernel(mesh: Mesh):
 def reconcile_columns_sharded(mesh: Mesh, cols: Dict[str, np.ndarray]):
     """Run the sharded kernel on flat global columns (length D*S, owner
     blocks laid out shard-contiguously). Returns device arrays:
-    (xor_mask, upsert_mask, owner_sorted, minute_sorted, seg_end,
-    seg_xor, seg_valid, digest)."""
+    (xor_sorted, upsert_sorted, i_s, owner_sorted, minute_sorted,
+    seg_end, seg_xor, seg_valid, digest) — masks are in per-shard
+    cell-sorted order; `unpermute_masks(..., block_size=shard_size)`
+    restores batch order on the host."""
     shd = sharding(mesh)
     args = [
         jax.device_put(cols[k], shd)
@@ -171,11 +185,11 @@ def reconcile_owner_batches(
 
 def _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners):
     cols, index = build_owner_columns(mesh, owner_batches, existing_winners)
-    xor_mask, upsert_mask, owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid, digest = (
+    xor_s, upsert_s, i_s, owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid, digest = (
         reconcile_columns_sharded(mesh, cols)
     )
-    xor_mask = np.asarray(xor_mask)
-    upsert_mask = np.asarray(upsert_mask)
+    shard_size = len(cols["cell_id"]) // mesh.devices.size
+    xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s, block_size=shard_size)
     deltas_by_ix = decode_owner_minute_deltas(
         owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid
     )

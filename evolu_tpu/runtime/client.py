@@ -65,6 +65,7 @@ class Evolu:
         self._batch = threading.local()
         self._on_reload: Optional[Callable[[], None]] = None
         self._reload_watcher = None  # started by on_reload(cross_process=True)
+        self._auto_syncer = None  # started by sync.client.connect
         self._transport = None  # set by attach_transport
         self.worker = DbWorker(
             self.db,
@@ -395,6 +396,8 @@ class Evolu:
             fn()
 
     def dispose(self) -> None:
+        if self._auto_syncer is not None:
+            self._auto_syncer.stop()
         self.worker.stop()
         if self._reload_watcher is not None:
             self._reload_watcher.stop()

@@ -140,14 +140,48 @@ def _http_post(url: str, body: bytes) -> bytes:
         return resp.read()
 
 
+class PeriodicSyncer:
+    """Timer analog of the reference's load/online/focus sync triggers
+    (db.ts:390-412): posts a pull-only sync round every `interval`
+    seconds until stopped."""
+
+    def __init__(self, evolu, interval: float):
+        self._evolu = evolu
+        self._interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="evolu-autosync")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self._evolu.sync(refresh_queries=False)
+            except Exception:  # noqa: BLE001 — never kill the timer
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not threading.current_thread():
+            self._thread.join()
+
+
 def connect(evolu, config: Optional[Config] = None) -> SyncTransport:
     """Wire a client to its relay: transport → Evolu.receive, and
-    Evolu's post_sync → transport (db.ts:134-156's channel setup)."""
+    Evolu's post_sync → transport (db.ts:134-156's channel setup).
+    When the config sets `sync_interval`, a periodic pull starts too
+    (stopped by `evolu.dispose()`)."""
+    cfg = config or evolu.config
     transport = SyncTransport(
-        config or evolu.config,
+        cfg,
         on_receive=evolu.receive,
         sync_lock=evolu.worker.sync_lock,
         on_error=lambda e: evolu._dispatch_output(OnError(e)),
     )
     evolu.attach_transport(transport)
+    prev = getattr(evolu, "_auto_syncer", None)
+    if prev is not None:
+        prev.stop()
+        evolu._auto_syncer = None
+    if cfg.sync_interval:
+        evolu._auto_syncer = PeriodicSyncer(evolu, cfg.sync_interval)
     return transport

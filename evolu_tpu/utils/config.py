@@ -18,6 +18,10 @@ class Config:
     max_drift: int = 60000  # config.ts:9
     reload_url: str = "/"
     # TPU-native extensions (no reference equivalent):
+    # Periodic pull interval in seconds (None = only explicit sync()).
+    # The reference syncs on load/online/focus browser events
+    # (db.ts:390-412); a headless process needs a timer instead.
+    sync_interval: "float | None" = None
     backend: str = "auto"  # "cpu" | "tpu" | "auto" — merge kernel backend
     min_device_batch: int = 1024  # below this, the CPU oracle path is faster than dispatch
 

@@ -1,0 +1,161 @@
+"""Failure detection + fault injection.
+
+The reference's failure story (SURVEY.md §5): offline tolerance
+(FetchError swallowed), sync-livelock detection via a repeated Merkle
+diff ⇒ SyncError (receive.ts:99-104), transactional rollback. The
+reference has no fault-injection tests; these add them: livelock
+surfacing, convergence under a flaky transport, and thread-safety
+under concurrent mutators (races the reference prevents only by
+browser architecture).
+"""
+
+import os
+import random
+import threading
+
+import pytest
+
+from evolu_tpu.core.types import SyncError
+from evolu_tpu.runtime.client import Evolu, create_evolu
+from evolu_tpu.server.relay import RelayServer, RelayStore
+from evolu_tpu.sync import client as sync_client
+from evolu_tpu.utils.config import Config
+
+
+def test_sync_livelock_raises_sync_error():
+    """A server diff identical to previous_diff must surface SyncError
+    (receive.ts:99-104, types.ts:371-378) instead of looping forever."""
+    evolu = create_evolu({"todo": ("title",)})
+    try:
+        errors = []
+        evolu.subscribe_error(errors.append)
+        evolu.create("todo", {"title": "x"})
+        evolu.worker.flush()
+
+        # A server tree that differs from ours (empty) produces a diff D.
+        # Replaying the same response with previous_diff=D simulates the
+        # server still diverged at the same minute => livelock.
+        from evolu_tpu.core.merkle import (
+            create_initial_merkle_tree,
+            diff_merkle_trees,
+            insert_into_merkle_tree,
+            merkle_tree_to_string,
+        )
+        from evolu_tpu.core.timestamp import Timestamp
+
+        server_tree = insert_into_merkle_tree(
+            Timestamp(1_700_000_000_000, 0, "b" * 16), create_initial_merkle_tree()
+        )
+        from evolu_tpu.storage.clock import read_clock
+
+        local = read_clock(evolu.db).merkle_tree
+        diff = diff_merkle_trees(server_tree, local)
+        assert diff is not None
+
+        evolu.receive((), merkle_tree_to_string(server_tree), previous_diff=diff)
+        evolu.worker.flush()
+        assert errors and isinstance(errors[0], SyncError)
+    finally:
+        evolu.dispose()
+
+
+def test_convergence_with_flaky_transport(tmp_path):
+    """30% of HTTP posts fail (connection errors): clients stay up
+    (offline tolerance, sync.worker.ts:217-227) and converge once
+    enough rounds get through."""
+    server = RelayServer(RelayStore(str(tmp_path / "relay.db"))).start()
+    try:
+        cfg = Config(sync_url=server.url + "/")
+        rng = random.Random(17)
+        real_post = sync_client._http_post
+
+        def flaky_post(url, body):
+            if rng.random() < 0.3:
+                raise OSError("injected network failure")
+            return real_post(url, body)
+
+        def mk(path, mnemonic=None):
+            e = Evolu(db_path=str(tmp_path / path), config=cfg, mnemonic=mnemonic)
+            e.update_db_schema({"todo": ("title",)})
+            t = sync_client.SyncTransport(
+                cfg, on_receive=e.receive, sync_lock=e.worker.sync_lock,
+                http_post=flaky_post,
+            )
+            e.attach_transport(t)
+            return e, t
+
+        a, ta = mk("a.db")
+        b, tb = mk("b.db", a.owner.mnemonic)
+        for i in range(30):
+            (a if i % 2 else b).create("todo", {"title": f"t{i}"})
+
+        # Injected failures make any fixed round count probabilistic:
+        # poll until both replicas converge (or a generous deadline).
+        import time as _time
+
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            for c, t in ((a, ta), (b, tb)):
+                c.sync()
+                c.worker.flush(); t.flush(); c.worker.flush()
+            rows_a = a.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+            rows_b = b.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+            if len(rows_a) == len(rows_b) == 90 and rows_a == rows_b:
+                break
+        assert len(rows_a) == len(rows_b) == 90  # 30 creates x 3 columns
+        assert rows_a == rows_b
+        a.dispose(), b.dispose()
+    finally:
+        server.stop()
+
+
+def test_concurrent_mutators_thread_safety():
+    """16 threads hammer one client: every mutation must land exactly
+    once and the worker's single-writer discipline must hold."""
+    evolu = create_evolu({"todo": ("title", "n")})
+    try:
+        n_threads, per_thread = 16, 25
+        errors = []
+        evolu.subscribe_error(errors.append)
+
+        def writer(t):
+            for i in range(per_thread):
+                evolu.create("todo", {"title": f"t{t}-{i}", "n": t * 1000 + i})
+
+        threads = [threading.Thread(target=writer, args=(t,)) for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        evolu.worker.flush()
+
+        rows = evolu.query_once('SELECT "title" FROM "todo"')
+        assert len(rows) == n_threads * per_thread
+        assert len({r["title"] for r in rows}) == n_threads * per_thread
+        assert not errors, errors[:3]
+    finally:
+        evolu.dispose()
+
+
+def test_transaction_rollback_on_mid_batch_failure():
+    """A batch containing a poisoned message must roll back whole —
+    the reference's per-command dbTransaction semantics
+    (db.worker.ts:71-73); no partial rows, no partial __message."""
+    from evolu_tpu.core.types import CrdtMessage
+    from evolu_tpu.storage.apply import apply_messages
+    from evolu_tpu.storage.schema import init_db_model
+    from evolu_tpu.storage.native import open_database
+
+    for backend in ("python", "native"):
+        db = open_database(backend=backend)
+        init_db_model(db, mnemonic=None)
+        db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title" BLOB)')
+        good = CrdtMessage(
+            "2024-01-01T00:00:00.000Z-0000-" + "a" * 16, "todo", "r1", "title", "ok"
+        )
+        bad = CrdtMessage("garbage-timestamp", "todo", "r2", "title", "boom")
+        with pytest.raises(Exception):
+            apply_messages(db, {}, [good, bad])
+        assert db.exec('SELECT COUNT(*) FROM "__message"') == [(0,)]
+        assert db.exec('SELECT COUNT(*) FROM "todo"') == [(0,)]
+        db.close()

@@ -281,3 +281,36 @@ def test_s2k_salted_and_simple_types():
     bad = crypto._new_packet(3, bytes([4, crypto.SYM_AES256, 1, 2]) + salt)  # SHA-1
     with _pytest.raises(crypto.PgpError, match="S2K hash"):
         crypto.decrypt_symmetric(bad + seipd, "pw")
+
+
+def test_periodic_sync_trigger(tmp_path):
+    """config.sync_interval drives automatic pull rounds — the headless
+    analog of the reference's load/online/focus triggers."""
+    import time as _time
+
+    from evolu_tpu.runtime.client import Evolu
+    from evolu_tpu.server.relay import RelayServer, RelayStore
+    from evolu_tpu.sync.client import connect
+    from evolu_tpu.utils.config import Config
+
+    server = RelayServer(RelayStore(str(tmp_path / "relay.db"))).start()
+    try:
+        cfg = Config(sync_url=server.url + "/", sync_interval=0.05)
+        a = Evolu(db_path=str(tmp_path / "a.db"), config=cfg)
+        a.update_db_schema({"todo": ("title",)})
+        connect(a)
+        b = Evolu(db_path=str(tmp_path / "b.db"), config=cfg, mnemonic=a.owner.mnemonic)
+        b.update_db_schema({"todo": ("title",)})
+        connect(b)
+
+        a.create("todo", {"title": "auto"})
+        deadline = _time.time() + 10
+        while _time.time() < deadline:
+            rows = b.db.exec('SELECT COUNT(*) FROM "__message"')
+            if rows == [(3,)]:
+                break
+            _time.sleep(0.05)
+        assert b.db.exec('SELECT COUNT(*) FROM "__message"') == [(3,)]
+        a.dispose(), b.dispose()
+    finally:
+        server.stop()
