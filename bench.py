@@ -100,7 +100,7 @@ def main():
     cols, total = shard_layout(build_columns(), n_dev)
 
     shd = sharding(mesh)
-    names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "millis", "counter", "node", "owner_ix")
+    names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
     args = [jax.device_put(cols[k], shd) for k in names]
 
     # Sustained throughput: run INNER_ITERS back-to-back pipeline
@@ -111,13 +111,12 @@ def main():
     # round-trip (which under the axon tunnel is ~80ms of pure RTT).
     spec = P("owners")
 
-    def shard_loop(cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node, owner_ix):
+    def shard_loop(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
         def body(i, acc):
             # Perturb the HLC tie-break key per iteration so XLA cannot
             # CSE iterations; cell structure and padding stay intact.
             outs = _shard_kernel(
-                cell_id, k1, k2 ^ i.astype(jnp.uint64), ex_k1, ex_k2,
-                millis, counter, node, owner_ix,
+                cell_id, k1, k2 ^ i.astype(jnp.uint64), ex_k1, ex_k2, owner_ix,
             )
             # Fold outputs into the carry so every iteration's pipeline
             # is live; psum makes the carry replicated across shards.
@@ -131,7 +130,7 @@ def main():
             shard_map(
                 shard_loop,
                 mesh=mesh,
-                in_specs=(spec,) * 9,
+                in_specs=(spec,) * 6,
                 out_specs=P(),
                 check_vma=False,
             )

@@ -1,0 +1,73 @@
+"""BASELINE config 4: 64 replicas editing the same 100 rows — HLC
+(counter, node) tie-break correctness under maximal collision, plus
+the merge throughput on that adversarial shape.
+
+Prints one JSON line; "correct" asserts byte-level agreement between
+the device-planned end state and the sequential TS-semantics oracle.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.storage.apply import apply_messages, apply_messages_sequential
+from evolu_tpu.storage.native import open_database
+from evolu_tpu.storage.schema import init_db_model
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+from test_convergence import make_contention_workload  # noqa: E402
+
+
+def fresh():
+    db = open_database(backend="auto")
+    init_db_model(db, mnemonic=None)
+    db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title" BLOB, "n" BLOB)')
+    return db
+
+
+def dump(db):
+    return (
+        db.exec('SELECT * FROM "todo" ORDER BY "id"'),
+        db.exec('SELECT * FROM "__message" ORDER BY "timestamp"'),
+    )
+
+
+def main():
+    messages = make_contention_workload(n_replicas=64, n_rows=100, writes_per_replica=60)
+
+    from evolu_tpu.ops.merge import plan_batch_device_full
+
+    plan_batch_device_full(messages, {})  # warm the jit bucket
+
+    device_db = fresh()
+    t0 = time.perf_counter()
+    apply_messages(device_db, {}, messages, planner=plan_batch_device_full)
+    device_s = time.perf_counter() - t0
+
+    oracle_db = fresh()
+    t0 = time.perf_counter()
+    with oracle_db.transaction():
+        apply_messages_sequential(oracle_db, {}, messages)
+    oracle_s = time.perf_counter() - t0
+
+    correct = dump(device_db) == dump(oracle_db)
+    print(json.dumps({
+        "metric": "config4_contention_msgs_per_sec",
+        "value": round(len(messages) / device_s),
+        "unit": "msgs/sec",
+        "detail": {
+            "messages": len(messages), "replicas": 64, "rows": 100,
+            "correct_vs_oracle": correct,
+            "device_s": round(device_s, 3), "oracle_s": round(oracle_s, 3),
+            "speedup_vs_sequential": round(oracle_s / device_s, 2),
+        },
+    }))
+    assert correct, "device plan diverged from sequential oracle"
+    device_db.close(), oracle_db.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""BASELINE config 5: 10M messages, owners sharded over the device
+mesh, Merkle digests XOR-combined across devices over ICI.
+
+On real TPU hardware this uses every local chip; under the CPU test
+env set XLA_FLAGS=--xla_force_host_platform_device_count=8 to exercise
+the 8-way mesh semantics.
+
+Prints one JSON line.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+N = 10_000_000
+OWNERS = 1_000
+INNER_ITERS = 2
+
+
+def main():
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import bench
+
+    from evolu_tpu.parallel.mesh import create_mesh, sharding
+    from evolu_tpu.parallel.reconcile import _shard_kernel
+
+    mesh = create_mesh()
+    n_dev = mesh.devices.size
+    cols, total = bench.shard_layout(bench.build_columns(n=N, owners=OWNERS), n_dev)
+
+    shd = sharding(mesh)
+    names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
+    args = [jax.device_put(cols[k], shd) for k in names]
+
+    spec = P("owners")
+
+    def shard_loop(*xs):
+        def body(i, acc):
+            outs = _shard_kernel(xs[0], xs[1], xs[2] ^ i.astype(jnp.uint64), *xs[3:])
+            masked = jax.lax.psum(outs[0].astype(jnp.int64).sum(), "owners")
+            return acc + masked + outs[-1].astype(jnp.int64)
+
+        return jax.lax.fori_loop(0, INNER_ITERS, body, jnp.int64(0))
+
+    with jax.enable_x64(True):
+        looped = jax.jit(shard_map(
+            shard_loop, mesh=mesh, in_specs=(spec,) * 6, out_specs=P(), check_vma=False,
+        ))
+        np.asarray(looped(*args))
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            np.asarray(looped(*args))
+            times.append(time.perf_counter() - t0)
+    p50 = statistics.median(times)
+    total_rate = INNER_ITERS * N / p50
+    print(json.dumps({
+        "metric": "config5_mesh_msgs_per_sec",
+        "value": round(total_rate),
+        "unit": "msgs/sec",
+        "detail": {
+            "batch": N, "owners": OWNERS, "devices": n_dev,
+            "per_chip": round(total_rate / n_dev),
+            "p50_ms": round(p50 * 1e3, 3),
+            "platform": jax.devices()[0].platform,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

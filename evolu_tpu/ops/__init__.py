@@ -18,8 +18,21 @@ wrappers convert inside the x64 scope so 64-bit dtypes survive.
 """
 
 import functools
+import os
 
 import jax
+
+# Cold-start relief: kernels compile once per power-of-two bucket; a
+# persistent compilation cache makes that a per-machine (not
+# per-process) cost. Only set when the embedder hasn't configured one.
+if jax.config.jax_compilation_cache_dir is None and "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    _cache = os.path.join(os.path.expanduser("~"), ".cache", "evolu_tpu", "jax")
+    try:
+        os.makedirs(_cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except OSError:
+        pass  # read-only home: stay with in-memory compilation only
 
 
 def with_x64(fn):

@@ -180,35 +180,35 @@ def messages_to_columns(
     messages: Sequence[CrdtMessage],
     existing_winners: Dict[Tuple[str, str, str], str],
 ):
-    """Host-side columnarization: intern cells, parse timestamps, pack keys.
+    """Host-side columnarization: intern cells, parse timestamps, pack
+    keys — fully vectorized (numpy); no per-message Python. A malformed
+    timestamp raises TimestampParseError for the whole batch (matching
+    the scalar parser's abort-the-transaction behavior).
 
     Returns numpy arrays (cell_id, k1, k2, ex_k1, ex_k2) plus the parsed
     (millis, counter, node_u64) columns for the Merkle kernel.
     """
-    n = len(messages)
-    cell_ids = np.empty(n, np.int32)
-    millis = np.empty(n, np.int64)
-    counter = np.empty(n, np.int32)
-    node = np.empty(n, np.uint64)
-    ex_k1 = np.zeros(n, np.uint64)
-    ex_k2 = np.zeros(n, np.uint64)
-    intern: Dict[Tuple[str, str, str], int] = {}
-    ex_cache: Dict[int, Tuple[int, int]] = {}
-    for i, m in enumerate(messages):
-        cell = (m.table, m.row, m.column)
-        cid = intern.setdefault(cell, len(intern))
-        cell_ids[i] = cid
-        t = timestamp_from_string(m.timestamp)
-        millis[i], counter[i] = t.millis, t.counter
-        node[i] = node_hex_to_u64(t.node)
-        if cid not in ex_cache:
-            w = existing_winners.get(cell)
-            if w is None:
-                ex_cache[cid] = (0, 0)
-            else:
-                wt = timestamp_from_string(w)
-                ex_cache[cid] = (pack_ts_key_host(wt.millis, wt.counter), node_hex_to_u64(wt.node))
-        ex_k1[i], ex_k2[i] = ex_cache[cid]
+    from evolu_tpu.ops.host_parse import intern_cells, parse_timestamp_strings
+
+    millis, counter, node = parse_timestamp_strings([m.timestamp for m in messages])
+    cell_ids, cells = intern_cells(
+        [m.table for m in messages], [m.row for m in messages],
+        [m.column for m in messages],
+    )
+
+    # Stored winners per unique cell (parsed as one vectorized batch).
+    winner_cids = [i for i, cell in enumerate(cells) if cell in existing_winners]
+    ex1_u = np.zeros(len(cells), np.uint64)
+    ex2_u = np.zeros(len(cells), np.uint64)
+    if winner_cids:
+        w_millis, w_counter, w_node = parse_timestamp_strings(
+            [existing_winners[cells[i]] for i in winner_cids]
+        )
+        ex1_u[winner_cids] = pack_ts_key_host(w_millis, w_counter)
+        ex2_u[winner_cids] = w_node
+    ex_k1 = ex1_u[cell_ids]
+    ex_k2 = ex2_u[cell_ids]
+
     k1 = pack_ts_key_host(millis, counter)
     k2 = node
     return cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node
@@ -253,3 +253,54 @@ def _plan_batch_device_timed(messages, existing_winners):
     upsert_mask = np.asarray(upsert_mask)[:n]
     upserts: List[CrdtMessage] = [m for i, m in enumerate(messages) if upsert_mask[i]]
     return list(map(bool, xor_mask)), upserts
+
+
+@jax.jit
+def _plan_full_kernel(cell_id, k1, k2, ex_k1, ex_k2):
+    """Masks + per-minute Merkle XOR deltas in ONE dispatch, all in the
+    planner's cell-sorted order (timestamp columns recovered from the
+    sorted HLC keys; the single-owner minute segmentation runs with
+    owner key 0)."""
+    from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
+    from evolu_tpu.ops.merkle_ops import owner_minute_segments
+
+    xor_s, upsert_s, i_s, s1, s2, _ = plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2)
+    millis_s, counter_s = unpack_ts_keys(s1)
+    hashes = jnp.where(xor_s, timestamp_hashes(millis_s, counter_s, s2), jnp.uint32(0))
+    zero_owner = jnp.zeros((), jnp.int32)
+    _, minute_sorted, seg_end, seg_xor, valid_sorted = owner_minute_segments(
+        zero_owner, millis_s, hashes, xor_s
+    )
+    return xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid_sorted
+
+
+@with_x64
+def plan_batch_device_full(
+    messages: Sequence[CrdtMessage],
+    existing_winners: Dict[Tuple[str, str, str], str],
+):
+    """Like `plan_batch_device` but ALSO returns the per-minute Merkle
+    XOR deltas computed on device — `(xor_mask, upserts, deltas)` — so
+    the apply path never hashes timestamps in Python (the reference's
+    hot loop #4 eliminated host-side)."""
+    from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas
+
+    n = len(messages)
+    if n == 0:
+        return [], [], {}
+    with span("kernel:merge", "plan_batch_device_full", n=n):
+        cell_ids, k1, k2, ex_k1, ex_k2, *_ = messages_to_columns(messages, existing_winners)
+        (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns(
+            [cell_ids, k1, k2, ex_k1, ex_k2], n
+        )
+        xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = _plan_full_kernel(
+            jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
+            jnp.asarray(ex_k1), jnp.asarray(ex_k2),
+        )
+        xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s)
+        xor_mask, upsert_mask = xor_mask[:n], upsert_mask[:n]
+        deltas = decode_owner_minute_deltas(
+            np.zeros(size, np.int32), minute_sorted, seg_end, seg_xor, valid
+        ).get(0, {})
+        upserts: List[CrdtMessage] = [m for i, m in enumerate(messages) if upsert_mask[i]]
+        return list(map(bool, xor_mask)), upserts, deltas

@@ -35,8 +35,7 @@ from evolu_tpu.parallel.reconcile import xor_allreduce
 from evolu_tpu.utils.log import span
 
 
-def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node):
-    del millis, counter, node  # recovered from the sorted HLC keys
+def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2):
     xor_s, upsert_s, i_s, s1, s2, _ = plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2)
     millis_s, counter_s = unpack_ts_keys(s1)
     hashes = jnp.where(xor_s, timestamp_hashes(millis_s, counter_s, s2), jnp.uint32(0))
@@ -56,7 +55,7 @@ def _compiled_kernel(mesh: Mesh):
         shard_map(
             _shard_kernel,
             mesh=mesh,
-            in_specs=(spec,) * 8,
+            in_specs=(spec,) * 5,
             out_specs=(spec,) * 7 + (P(),),
             check_vma=False,
         )
@@ -94,18 +93,16 @@ def reconcile_hot_owner(
         chunk = bucket_size(int(loads.max()) if n else 1)
         total = n_dev * chunk
 
+        # millis/counter/node are recovered on device from the HLC keys;
+        # only the key columns are laid out and transferred.
         cols = {
             "cell_id": np.full(total, int(_PAD_CELL), np.int32),
             "k1": np.zeros(total, np.uint64),
             "k2": np.zeros(total, np.uint64),
             "ex_k1": np.zeros(total, np.uint64),
             "ex_k2": np.zeros(total, np.uint64),
-            "millis": np.zeros(total, np.int64),
-            "counter": np.zeros(total, np.int32),
-            "node": np.zeros(total, np.uint64),
         }
-        src = {"cell_id": cell_id, "k1": k1, "k2": k2, "ex_k1": ex_k1,
-               "ex_k2": ex_k2, "millis": millis, "counter": counter, "node": node}
+        src = {"cell_id": cell_id, "k1": k1, "k2": k2, "ex_k1": ex_k1, "ex_k2": ex_k2}
         # positions[i] = where original row i lives in the flat layout
         positions = np.empty(n, np.int64)
         start = 0
@@ -119,7 +116,7 @@ def reconcile_hot_owner(
 
         shd = sharding(mesh)
         args = [jax.device_put(cols[k], shd) for k in
-                ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "millis", "counter", "node")]
+                ("cell_id", "k1", "k2", "ex_k1", "ex_k2")]
         xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid, digest = (
             _compiled_kernel(mesh)(*args)
         )

@@ -48,7 +48,7 @@ def xor_allreduce(x, axis_name: str = OWNERS_AXIS):
     return jax.lax.reduce(gathered, jnp.uint32(0), jnp.bitwise_xor, (0,))
 
 
-def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node, owner_ix):
+def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
     """Per-shard reconcile: LWW plan + (owner, minute) XOR deltas +
     shard digest. All inputs are this shard's local (S,) slices.
 
@@ -59,7 +59,6 @@ def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node, owner_ix
     rows directly, and the two bool masks return to the host with
     `i_s` for a vectorized numpy unpermute — no device restoring
     sort."""
-    del millis, counter, node  # all recovered from the sorted keys
     xor_s, upsert_s, i_s, s1, s2, (owner_s,) = plan_merge_sorted_core(
         cell_id, k1, k2, ex_k1, ex_k2, extras=(owner_ix.astype(jnp.int32),)
     )
@@ -83,7 +82,7 @@ def _compiled_kernel(mesh: Mesh):
     mapped = shard_map(
         _shard_kernel,
         mesh=mesh,
-        in_specs=(spec,) * 9,
+        in_specs=(spec,) * 6,
         out_specs=(spec,) * 8 + (P(),),
         check_vma=False,
     )
@@ -101,7 +100,7 @@ def reconcile_columns_sharded(mesh: Mesh, cols: Dict[str, np.ndarray]):
     shd = sharding(mesh)
     args = [
         jax.device_put(cols[k], shd)
-        for k in ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "millis", "counter", "node", "owner_ix")
+        for k in ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
     ]
     return _compiled_kernel(mesh)(*args)
 
@@ -134,6 +133,9 @@ def build_owner_columns(
     shard_len = max((sum(len(owner_batches[o]) for o in s) for s in shards), default=0)
     shard_size = bucket_size(max(shard_len, 1))
 
+    # Timestamp columns are NOT laid out: the kernels recover
+    # millis/counter/node from the sorted HLC keys, so transferring
+    # them would be dead H2D traffic.
     total = n_shards * shard_size
     out = {
         "cell_id": np.full(total, int(_PAD_CELL), np.int32),
@@ -141,22 +143,18 @@ def build_owner_columns(
         "k2": np.zeros(total, np.uint64),
         "ex_k1": np.zeros(total, np.uint64),
         "ex_k2": np.zeros(total, np.uint64),
-        "millis": np.zeros(total, np.int64),
-        "counter": np.zeros(total, np.int32),
-        "node": np.zeros(total, np.uint64),
         "owner_ix": np.zeros(total, np.int64),
     }
     index: Dict[str, Tuple[np.ndarray, int]] = {}
     for si, shard in enumerate(shards):
         pos = si * shard_size
         for o in shard:
-            cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node = per_owner[o]
+            cell_ids, k1, k2, ex_k1, ex_k2, _millis, _counter, _node = per_owner[o]
             n = len(cell_ids)
             sl = slice(pos, pos + n)
             out["cell_id"][sl] = cell_ids
             out["k1"][sl], out["k2"][sl] = k1, k2
             out["ex_k1"][sl], out["ex_k2"][sl] = ex_k1, ex_k2
-            out["millis"][sl], out["counter"][sl], out["node"][sl] = millis, counter, node
             out["owner_ix"][sl] = owner_ix[o]
             index[o] = (np.arange(pos, pos + n), owner_ix[o])
             pos += n

@@ -52,13 +52,16 @@ def select_planner(config: Config) -> Callable:
     if config.backend == "cpu":
         return plan_batch
 
-    from evolu_tpu.ops.merge import plan_batch_device
+    from evolu_tpu.ops.merge import plan_batch_device_full
 
     threshold = 0 if config.backend == "tpu" else config.min_device_batch
 
     def planner(batch, existing):
         if len(batch) >= threshold:
-            return plan_batch_device(batch, existing)
+            # Returns (xor_mask, upserts, deltas): the device also
+            # computes the Merkle minute deltas, so the apply path does
+            # no per-message Python hashing.
+            return plan_batch_device_full(batch, existing)
         return plan_batch(batch, existing)
 
     return planner

@@ -87,8 +87,10 @@ def fetch_existing_winners(
     cells = list(cells)
     if not cells:
         return {}
-    if hasattr(db, "fetch_winners"):
-        # C++ backend: per-cell indexed lookups in one native call.
+    if hasattr(db, "fetch_winners") and len(cells) < 4096:
+        # C++ backend: per-cell indexed lookups in one native call —
+        # fastest for small batches; above ~4k cells the single
+        # temp-table GROUP BY join below wins (one scan vs N probes).
         winners = db.fetch_winners(cells)
         return {c: w for c, w in zip(cells, winners) if w is not None}
     with db.transaction():
@@ -153,20 +155,26 @@ def apply_messages(
     with db.transaction():  # whole-batch atomicity, like the reference's dbTransaction
         cells = {(m.table, m.row, m.column) for m in messages}
         existing = fetch_existing_winners(db, cells)
-        xor_mask, upserts = (planner or plan_batch)(messages, existing)
-
-        # Merkle deltas: aggregate XOR per minute key. Computed BEFORE any
-        # write so a malformed timestamp rolls the whole batch back —
-        # committing messages whose hashes never reach the tree would
-        # diverge the digest permanently. Hash the canonical re-rendered
-        # form (timestamp_to_hash), exactly as the sequential oracle does
-        # — raw wire strings may be non-canonical.
-        deltas: Dict[str, int] = {}
-        for i, m in enumerate(messages):
-            if xor_mask[i]:
-                ts = timestamp_from_string(m.timestamp)
-                key = minutes_base3(ts.millis)
-                deltas[key] = to_int32(deltas.get(key, 0) ^ timestamp_to_hash(ts))
+        plan = (planner or plan_batch)(messages, existing)
+        if len(plan) == 3:
+            # Device planner: masks AND per-minute Merkle deltas in one
+            # dispatch (no per-message Python hashing).
+            xor_mask, upserts, deltas = plan
+        else:
+            xor_mask, upserts = plan
+            # Merkle deltas: aggregate XOR per minute key. Computed BEFORE
+            # any write so a malformed timestamp rolls the whole batch
+            # back — committing messages whose hashes never reach the tree
+            # would diverge the digest permanently. Hash the canonical
+            # re-rendered form (timestamp_to_hash), exactly as the
+            # sequential oracle does — raw wire strings may be
+            # non-canonical.
+            deltas = {}
+            for i, m in enumerate(messages):
+                if xor_mask[i]:
+                    ts = timestamp_from_string(m.timestamp)
+                    key = minutes_base3(ts.millis)
+                    deltas[key] = to_int32(deltas.get(key, 0) ^ timestamp_to_hash(ts))
 
         if hasattr(db, "apply_planned"):
             # C++ backend: upserts + bulk __message insert in one call.

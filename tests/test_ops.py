@@ -211,3 +211,109 @@ class TestDevicePlannerEndState:
                 tree_dev = apply_messages(db_dev, tree_dev, batch, planner=plan_batch_device)
             assert dump(db_seq) == dump(db_dev)
             assert tree_seq == tree_dev
+
+
+def test_vectorized_timestamp_parse_matches_scalar():
+    import random as _random
+
+    import numpy as _np
+
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_from_string, timestamp_to_string
+    from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+    rng = _random.Random(21)
+    stamps = []
+    for _ in range(500):
+        t = Timestamp(
+            rng.randrange(0, 253_402_300_799_999),
+            rng.randrange(0, 65536),
+            f"{rng.getrandbits(64):016x}",
+        )
+        stamps.append(timestamp_to_string(t))
+    millis, counter, node = parse_timestamp_strings(stamps)
+    for i, s in enumerate(stamps):
+        t = timestamp_from_string(s)
+        assert (int(millis[i]), int(counter[i])) == (t.millis, t.counter), s
+        assert f"{int(node[i]):016x}" == t.node, s
+
+
+def test_vectorized_parse_rejects_malformed():
+    import pytest as _pytest
+
+    from evolu_tpu.core.types import TimestampParseError
+    from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+    good = "2024-01-15T10:30:00.123Z-0001-89e3b4f11a2c5d70"
+    for bad in (
+        "garbage",
+        good.replace("T", " "),
+        good.replace("-0001-", "-00g1-"),   # bad hex
+        good[:-1] + "G",                     # bad node hex
+        good.replace("10:30", "1a:30"),     # bad decimal
+    ):
+        with _pytest.raises(TimestampParseError):
+            parse_timestamp_strings([good, bad])
+
+
+def test_intern_cells_first_appearance_order():
+    from evolu_tpu.ops.host_parse import intern_cells
+
+    tables = ["t2", "t1", "t2", "t1", "t3"]
+    rows = ["r", "r", "r", "r", "r"]
+    cols = ["a", "a", "a", "b", "a"]
+    cell_id, cells = intern_cells(tables, rows, cols)
+    assert list(cell_id) == [0, 1, 0, 2, 3]
+    assert cells == [("t2", "r", "a"), ("t1", "r", "a"), ("t1", "r", "b"), ("t3", "r", "a")]
+
+
+def test_plan_batch_device_full_matches_python_deltas():
+    from evolu_tpu.core.merkle import minutes_base3
+    from evolu_tpu.core.murmur import to_int32
+    from evolu_tpu.core.timestamp import timestamp_from_string, timestamp_to_hash
+    from evolu_tpu.ops.merge import plan_batch_device, plan_batch_device_full
+
+    from test_convergence import make_contention_workload
+
+    messages = make_contention_workload(n_replicas=6, n_rows=9, writes_per_replica=10)
+    xor_a, ups_a = plan_batch_device(messages, {})
+    xor_b, ups_b, deltas = plan_batch_device_full(messages, {})
+    assert xor_a == xor_b and ups_a == ups_b
+    expect = {}
+    for i, m in enumerate(messages):
+        if xor_a[i]:
+            t = timestamp_from_string(m.timestamp)
+            k = minutes_base3(t.millis)
+            expect[k] = to_int32(expect.get(k, 0) ^ timestamp_to_hash(t))
+    assert deltas == expect
+
+
+def test_vectorized_parse_field_range_and_case_parity():
+    import pytest as _pytest
+
+    from evolu_tpu.core.types import TimestampParseError
+    from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+    good = "2024-01-15T10:30:00.123Z-0001-89e3b4f11a2c5d70"
+    # Out-of-range fields must abort like the scalar datetime parser.
+    for bad in (
+        good.replace("2024-01", "2024-13"),
+        good.replace("-15T", "-32T"),
+        "2023-02-29T00:00:00.000Z-0001-89e3b4f11a2c5d70",  # not a leap year
+        good.replace("T10", "T24"),
+        good.replace(":30:", ":60:"),
+    ):
+        with _pytest.raises(TimestampParseError):
+            parse_timestamp_strings([bad])
+    # 2024 IS a leap year; Feb 29 parses.
+    parse_timestamp_strings(["2024-02-29T00:00:00.000Z-0001-89e3b4f11a2c5d70"])
+    # Mixed-case hex is non-canonical but must parse on every backend.
+    m1, c1, n1 = parse_timestamp_strings([good.replace("0001", "00aB").replace("89e3", "89E3")])
+    assert int(c1[0]) == 0xAB and f"{int(n1[0]):016x}".startswith("89e3")
+
+
+def test_intern_cells_separator_injection_cannot_collide():
+    from evolu_tpu.ops.host_parse import intern_cells
+
+    cell_id, cells = intern_cells(["t", "t\x1fr"], ["r\x1fc", "c"], ["x", "x"])
+    assert cell_id[0] != cell_id[1]
+    assert len(cells) == 2
