@@ -138,3 +138,87 @@ def receive_timestamp(
     else:
         counter = 0
     return Timestamp(next_millis, counter, local.node)
+
+
+def receive_timestamps_batch(
+    local: Timestamp,
+    millis,
+    counter,
+    node_hex,
+    now: int = 0,
+    max_drift: int = 60000,
+) -> Timestamp:
+    """Fold `receive_timestamp` over a whole batch in O(n) numpy — the
+    "HLC receive is a fold, but reducible" item of SURVEY.md §7.
+
+    With the reference's per-command TimeEnv (`now` is ONE value for the
+    whole command, types.ts:303-309), the sequential fold reduces:
+
+    - the clock's millis after step i is the prefix max of
+      (local.millis, now, remote millis so far), so the final millis is
+      the batch max;
+    - the counter follows a max-plus recurrence
+      `c_i = max(a_i, c_{i-1} + 1)` inside runs where the prefix max is
+      flat (ties with the local clock), resetting when it rises — so the
+      final counter is a window max of `a_j + (n - j)` over the last
+      run, where `a_j` is `remote.counter + 1` on remote ties.
+
+    Error parity: if any step could error (drift, duplicate node, or a
+    counter that might overflow mid-run), fall back to the sequential
+    fold so the error type, payload, and position match the reference
+    exactly (errors abort the batch, so the slow path costs nothing in
+    steady state).
+
+    `millis`/`counter` are numpy arrays; `node_hex` is the RAW wire
+    node strings — the duplicate-node check is an exact string compare
+    (a u64 compare would be case-insensitive for non-canonical
+    uppercase wire hex, diverging from the sequential fold).
+    """
+    import numpy as np
+
+    n = len(millis)
+    if n == 0:
+        return local
+    millis = np.asarray(millis, np.int64)
+    counter_arr = np.asarray(counter, np.int64)
+
+    seed = max(local.millis, now)
+    pm = np.maximum.accumulate(np.maximum(millis, seed))
+    prev_pm = np.empty_like(pm)
+    prev_pm[0] = local.millis
+    prev_pm[1:] = pm[:-1]
+    tie_local = pm == prev_pm
+    tie_remote = pm == millis
+
+    # Conservative screens: any possible error → exact sequential path.
+    counter_bound = max(local.counter, int(counter_arr.max(initial=0))) + n
+    if (
+        int(pm[-1]) - now > max_drift
+        or any(h == local.node for h in node_hex)
+        or counter_bound > 65535
+    ):
+        t = local
+        for i in range(n):
+            t = receive_timestamp(
+                t,
+                Timestamp(int(millis[i]), int(counter_arr[i]), node_hex[i]),
+                now,
+                max_drift,
+            )
+        return t
+
+    resets = ~tie_local
+    neg = np.int64(-(1 << 40))
+    a = np.where(tie_remote, counter_arr + 1, np.where(resets, 0, neg))
+    idx = np.arange(1, n + 1, dtype=np.int64)
+    reset_positions = np.nonzero(resets)[0]
+    if len(reset_positions) == 0:
+        k = 0
+        base = local.counter  # virtual step 0 carries the seed counter
+    else:
+        k = int(reset_positions[-1]) + 1  # 1-based step index of last reset
+        base = neg
+    window = a[k - 1 :] - idx[k - 1 :] if k >= 1 else a - idx
+    best = int(window.max(initial=neg))
+    final_counter = max(best, base - 0) + n
+    return Timestamp(int(pm[-1]), int(final_counter), local.node)

@@ -24,6 +24,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from evolu_tpu.core.merkle import diff_merkle_trees, merkle_tree_from_string, merkle_tree_to_string
 from evolu_tpu.core.timestamp import (
+    receive_timestamps_batch,
     create_sync_timestamp,
     receive_timestamp,
     send_timestamp,
@@ -193,12 +194,16 @@ class DbWorker:
     # -- commands --
 
     def _send(self, command: msg.Send) -> None:
-        """send.ts:82-122: stamp → apply → persist clock → push → re-query."""
+        """send.ts:82-122: stamp → apply → persist clock → push → re-query.
+
+        One wall-clock sample per command, like the reference's
+        per-command TimeEnv (types.ts:303-309)."""
         clock = read_clock(self.db)
         t = clock.timestamp
+        now = self.now()
         stamped: List[CrdtMessage] = []
         for m in command.messages:
-            t = send_timestamp(t, self.now(), self.config.max_drift)
+            t = send_timestamp(t, now, self.config.max_drift)
             stamped.append(
                 CrdtMessage(timestamp_to_string(t), m.table, m.row, m.column, m.value)
             )
@@ -219,12 +224,31 @@ class DbWorker:
         """receive.ts:144-199: merge remote messages, then anti-entropy."""
         clock = read_clock(self.db)
         if command.messages:
-            # HLC merge folded over every remote timestamp (receive.ts:45-66).
-            t = clock.timestamp
-            for m in command.messages:
-                t = receive_timestamp(
-                    t, timestamp_from_string(m.timestamp), self.now(), self.config.max_drift
+            # HLC merge folded over every remote timestamp
+            # (receive.ts:45-66) — the reduced vectorized fold, with one
+            # wall-clock sample per command like the reference's TimeEnv.
+            # A parse failure re-runs the fold sequentially so the FIRST
+            # failing message defines the surfaced error, exactly like
+            # the reference's per-message traversal.
+            from evolu_tpu.core.types import TimestampParseError
+            from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+            now = self.now()
+            try:
+                r_millis, r_counter, _ = parse_timestamp_strings(
+                    [m.timestamp for m in command.messages]
                 )
+                t = receive_timestamps_batch(
+                    clock.timestamp, r_millis, r_counter,
+                    [m.timestamp[30:46] for m in command.messages],
+                    now=now, max_drift=self.config.max_drift,
+                )
+            except TimestampParseError:
+                t = clock.timestamp
+                for m in command.messages:
+                    t = receive_timestamp(
+                        t, timestamp_from_string(m.timestamp), now, self.config.max_drift
+                    )
             tree = apply_messages(
                 self.db, clock.merkle_tree, list(command.messages), planner=self._planner
             )

@@ -146,3 +146,144 @@ class TestReceiveTimestamp:
             receive_timestamp(
                 node1(MAX_DRIFT + 1), node1(MAX_DRIFT + 1), now=0
             )
+
+
+def test_receive_batch_reduction_matches_sequential_fold():
+    """The vectorized receive fold (SURVEY §7 "HLC receive is
+    reducible") must equal the sequential fold on adversarial batches:
+    frozen clocks, millis ties with local and remotes, counter chains,
+    regressing remote order."""
+    import random as _random
+
+    import numpy as np
+
+    from evolu_tpu.core.timestamp import (
+        Timestamp,
+        receive_timestamp,
+        receive_timestamps_batch,
+    )
+
+    rng = _random.Random(42)
+    base = 1_700_000_000_000
+    for trial in range(200):
+        n = rng.randrange(1, 40)
+        local = Timestamp(base + rng.randrange(0, 5), rng.randrange(0, 5), "a" * 16)
+        now = base + rng.randrange(0, 8)
+        millis = np.array(
+            [base + rng.randrange(0, 8) for _ in range(n)], np.int64
+        )
+        counter = np.array([rng.randrange(0, 7) for _ in range(n)], np.int64)
+        nodes = [f"{rng.randrange(1, 6):016x}" for _ in range(n)]
+
+        expect = local
+        err = None
+        try:
+            for i in range(n):
+                expect = receive_timestamp(
+                    expect, Timestamp(int(millis[i]), int(counter[i]), nodes[i]), now
+                )
+        except Exception as e:  # noqa: BLE001
+            err = e
+
+        if err is None:
+            got = receive_timestamps_batch(local, millis, counter, nodes, now)
+            assert (got.millis, got.counter, got.node) == (
+                expect.millis, expect.counter, expect.node,
+            ), trial
+        else:
+            import pytest as _pytest
+
+            with _pytest.raises(type(err)):
+                receive_timestamps_batch(local, millis, counter, nodes, now)
+
+    # Adversarial regime: drift-range millis, node collisions with the
+    # local clock, counters near the overflow boundary — every error
+    # branch must reproduce the sequential fold's error type.
+    error_types = set()
+    for trial in range(200):
+        n = rng.randrange(1, 30)
+        local = Timestamp(base, rng.randrange(65_500, 65_536), "a" * 16)
+        now = base + rng.randrange(0, 3)
+        millis = np.array(
+            [base + rng.choice([0, 1, 59_999, 60_004, 120_000]) for _ in range(n)],
+            np.int64,
+        )
+        counter = np.array(
+            [rng.choice([0, 65_530, 65_535]) for _ in range(n)], np.int64
+        )
+        nodes = [rng.choice(["a" * 16, "b" * 16]) for _ in range(n)]
+        expect = local
+        err = None
+        try:
+            for i in range(n):
+                expect = receive_timestamp(
+                    expect, Timestamp(int(millis[i]), int(counter[i]), nodes[i]), now
+                )
+        except Exception as e:  # noqa: BLE001
+            err = e
+        import pytest as _pytest
+
+        if err is None:
+            got = receive_timestamps_batch(local, millis, counter, nodes, now)
+            assert (got.millis, got.counter, got.node) == (
+                expect.millis, expect.counter, expect.node,
+            ), trial
+        else:
+            error_types.add(type(err).__name__)
+            with _pytest.raises(type(err)):
+                receive_timestamps_batch(local, millis, counter, nodes, now)
+    # The adversarial regime must actually exercise error paths.
+    assert error_types, "adversarial fuzz never errored"
+
+
+def test_receive_batch_error_parity():
+    """Error type/payload parity on the fallback path: drift, duplicate
+    node, and mid-run counter overflow (which a final-state-only check
+    would miss)."""
+    import numpy as np
+    import pytest as _pytest
+
+    from evolu_tpu.core.timestamp import (
+        Timestamp,
+        TimestampCounterOverflowError,
+        TimestampDriftError,
+        TimestampDuplicateNodeError,
+        receive_timestamps_batch,
+    )
+
+    base = 1_700_000_000_000
+    local = Timestamp(base, 0, "a" * 16)
+
+    with _pytest.raises(TimestampDriftError):
+        receive_timestamps_batch(
+            local, np.array([base + 120_000]), np.array([0]), ["b" * 16], now=base
+        )
+    with _pytest.raises(TimestampDuplicateNodeError):
+        receive_timestamps_batch(
+            local, np.array([base]), np.array([0]), ["a" * 16], now=base
+        )
+    # 65536 frozen-clock receives overflow the counter mid-run even
+    # though a later message with larger millis would reset it.
+    n = 65_536
+    millis = np.full(n + 1, base, np.int64)
+    millis[-1] = base + 1
+    counter = np.zeros(n + 1, np.int64)
+    nodes = ["b" * 16] * (n + 1)
+    with _pytest.raises(TimestampCounterOverflowError):
+        receive_timestamps_batch(local, millis, counter, nodes, now=base)
+
+
+def test_receive_batch_node_compare_is_case_sensitive():
+    """Non-canonical uppercase wire hex for the same node value must NOT
+    trigger the duplicate-node error — the reference compares strings."""
+    import numpy as np
+
+    from evolu_tpu.core.timestamp import Timestamp, receive_timestamps_batch
+
+    base = 1_700_000_000_000
+    local = Timestamp(base, 0, "00000000000000ab")
+    got = receive_timestamps_batch(
+        local, np.array([base], np.int64), np.array([3], np.int64),
+        ["00000000000000AB"], now=base,
+    )
+    assert got.counter == 4 and got.node == local.node
