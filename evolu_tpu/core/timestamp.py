@@ -191,11 +191,20 @@ def receive_timestamps_batch(
     tie_remote = pm == millis
 
     # Conservative screens: any possible error → exact sequential path.
-    counter_bound = max(local.counter, int(counter_arr.max(initial=0))) + n
+    # The counter only grows inside a flat-millis run (resets between
+    # runs), so the tight bound uses the LONGEST tie_local run — a
+    # whole-batch `+ n` bound would push every large batch onto the
+    # sequential path for no reason.
+    reset_pos = np.flatnonzero(~tie_local)
+    run_lengths = np.diff(np.concatenate(([-1], reset_pos, [n]))) - 1
+    longest_run = int(run_lengths.max(initial=0))
+    counter_bound = (
+        max(local.counter, int(counter_arr.max(initial=0)) + 1) + longest_run
+    )
     if (
         int(pm[-1]) - now > max_drift
         or any(h == local.node for h in node_hex)
-        or counter_bound > 65535
+        or counter_bound > MAX_COUNTER
     ):
         t = local
         for i in range(n):
@@ -220,5 +229,5 @@ def receive_timestamps_batch(
         base = neg
     window = a[k - 1 :] - idx[k - 1 :] if k >= 1 else a - idx
     best = int(window.max(initial=neg))
-    final_counter = max(best, base - 0) + n
+    final_counter = max(best, base) + n
     return Timestamp(int(pm[-1]), int(final_counter), local.node)

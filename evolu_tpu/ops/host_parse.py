@@ -51,8 +51,12 @@ def parse_timestamp_strings(
     n = len(timestamps)
     if n == 0:
         return (np.empty(0, np.int64), np.empty(0, np.int32), np.empty(0, np.uint64))
+    # Per-string length check FIRST: a joined-length check alone would
+    # accept e.g. ["", "<two valid stamps concatenated>"] after reshape.
+    if any(len(t) != _LEN for t in timestamps):
+        raise TimestampParseError("malformed timestamp in batch")
     joined = "".join(timestamps)
-    if len(joined) != n * _LEN or not joined.isascii():
+    if not joined.isascii():
         raise TimestampParseError("malformed timestamp in batch")
     buf = np.frombuffer(joined.encode("ascii"), np.uint8).reshape(n, _LEN)
 

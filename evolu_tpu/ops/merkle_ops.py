@@ -35,22 +35,23 @@ from evolu_tpu.ops.encode import timestamp_hashes
 _SENTINEL_HI = 0x7FFFFFFF  # int32 max: masked rows sort after every real key
 
 
-def segment_xor2_core(hi_i32, lo_i32, hashes_u32, valid):
+def segment_xor2_core(hi_i32, lo_i32, hashes_u32, valid=None):
     """Sorted segmented-XOR reduce over an (hi, lo) int32 key pair
     (traceable core).
 
     Sort rows lexicographically by (hi, lo) — 32-bit keys, so the TPU
-    sort never touches emulated 64-bit compares — carrying the hash and
-    valid payloads through the sort (no post-sort gathers). Per
-    distinct key pair, XOR the hashes of its rows. Masked rows must
-    carry hash 0 and hi = _SENTINEL_HI. Returns (hi_sorted, lo_sorted,
-    seg_end, seg_xor, valid_sorted), all (N,); rows where seg_end is
-    True give one (key, xor) per distinct key.
+    sort never touches emulated 64-bit compares — carrying the hash as
+    the only payload (no post-sort gathers). Per distinct key pair,
+    XOR the hashes of its rows. Masked rows must carry hash 0 and
+    hi = _SENTINEL_HI; validity is recovered from the sorted hi key
+    itself rather than riding the sort as a payload. Returns
+    (hi_sorted, lo_sorted, seg_end, seg_xor, valid_sorted), all (N,);
+    rows where seg_end is True give one (key, xor) per distinct key.
     """
+    del valid  # masked rows are identified by the hi sentinel
     n = hi_i32.shape[0]
-    hi_s, lo_s, h_sorted, valid_sorted = jax.lax.sort(
-        (hi_i32, lo_i32, hashes_u32, valid), num_keys=2
-    )
+    hi_s, lo_s, h_sorted = jax.lax.sort((hi_i32, lo_i32, hashes_u32), num_keys=2)
+    valid_sorted = hi_s != jnp.int32(_SENTINEL_HI)
 
     prefix = jax.lax.associative_scan(jnp.bitwise_xor, h_sorted)
     seg_end = jnp.concatenate(

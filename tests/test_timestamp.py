@@ -287,3 +287,49 @@ def test_receive_batch_node_compare_is_case_sensitive():
         ["00000000000000AB"], now=base,
     )
     assert got.counter == 4 and got.node == local.node
+
+
+def test_receive_batch_large_distinct_millis_stays_vectorized():
+    """100k messages with distinct millis cannot overflow (every step
+    resets the counter), so the fold must NOT fall back to the
+    sequential per-message path."""
+    import numpy as np
+
+    import evolu_tpu.core.timestamp as ts_mod
+    from evolu_tpu.core.timestamp import Timestamp, receive_timestamps_batch
+
+    base = 1_700_000_000_000
+    # n > 65535 (the counter range): a whole-batch `+ n` overflow bound
+    # would wrongly fall back; the run-length bound must not. Millis
+    # rise every second message, so the longest flat run is 1 and the
+    # span (n/2) stays inside max_drift of `now`.
+    n = 100_000
+    millis = base + 1 + np.arange(n, dtype=np.int64) // 2
+    counter = np.zeros(n, np.int64)
+    nodes = ["b" * 16] * n
+
+    calls = []
+    orig = ts_mod.receive_timestamp
+    ts_mod.receive_timestamp = lambda *a, **k: calls.append(1) or orig(*a, **k)
+    try:
+        got = receive_timestamps_batch(
+            Timestamp(base, 7, "a" * 16), millis, counter, nodes, now=base
+        )
+    finally:
+        ts_mod.receive_timestamp = orig
+    assert not calls, "large clean batch fell back to the sequential fold"
+    assert got.millis == base + n // 2
+    # Final millis arrives via a remote tie (counter = 0 + 1), then its
+    # duplicate ties with the local clock (max(1, 0) + 1 = 2).
+    assert got.counter == 2
+
+
+def test_parse_rejects_per_string_length_tricks():
+    import pytest as _pytest
+
+    from evolu_tpu.core.types import TimestampParseError
+    from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+    good = "2024-01-15T10:30:00.123Z-0001-89e3b4f11a2c5d70"
+    with _pytest.raises(TimestampParseError):
+        parse_timestamp_strings(["", good + good])  # joined length still n*46
