@@ -37,6 +37,29 @@ def validate_non_empty_string_1000(value: str) -> str:
     return value
 
 
+_EMAIL_RE = re.compile(r"^[^\s@]+@[^\s@]+\.[^\s@]+$")
+
+
+def validate_email(value: str) -> str:
+    """Email brand (model.ts:65-66)."""
+    if not _EMAIL_RE.fullmatch(value):
+        raise StringMaxLengthError(f"invalid email: {value!r}")
+    return value
+
+
+def validate_url(value: str) -> str:
+    """Url brand (model.ts:69-70)."""
+    from urllib.parse import urlparse
+
+    try:
+        p = urlparse(value)
+    except ValueError:
+        raise StringMaxLengthError(f"invalid url: {value!r}") from None
+    if not (p.scheme and p.netloc):
+        raise StringMaxLengthError(f"invalid url: {value!r}")
+    return value
+
+
 def is_sqlite_boolean(value: object) -> bool:
     return value in (0, 1)
 

@@ -448,3 +448,21 @@ def test_create_hooks_analog():
         view.dispose()
     finally:
         hooks.evolu.dispose()
+
+
+def test_model_email_and_url_brands():
+    import pytest as _pytest
+
+    from evolu_tpu.api.model import validate_email, validate_url
+    from evolu_tpu.core.types import StringMaxLengthError
+
+    assert validate_email("user@example.com") == "user@example.com"
+    assert validate_url("https://example.com/a?b=1") == "https://example.com/a?b=1"
+    for bad in ("not-an-email", "a@b", "x y@z.co"):
+        with _pytest.raises(StringMaxLengthError):
+            validate_email(bad)
+    for bad in ("example.com", "", "http://", "http://[invalid"):
+        with _pytest.raises(StringMaxLengthError):
+            validate_url(bad)
+    with _pytest.raises(StringMaxLengthError):
+        validate_email("user@example.com\n")
