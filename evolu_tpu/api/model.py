@@ -12,11 +12,12 @@ from __future__ import annotations
 
 import datetime
 import re
+from urllib.parse import urlparse
 from typing import Union
 
 from evolu_tpu.core.ids import create_id, is_valid_id
 from evolu_tpu.core.mnemonic import validate_mnemonic
-from evolu_tpu.core.types import StringMaxLengthError
+from evolu_tpu.core.types import StringMaxLengthError, ValidationError
 
 SqliteBoolean = int  # 0 | 1 (model.ts:57-63)
 SqliteDate = str  # ISO-8601 string (model.ts:65-74)
@@ -41,22 +42,25 @@ _EMAIL_RE = re.compile(r"^[^\s@]+@[^\s@]+\.[^\s@]+$")
 
 
 def validate_email(value: str) -> str:
-    """Email brand (model.ts:65-66)."""
-    if not _EMAIL_RE.fullmatch(value):
-        raise StringMaxLengthError(f"invalid email: {value!r}")
+    """Email brand (model.ts:65-66). Like the reference's zod
+    `.email()`, no length cap — sync never validates, so local
+    strictness is a UX concern only."""
+    if not isinstance(value, str) or not _EMAIL_RE.fullmatch(value):
+        raise ValidationError(f"invalid email: {value!r}")
     return value
 
 
 def validate_url(value: str) -> str:
-    """Url brand (model.ts:69-70)."""
-    from urllib.parse import urlparse
-
+    """Url brand (model.ts:69-70). Rejects whitespace anywhere (JS
+    `new URL` / zod `.url()` semantics) and malformed hosts."""
+    if not isinstance(value, str) or re.search(r"\s", value):
+        raise ValidationError(f"invalid url: {value!r}")
     try:
         p = urlparse(value)
     except ValueError:
-        raise StringMaxLengthError(f"invalid url: {value!r}") from None
+        raise ValidationError(f"invalid url: {value!r}") from None
     if not (p.scheme and p.netloc):
-        raise StringMaxLengthError(f"invalid url: {value!r}")
+        raise ValidationError(f"invalid url: {value!r}")
     return value
 
 
@@ -118,6 +122,8 @@ __all__ = [
     "validate_mnemonic",
     "validate_string_1000",
     "validate_non_empty_string_1000",
+    "validate_email",
+    "validate_url",
     "is_sqlite_boolean",
     "is_sqlite_date",
 ]

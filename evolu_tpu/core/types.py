@@ -122,7 +122,13 @@ class SQLiteError(EvoluError):
     type = "SQLiteError"
 
 
-class StringMaxLengthError(EvoluError):
+class ValidationError(EvoluError):
+    """A model brand rejected a value (format or length)."""
+
+    type = "ValidationError"
+
+
+class StringMaxLengthError(ValidationError):
     type = "StringMaxLengthError"
 
 

@@ -451,18 +451,14 @@ def test_create_hooks_analog():
 
 
 def test_model_email_and_url_brands():
-    import pytest as _pytest
+    from evolu_tpu.core.types import ValidationError
 
-    from evolu_tpu.api.model import validate_email, validate_url
-    from evolu_tpu.core.types import StringMaxLengthError
-
-    assert validate_email("user@example.com") == "user@example.com"
-    assert validate_url("https://example.com/a?b=1") == "https://example.com/a?b=1"
-    for bad in ("not-an-email", "a@b", "x y@z.co"):
-        with _pytest.raises(StringMaxLengthError):
-            validate_email(bad)
-    for bad in ("example.com", "", "http://", "http://[invalid"):
-        with _pytest.raises(StringMaxLengthError):
-            validate_url(bad)
-    with _pytest.raises(StringMaxLengthError):
-        validate_email("user@example.com\n")
+    assert model.validate_email("user@example.com") == "user@example.com"
+    assert model.validate_url("https://example.com/a?b=1") == "https://example.com/a?b=1"
+    for bad in ("not-an-email", "a@b", "x y@z.co", "user@example.com\n", None, 123):
+        with pytest.raises(ValidationError):
+            model.validate_email(bad)
+    for bad in ("example.com", "", "http://", "http://[invalid",
+                "http://exa mple.com/x", "http://\t.com", None, 5):
+        with pytest.raises(ValidationError):
+            model.validate_url(bad)
