@@ -29,7 +29,7 @@ from evolu_tpu.core.merkle import insert_into_merkle_tree, apply_prefix_xors, mi
 from evolu_tpu.core.murmur import to_int32
 from evolu_tpu.core.timestamp import timestamp_from_string, timestamp_to_hash
 from evolu_tpu.core.types import CrdtMessage
-from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.storage.sqlite import PySqliteDatabase, quote_ident
 
 _SELECT_WINNER = (
     'SELECT "timestamp" FROM "__message" '
@@ -43,9 +43,12 @@ _INSERT_MESSAGE = (
 
 
 def _upsert_sql(table: str, column: str) -> str:
+    """Hostile table/column names from the wire must not splice SQL:
+    identifiers are quote-doubled (same as the C++ layer)."""
+    t, c = quote_ident(table), quote_ident(column)
     return (
-        f'INSERT INTO "{table}" ("id", "{column}") VALUES (?, ?) '
-        f'ON CONFLICT DO UPDATE SET "{column}" = ?'
+        f"INSERT INTO {t} (\"id\", {c}) VALUES (?, ?) "
+        f"ON CONFLICT DO UPDATE SET {c} = ?"
     )
 
 

@@ -17,7 +17,7 @@ from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_str
 from evolu_tpu.core.mnemonic import generate_mnemonic
 from evolu_tpu.core.timestamp import create_initial_timestamp, timestamp_to_string
 from evolu_tpu.core.types import Owner, TableDefinition
-from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.storage.sqlite import PySqliteDatabase, quote_ident
 
 
 def init_db_model(db: PySqliteDatabase, mnemonic: Optional[str] = None) -> Owner:
@@ -67,17 +67,17 @@ def update_db_schema(db: PySqliteDatabase, table_definitions: Iterable[TableDefi
     existing = get_existing_tables(db)
     for td in table_definitions:
         if td.name in existing:
-            have = {r["name"] for r in db.exec_sql_query(f'PRAGMA table_info ("{td.name}")')}
+            have = {r["name"] for r in db.exec_sql_query(f"PRAGMA table_info ({quote_ident(td.name)})")}
             for col in td.columns:
                 if col not in have:
-                    db.run(f'ALTER TABLE "{td.name}" ADD COLUMN "{col}" BLOB')
+                    db.run(f"ALTER TABLE {quote_ident(td.name)} ADD COLUMN {quote_ident(col)} BLOB")
         else:
-            cols = ", ".join(f'"{c}" BLOB' for c in td.columns)
-            db.exec(f'CREATE TABLE "{td.name}" ("id" TEXT PRIMARY KEY, {cols})')
+            cols = ", ".join(f"{quote_ident(c)} BLOB" for c in td.columns)
+            db.exec(f'CREATE TABLE {quote_ident(td.name)} ("id" TEXT PRIMARY KEY, {cols})')
 
 
 def delete_all_tables(db: PySqliteDatabase) -> None:
     """DROP every table (deleteAllTables.ts:6-25)."""
     rows = db.exec_sql_query("SELECT \"name\" FROM sqlite_schema WHERE type='table'")
     for r in rows:
-        db.exec(f'DROP TABLE "{r["name"]}"')
+        db.exec(f"DROP TABLE {quote_ident(r['name'])}")

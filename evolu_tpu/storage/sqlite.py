@@ -17,6 +17,14 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 from evolu_tpu.core.types import UnknownError
 
 
+def quote_ident(name: str) -> str:
+    """SQL identifier quoting with embedded quotes doubled — one
+    definition shared by the Python paths and matching the C++ layer's
+    quote_ident, so hostile names fail identically on both backends."""
+    return '"' + str(name).replace('"', '""') + '"'
+
+
+
 class PySqliteDatabase:
     """Single-writer SQLite handle.
 

@@ -114,3 +114,27 @@ def test_batch_updates_clock_tree_consistency():
     db2 = make_db()
     tree2 = apply_messages_sequential(db2, {}, msgs)
     assert tree == tree2
+
+
+def test_hostile_identifiers_cannot_splice_sql():
+    """A wire message naming table 'todo\" (x\"); DROP TABLE ...' must not
+    execute injected SQL; both backends fail identically (missing
+    table), leaving state untouched."""
+    import pytest
+
+    from evolu_tpu.core.types import CrdtMessage, EvoluError
+    from evolu_tpu.storage.apply import apply_messages
+    from evolu_tpu.storage.native import open_database
+    from evolu_tpu.storage.schema import init_db_model
+
+    hostile = 'todo" ("x"); DROP TABLE "__message"; --'
+    ts = "2024-01-01T00:00:00.000Z-0000-" + "a" * 16
+    for backend in ("python", "native"):
+        db = open_database(backend=backend)
+        init_db_model(db, mnemonic=None)
+        db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title" BLOB)')
+        with pytest.raises(EvoluError):
+            apply_messages(db, {}, [CrdtMessage(ts, hostile, "r", "title", "v")])
+        # __message survives and nothing was inserted.
+        assert db.exec('SELECT COUNT(*) FROM "__message"') == [(0,)]
+        db.close()
