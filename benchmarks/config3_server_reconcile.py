@@ -1,0 +1,85 @@
+"""BASELINE config 3, full system: batch-reconcile encrypted messages
+across many owners through the relay's BatchReconciler — protobuf-shaped
+requests in, SQLite + per-owner Merkle trees out, device pass for the
+per-(owner, minute) XOR deltas. The end state is identical to running
+`store.sync` per request (asserted on a sample).
+
+The kernel-only number for this shape is bench.py; this measures the
+whole server path a pod would run.
+
+Prints one JSON line.
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.server.engine import BatchReconciler
+from evolu_tpu.server.relay import RelayStore
+from evolu_tpu.sync import protocol
+
+N = int(os.environ.get("CONFIG3_N", 200_000))
+OWNERS = int(os.environ.get("CONFIG3_OWNERS", 200))
+
+
+def build_requests(n=N, owners=OWNERS, seed=3):
+    rng = random.Random(seed)
+    base = 1_700_000_000_000
+    per_owner = {}
+    for i in range(n):
+        o = rng.randrange(owners)
+        t = Timestamp(base + i // 16, i % 16, f"{o:015x}{rng.randrange(16):x}")
+        per_owner.setdefault(o, []).append(
+            protocol.EncryptedCrdtMessage(timestamp_to_string(t), b"\x00" * 64)
+        )
+    from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_string
+
+    empty = merkle_tree_to_string(create_initial_merkle_tree())
+    return [
+        protocol.SyncRequest(tuple(msgs), f"owner{o:04d}", "f" * 16, empty)
+        for o, msgs in per_owner.items()
+    ]
+
+
+def main():
+    requests = build_requests()
+    n_msgs = sum(len(r.messages) for r in requests)
+
+    # Warm the jit bucket with a tiny batch of the same code path.
+    warm = BatchReconciler(RelayStore())
+    warm.reconcile(build_requests(n=2048, owners=8, seed=9))
+
+    store = RelayStore()
+    engine = BatchReconciler(store, warm.mesh)
+    t0 = time.perf_counter()
+    responses = engine.reconcile(requests)
+    elapsed = time.perf_counter() - t0
+
+    # Spot-check: per-request sync on a fresh store gives the same tree.
+    sample = requests[0]
+    solo = RelayStore()
+    solo_resp = solo.sync(sample)
+    assert responses[0].merkle_tree == solo_resp.merkle_tree, "batch != per-request"
+
+    stored = store.db.exec('SELECT COUNT(*) FROM "message"')[0][0]
+    print(json.dumps({
+        "metric": "config3_server_reconcile_msgs_per_sec",
+        "value": round(n_msgs / elapsed),
+        "unit": "msgs/sec",
+        "detail": {
+            "messages": n_msgs, "owners": len(requests), "stored": stored,
+            "elapsed_s": round(elapsed, 3),
+            "devices": engine.mesh.devices.size,
+            "backend": type(store.db).__name__,
+        },
+    }))
+    store.close(), solo.close(), warm.store.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -81,7 +81,8 @@ def parse_timestamp_strings(
     # Field-range validation, matching the scalar parser's datetime
     # constructor (a month 13 or hour 25 must abort, not wrap).
     if (
-        (mo < 1).any() or (mo > 12).any()
+        (y < 1).any()  # datetime's MINYEAR — year 0000 must abort
+        or (mo < 1).any() or (mo > 12).any()
         or (d < 1).any() or (d > _days_in_month(y, mo)).any()
         or (hh > 23).any() or (mi > 59).any() or (ss > 59).any()
     ):

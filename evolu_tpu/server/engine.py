@@ -29,9 +29,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from evolu_tpu.core.merkle import apply_prefix_xors, merkle_tree_to_string
-from evolu_tpu.core.timestamp import timestamp_from_string
 from evolu_tpu.ops import bucket_size, with_x64
-from evolu_tpu.ops.encode import node_hex_to_u64, timestamp_hashes
+from evolu_tpu.ops.encode import timestamp_hashes
+from evolu_tpu.ops.host_parse import parse_timestamp_strings
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, create_mesh, sharding
 from evolu_tpu.parallel.reconcile import xor_allreduce
@@ -92,13 +92,18 @@ def _owner_minute_deltas_timed(mesh, owner_rows):
     for si, shard in enumerate(shards):
         pos = si * shard_size
         for o in shard:
-            for ts in owner_rows[o]:
-                t = timestamp_from_string(ts)
-                millis[pos], counter[pos] = t.millis, t.counter
-                node[pos] = node_hex_to_u64(t.node)
-                valid[pos] = True
-                oix[pos] = owner_ix[o]
-                pos += 1
+            rows = owner_rows[o]
+            n = len(rows)
+            if not n:
+                continue
+            # Vectorized batch parse (ops/host_parse) — no per-message
+            # Python on the server hot path.
+            m, c, nd = parse_timestamp_strings(list(rows))
+            sl = slice(pos, pos + n)
+            millis[sl], counter[sl], node[sl] = m, c, nd
+            valid[sl] = True
+            oix[sl] = owner_ix[o]
+            pos += n
 
     shd = sharding(mesh)
     args = [jax.device_put(a, shd) for a in (millis, counter, node, valid, oix)]

@@ -56,6 +56,13 @@ class RelayStore:
             'CREATE TABLE IF NOT EXISTS "merkleTree" ('
             '"userId" TEXT PRIMARY KEY, "merkleTree" TEXT)'
         )
+        # The reference's PK (timestamp, userId) forces a timestamp-range
+        # scan per user query; this covering index turns get_messages
+        # into an index range read (a deliberate improvement).
+        self.db.exec(
+            'CREATE INDEX IF NOT EXISTS "message_user_ts" '
+            'ON "message" ("userId", "timestamp")'
+        )
 
     def get_merkle_tree(self, user_id: str) -> dict:
         """index.ts:121-136 — a user's tree, empty if unseen."""
@@ -112,6 +119,10 @@ class RelayStore:
         if diff is None:
             return ()
         since = timestamp_to_string(create_sync_timestamp(diff))
+        if hasattr(self.db, "fetch_relay_messages"):
+            # C++ backend: packed single-call reader.
+            rows = self.db.fetch_relay_messages(user_id, since, node_id)
+            return tuple(protocol.EncryptedCrdtMessage(t, c) for t, c in rows)
         rows = self.db.exec_sql_query(
             'SELECT "timestamp", "content" FROM "message" '
             'WHERE "userId" = ? AND "timestamp" > ? AND "timestamp" NOT LIKE \'%\' || ? '

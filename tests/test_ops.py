@@ -317,3 +317,15 @@ def test_intern_cells_separator_injection_cannot_collide():
     cell_id, cells = intern_cells(["t", "t\x1fr"], ["r\x1fc", "c"], ["x", "x"])
     assert cell_id[0] != cell_id[1]
     assert len(cells) == 2
+
+
+def test_vectorized_parse_rejects_year_zero():
+    import pytest as _pytest
+
+    from evolu_tpu.core.types import TimestampParseError
+    from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+    with _pytest.raises(TimestampParseError):
+        parse_timestamp_strings(["0000-01-01T00:00:00.000Z-0000-" + "a" * 16])
+    # Year 0001 is datetime's MINYEAR and must parse.
+    parse_timestamp_strings(["0001-01-01T00:00:00.000Z-0000-" + "a" * 16])
