@@ -50,9 +50,10 @@ def main():
     requests = build_requests()
     n_msgs = sum(len(r.messages) for r in requests)
 
-    # Warm the jit bucket with a tiny batch of the same code path.
+    # Warm the jit with the SAME batch shape (jit traces per bucket
+    # size) on a throwaway store, so the timed run measures steady state.
     warm = BatchReconciler(RelayStore())
-    warm.reconcile(build_requests(n=2048, owners=8, seed=9))
+    warm.reconcile(build_requests())
 
     store = RelayStore()
     engine = BatchReconciler(store, warm.mesh)

@@ -1,0 +1,65 @@
+"""Multi-host initialization — the DCN leg of the distributed design.
+
+Topology (SURVEY.md §5 "distributed communication backend"):
+
+- **Inside a pod (ICI)**: the owner mesh spans every device JAX knows
+  about; XLA inserts the collectives (`xor_allreduce` rides ICI).
+- **Across hosts (DCN)**: two distinct channels —
+  1. the *control/compute* plane: `jax.distributed` (this module) so a
+     multi-host mesh sees all processes' devices and collectives cross
+     hosts over DCN where the topology requires;
+  2. the *sync protocol* plane: the unchanged protobuf-over-HTTP relay
+     contract (`evolu_tpu.sync`, `evolu_tpu.server.relay`) — existing
+     TypeScript clients interoperate with a pod-backed relay unchanged.
+
+The reference's analog is Worker `postMessage` in-device plus the HTTP
+star topology across devices; there is no NCCL/MPI to port — the mesh
++ collectives ARE the backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from evolu_tpu.parallel.mesh import create_mesh
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+):
+    """Join this host to the pod's jax.distributed cluster and return
+    the global owner mesh over every device in the cluster.
+
+    With no arguments, environment-driven auto-detection is used (TPU
+    pods populate it); on a single host this is a no-op join of a
+    1-process cluster. Call once, before any jax computation.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return create_mesh()
+
+
+def is_multihost() -> bool:
+    return jax.process_count() > 1
+
+
+def local_shard_indices(mesh) -> list:
+    """Mesh shard slots whose device this PROCESS hosts (hosts feed
+    only their addressable devices; jax assembles the global array)."""
+    me = jax.process_index()
+    return [i for i, d in enumerate(mesh.devices.flat) if d.process_index == me]
+
+
+def local_owners(mesh, shards) -> list:
+    """Owners this process materializes data for, given the ACTUAL
+    shard assignment produced by `assign_owners_to_shards` (greedy LPT
+    — shard index s maps to mesh.devices.flat[s])."""
+    mine = set(local_shard_indices(mesh))
+    return [o for i, shard in enumerate(shards) if i in mine for o in shard]

@@ -89,21 +89,29 @@ def _owner_minute_deltas_timed(mesh, owner_rows):
     node = np.zeros(total, np.uint64)
     valid = np.zeros(total, bool)
     oix = np.zeros(total, np.int64)
-    for si, shard in enumerate(shards):
-        pos = si * shard_size
-        for o in shard:
-            rows = owner_rows[o]
-            n = len(rows)
-            if not n:
-                continue
-            # Vectorized batch parse (ops/host_parse) — no per-message
-            # Python on the server hot path.
-            m, c, nd = parse_timestamp_strings(list(rows))
-            sl = slice(pos, pos + n)
-            millis[sl], counter[sl], node[sl] = m, c, nd
-            valid[sl] = True
-            oix[sl] = owner_ix[o]
-            pos += n
+    # ONE vectorized parse for every owner's timestamps (per-owner calls
+    # would pay the numpy setup ~owners times), then slice into the
+    # shard-contiguous layout.
+    ordered = [(o, owner_rows[o]) for shard in shards for o in shard]
+    flat = [ts for _, rows in ordered for ts in rows]
+    all_m, all_c, all_n = parse_timestamp_strings(flat)
+    src = 0
+    pos_by_shard = [si * shard_size for si in range(len(shards))]
+    shard_of_owner = {o: si for si, shard in enumerate(shards) for o in shard}
+    for o, rows in ordered:
+        n = len(rows)
+        if not n:
+            continue
+        si = shard_of_owner[o]
+        pos = pos_by_shard[si]
+        sl = slice(pos, pos + n)
+        millis[sl] = all_m[src : src + n]
+        counter[sl] = all_c[src : src + n]
+        node[sl] = all_n[src : src + n]
+        valid[sl] = True
+        oix[sl] = owner_ix[o]
+        pos_by_shard[si] = pos + n
+        src += n
 
     shd = sharding(mesh)
     args = [jax.device_put(a, shd) for a in (millis, counter, node, valid, oix)]

@@ -120,7 +120,10 @@ class RelayStore:
             return ()
         since = timestamp_to_string(create_sync_timestamp(diff))
         if hasattr(self.db, "fetch_relay_messages"):
-            # C++ backend: packed single-call reader.
+            # C++ backend: packed single-call reader. NB the query text
+            # lives in BOTH native/evolu_host.cpp::eh_get_messages and
+            # the fallback below — change them together
+            # (tests assert cross-backend equivalence).
             rows = self.db.fetch_relay_messages(user_id, since, node_id)
             return tuple(protocol.EncryptedCrdtMessage(t, c) for t, c in rows)
         rows = self.db.exec_sql_query(

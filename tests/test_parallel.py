@@ -268,3 +268,21 @@ def test_hot_owner_cell_sharding_matches_single_device():
     for i in np.nonzero(exp_xor)[0]:
         exp_digest ^= int(hashes[i])
     assert got_digest == exp_digest
+
+
+def test_multihost_helpers_single_process():
+    """Single process hosts every shard; local_owners respects the
+    actual LPT shard assignment. (jax.distributed.initialize itself
+    must run before any backend exists, so it is not callable from
+    inside the suite — the helpers are the testable surface.)"""
+    import jax
+
+    from evolu_tpu.parallel import multihost
+    from evolu_tpu.parallel.mesh import assign_owners_to_shards, create_mesh
+
+    mesh = create_mesh()
+    assert not multihost.is_multihost()
+    assert multihost.local_shard_indices(mesh) == list(range(mesh.devices.size))
+    sizes = {f"o{i}": (i * 37) % 101 + 1 for i in range(10)}
+    shards = assign_owners_to_shards(sizes, mesh.devices.size)
+    assert sorted(multihost.local_owners(mesh, shards)) == sorted(sizes)

@@ -314,3 +314,25 @@ def test_periodic_sync_trigger(tmp_path):
         a.dispose(), b.dispose()
     finally:
         server.stop()
+
+
+def test_get_messages_identical_across_backends():
+    """The native packed reader and the Python query must return the
+    same payloads (their SQL lives in two places — this pins them)."""
+    from evolu_tpu.core.merkle import create_initial_merkle_tree
+    from evolu_tpu.storage.native import native_available
+
+    if not native_available():
+        pytest.skip("native backend unavailable")
+    stores = [RelayStore(backend="python"), RelayStore(backend="native")]
+    own = TS  # requester's own node id suffix
+    other = TS.replace("89e3b4f11a2c5d70", "0123456789abcdef")
+    outs = []
+    for store in stores:
+        store.add_messages("u1", [_enc(own, b"mine"), _enc(other, b"\x00\xffblob")])
+        tree = store.get_merkle_tree("u1")
+        msgs = store.get_messages("u1", "89e3b4f11a2c5d70", tree, create_initial_merkle_tree())
+        outs.append(msgs)
+        store.close()
+    assert outs[0] == outs[1]
+    assert [m.timestamp for m in outs[0]] == [other]
