@@ -24,8 +24,15 @@ import jax
 
 # Cold-start relief: kernels compile once per power-of-two bucket; a
 # persistent compilation cache makes that a per-machine (not
-# per-process) cost. Only set when the embedder hasn't configured one.
-if jax.config.jax_compilation_cache_dir is None and "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+# per-process) cost. Only set when the embedder hasn't configured one,
+# and never under a remote-compile tunnel — artifacts built by the
+# remote helper carry its machine features, and loading them on this
+# host risks SIGILL (XLA warns "machine type ... doesn't match").
+if (
+    jax.config.jax_compilation_cache_dir is None
+    and "JAX_COMPILATION_CACHE_DIR" not in os.environ
+    and os.environ.get("PALLAS_AXON_REMOTE_COMPILE") != "1"
+):
     _cache = os.path.join(os.path.expanduser("~"), ".cache", "evolu_tpu", "jax")
     try:
         os.makedirs(_cache, exist_ok=True)
