@@ -35,7 +35,7 @@ from evolu_tpu.core.types import CrdtClock, CrdtMessage, Owner, SyncError
 from evolu_tpu.runtime import messages as msg
 from evolu_tpu.runtime.jsonpatch import create_patch
 from evolu_tpu.runtime.synclock import SyncLock, get_sync_lock
-from evolu_tpu.storage.apply import apply_messages, plan_batch
+from evolu_tpu.storage.apply import apply_messages, apply_messages_chunked, plan_batch
 from evolu_tpu.storage.clock import read_clock, update_clock
 from evolu_tpu.storage.schema import delete_all_tables, init_db_model, update_db_schema
 from evolu_tpu.storage.sqlite import PySqliteDatabase
@@ -151,13 +151,33 @@ class DbWorker:
     def _push(self, request: msg.SyncRequestInput) -> None:
         self._staged_effects.append(lambda: self.post_sync(request))
 
+    def _manages_own_transactions(self, command: object) -> bool:
+        """A Receive large enough to chunk commits per chunk (bounded
+        transaction memory + resumable clock); every other command gets
+        the reference's one-transaction-per-command wrapper. Nested
+        transactions JOIN the outer one, so the chunked path must run
+        without it or per-chunk commits would silently be no-ops."""
+        chunk = self.config.receive_chunk_size
+        return (
+            isinstance(command, msg.Receive)
+            and bool(chunk)
+            and len(command.messages) > chunk
+        )
+
     def handle(self, command: object) -> None:
         """Dispatch one command inside one transaction; errors roll back
         and surface as OnError (db.worker.ts:57-73)."""
         self._staged_effects = []
         self._staged_cache: Dict[str, List[dict]] = {}
         try:
-            with self.db.transaction():
+            from contextlib import nullcontext
+
+            txn = (
+                nullcontext()
+                if self._manages_own_transactions(command)
+                else self.db.transaction()
+            )
+            with txn:
                 if isinstance(command, msg.Send):
                     self._send(command)
                 elif isinstance(command, msg.Receive):
@@ -249,11 +269,31 @@ class DbWorker:
                     t = receive_timestamp(
                         t, timestamp_from_string(m.timestamp), now, self.config.max_drift
                     )
-            tree = apply_messages(
-                self.db, clock.merkle_tree, list(command.messages), planner=self._planner
-            )
-            clock = CrdtClock(t, tree)
-            update_clock(self.db, clock)
+            messages = list(command.messages)
+            chunk = self.config.receive_chunk_size
+            if chunk and len(messages) > chunk:
+                # Huge history (e.g. initial sync of a restored device):
+                # blockwise apply with the clock persisted per chunk —
+                # the LWW contraction is associative, so the end state
+                # equals one giant batch, but memory stays bounded and a
+                # mid-sync failure resumes from the last chunk. The HLC
+                # timestamp is already merged over the WHOLE batch above,
+                # matching the reference's clock-then-apply order.
+                def persist(tree_so_far, _applied):
+                    update_clock(self.db, CrdtClock(t, tree_so_far))
+
+                tree = apply_messages_chunked(
+                    self.db, clock.merkle_tree, messages, chunk_size=chunk,
+                    planner=self._planner, on_chunk=persist,
+                )
+                # persist() already wrote the final clock with this tree.
+                clock = CrdtClock(t, tree)
+            else:
+                tree = apply_messages(
+                    self.db, clock.merkle_tree, messages, planner=self._planner
+                )
+                clock = CrdtClock(t, tree)
+                update_clock(self.db, clock)
             self._emit(msg.OnReceive())
 
         server_tree = merkle_tree_from_string(command.merkle_tree)

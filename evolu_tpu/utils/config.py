@@ -23,6 +23,11 @@ class Config:
     # (db.ts:390-412); a headless process needs a timer instead.
     sync_interval: "float | None" = None
     backend: str = "auto"  # "cpu" | "tpu" | "auto" — merge kernel backend
+    # Receive batches above this size apply blockwise (bounded device
+    # and transaction memory; the Merkle tree and clock persist per
+    # chunk, so a mid-sync crash resumes instead of replaying).
+    # None = whole-batch transactions always (reference semantics).
+    receive_chunk_size: "int | None" = 1 << 20
     min_device_batch: int = 1024  # below this, the CPU oracle path is faster than dispatch
 
 

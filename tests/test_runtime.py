@@ -462,3 +462,90 @@ def test_model_email_and_url_brands():
                 "http://exa mple.com/x", "http://\t.com", None, 5):
         with pytest.raises(ValidationError):
             model.validate_url(bad)
+
+
+def test_huge_receive_applies_chunked_with_identical_state(tmp_path):
+    """A receive batch above receive_chunk_size applies blockwise with
+    the clock persisted per chunk; the end state is identical to the
+    whole-batch path."""
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.core.types import CrdtMessage
+    from evolu_tpu.core.merkle import merkle_tree_to_string
+    from evolu_tpu.storage.clock import read_clock
+    from evolu_tpu.utils.config import Config
+
+    base = 1_700_000_000_000
+    messages = tuple(
+        CrdtMessage(
+            timestamp_to_string(Timestamp(base + i, 0, "b" * 16)),
+            "todo", f"r{i % 50}", "title", f"v{i}",
+        )
+        for i in range(500)
+    )
+    tree_str = "{}"
+
+    small = create_evolu(TODO_SCHEMA, config=Config(receive_chunk_size=64))
+    whole = create_evolu(TODO_SCHEMA, config=Config(receive_chunk_size=None),
+                         mnemonic=small.owner.mnemonic)
+    try:
+        for c in (small, whole):
+            c.receive(messages, tree_str, None)
+            c.worker.flush()
+        dump_a = small.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+        dump_b = whole.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+        assert len(dump_a) == 500 and dump_a == dump_b
+        ca, cb = read_clock(small.db), read_clock(whole.db)
+        assert merkle_tree_to_string(ca.merkle_tree) == merkle_tree_to_string(cb.merkle_tree)
+        # The HLC merged the remote max on both (wall clock/node differ
+        # per instance, so only the merged floor is deterministic).
+        assert ca.timestamp.millis >= base + 499
+        assert cb.timestamp.millis >= base + 499
+    finally:
+        small.dispose()
+        whole.dispose()
+
+
+def test_huge_receive_mid_failure_keeps_committed_chunks_coherent():
+    """With chunked receive, a poisoned later chunk must not roll back
+    earlier chunks, and the persisted clock's tree must cover exactly
+    the stored messages (digest coherence for resume)."""
+    from evolu_tpu.core.merkle import create_initial_merkle_tree, insert_into_merkle_tree, merkle_tree_to_string
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_from_string, timestamp_to_string
+    from evolu_tpu.core.types import CrdtMessage
+    from evolu_tpu.storage.clock import read_clock
+    from evolu_tpu.utils.config import Config
+
+    base = 1_700_000_000_000
+    good = [
+        CrdtMessage(
+            timestamp_to_string(Timestamp(base + i, 0, "b" * 16)),
+            "todo", f"r{i}", "title", f"v{i}",
+        )
+        for i in range(100)
+    ]
+    # Valid timestamp (the HLC fold must pass) but an apply-time failure:
+    # the table does not exist, so the LAST chunk's transaction fails.
+    poisoned = good + [
+        CrdtMessage(
+            timestamp_to_string(Timestamp(base + 200, 0, "b" * 16)),
+            "no_such_table", "rx", "title", "x",
+        )
+    ]
+
+    evolu = create_evolu(TODO_SCHEMA, config=Config(receive_chunk_size=40))
+    try:
+        errors = []
+        evolu.subscribe_error(errors.append)
+        evolu.receive(tuple(poisoned), "{}", None)
+        evolu.worker.flush()
+        assert errors, "poisoned batch must surface an error"
+        stored = evolu.db.exec('SELECT "timestamp" FROM "__message" ORDER BY "timestamp"')
+        # First chunks (2 x 40) committed; the poisoned final chunk rolled back.
+        assert len(stored) == 80
+        clock = read_clock(evolu.db)
+        expect = create_initial_merkle_tree()
+        for (ts,) in stored:
+            expect = insert_into_merkle_tree(timestamp_from_string(ts), expect)
+        assert merkle_tree_to_string(clock.merkle_tree) == merkle_tree_to_string(expect)
+    finally:
+        evolu.dispose()
