@@ -336,3 +336,22 @@ def test_get_messages_identical_across_backends():
         store.close()
     assert outs[0] == outs[1]
     assert [m.timestamp for m in outs[0]] == [other]
+
+
+def test_relay_rejects_oversized_body(tmp_path):
+    """20 MB body limit parity (index.ts:222): 413, no state change."""
+    import urllib.error
+    import urllib.request
+
+    server = RelayServer(RelayStore(str(tmp_path / "r.db"))).start()
+    try:
+        req = urllib.request.Request(
+            server.url + "/", data=b"", method="POST",
+            headers={"Content-Length": str(21 * 1024 * 1024)},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 413
+        assert server.store.db.exec('SELECT COUNT(*) FROM "message"') == [(0,)]
+    finally:
+        server.stop()
