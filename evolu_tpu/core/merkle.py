@@ -71,6 +71,26 @@ def insert_into_merkle_tree(t: Timestamp, tree: dict) -> dict:
     return new_tree
 
 
+def minute_deltas_host(timestamp_strings) -> tuple:
+    """Oracle-exact host fold over timestamp STRINGS already flagged for
+    insertion: → ({minute-key: int32 XOR delta}, uint32 digest). Parses
+    each string and hashes its canonical re-render with the node case
+    preserved VERBATIM (timestampToHash semantics) — the single shared
+    implementation behind every host fallback, so client, reconcile and
+    relay digests can never drift apart."""
+    from evolu_tpu.core.timestamp import timestamp_from_string
+
+    deltas: dict = {}
+    digest = 0
+    for s in timestamp_strings:
+        t = timestamp_from_string(s)
+        h = timestamp_to_hash(t)
+        k = minutes_base3(t.millis)
+        deltas[k] = to_int32(deltas.get(k, 0) ^ h)
+        digest ^= h & 0xFFFFFFFF
+    return deltas, digest
+
+
 def insert_many_into_merkle_tree(timestamps, tree: dict) -> dict:
     """Batch insert (order-independent since XOR commutes). In-place on a copy."""
     for t in timestamps:

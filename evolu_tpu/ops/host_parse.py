@@ -44,13 +44,23 @@ def _days_in_month(y, m):
 
 
 def parse_timestamp_strings(
-    timestamps: Sequence[str],
-) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    timestamps: Sequence[str], with_case: bool = False
+):
     """Batch `timestampFromString`: → (millis int64, counter int32,
-    node uint64). Validates the full fixed-width layout."""
+    node uint64). Validates the full fixed-width layout.
+
+    With `with_case=True`, appends a per-row bool array: True where the
+    row uses the canonical encoder's hex case (UPPERCASE counter,
+    lowercase node — timestamp.ts:43-48). Computed from the
+    already-built byte buffer, so the screen costs two slice compares,
+    not a second join+scan. Callers quarantine non-canonical rows to
+    host paths: the device kernels order by numeric keys and hash a
+    canonical re-render, which matches the reference's raw-string
+    order / verbatim-node hash only for canonical strings."""
     n = len(timestamps)
     if n == 0:
-        return (np.empty(0, np.int64), np.empty(0, np.int32), np.empty(0, np.uint64))
+        empty = (np.empty(0, np.int64), np.empty(0, np.int32), np.empty(0, np.uint64))
+        return (*empty, np.ones(0, bool)) if with_case else empty
     # Per-string length check FIRST: a joined-length check alone would
     # accept e.g. ["", "<two valid stamps concatenated>"] after reshape.
     if any(len(t) != _LEN for t in timestamps):
@@ -112,6 +122,13 @@ def parse_timestamp_strings(
 
     counter = hexv(25, 29).astype(np.int32)
     node = hexv(30, 46)
+    if with_case:
+        cb, nb = buf[:, 25:29], buf[:, 30:46]
+        case_ok = ~(
+            ((cb >= ord("a")) & (cb <= ord("f"))).any(axis=1)
+            | ((nb >= ord("A")) & (nb <= ord("F"))).any(axis=1)
+        )
+        return millis, counter, node, case_ok
     return millis, counter, node
 
 

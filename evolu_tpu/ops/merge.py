@@ -186,11 +186,19 @@ def messages_to_columns(
     the scalar parser's abort-the-transaction behavior).
 
     Returns numpy arrays (cell_id, k1, k2, ex_k1, ex_k2) plus the parsed
-    (millis, counter, node_u64) columns for the Merkle kernel.
+    (millis, counter, node_u64) columns for the Merkle kernel, plus a
+    trailing `canonical` bool: False when any message or stored winner
+    uses non-canonical hex case — the device kernels order by numeric
+    keys and hash a canonical re-render, which matches the reference's
+    raw-string order / verbatim-node hash ONLY for canonical strings,
+    so such batches must take the host oracle path.
     """
     from evolu_tpu.ops.host_parse import intern_cells, parse_timestamp_strings
 
-    millis, counter, node = parse_timestamp_strings([m.timestamp for m in messages])
+    millis, counter, node, case_ok = parse_timestamp_strings(
+        [m.timestamp for m in messages], with_case=True
+    )
+    canonical = bool(case_ok.all())
     cell_ids, cells = intern_cells(
         [m.table for m in messages], [m.row for m in messages],
         [m.column for m in messages],
@@ -201,9 +209,10 @@ def messages_to_columns(
     ex1_u = np.zeros(len(cells), np.uint64)
     ex2_u = np.zeros(len(cells), np.uint64)
     if winner_cids:
-        w_millis, w_counter, w_node = parse_timestamp_strings(
-            [existing_winners[cells[i]] for i in winner_cids]
+        w_millis, w_counter, w_node, w_case_ok = parse_timestamp_strings(
+            [existing_winners[cells[i]] for i in winner_cids], with_case=True
         )
+        canonical = canonical and bool(w_case_ok.all())
         ex1_u[winner_cids] = pack_ts_key_host(w_millis, w_counter)
         ex2_u[winner_cids] = w_node
     ex_k1 = ex1_u[cell_ids]
@@ -211,7 +220,7 @@ def messages_to_columns(
 
     k1 = pack_ts_key_host(millis, counter)
     k2 = node
-    return cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node
+    return cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node, canonical
 
 
 def pad_columns(arrays, n: int, pad_cell: bool = True):
@@ -238,12 +247,40 @@ def plan_batch_device(
     if n == 0:
         return [], []
     with span("kernel:merge", "plan_batch_device", n=n):
-        return _plan_batch_device_timed(messages, existing_winners)
+        plan = _plan_batch_device_timed(messages, existing_winners)
+    if plan is None:
+        return _host_fallback(messages, existing_winners, n)
+    return plan
+
+
+def _host_fallback(messages, existing_winners, n, with_deltas=False):
+    """Non-canonical hex case in the batch (or its stored winners):
+    device numeric order / canonical-render hash would diverge from the
+    reference's raw-string semantics, so route to the host oracle —
+    loudly, so a throughput collapse (e.g. an adversarial client
+    persisting a non-canonical winner into a hot cell) is visible in
+    the kernel logs. `with_deltas` keeps plan_batch_device_full's
+    3-tuple contract (host fold with verbatim node case)."""
+    from evolu_tpu.storage.apply import plan_batch
+    from evolu_tpu.utils.log import log
+
+    log("kernel:merge", "non-canonical hex case: host-planner fallback", n=n)
+    xor_mask, upserts = plan_batch(messages, existing_winners)
+    if not with_deltas:
+        return xor_mask, upserts
+    from evolu_tpu.core.merkle import minute_deltas_host
+
+    deltas, _ = minute_deltas_host(
+        m.timestamp for flag, m in zip(xor_mask, messages) if flag
+    )
+    return xor_mask, upserts, deltas
 
 
 def _plan_batch_device_timed(messages, existing_winners):
     n = len(messages)
-    cell_ids, k1, k2, ex_k1, ex_k2, *_ = messages_to_columns(messages, existing_winners)
+    cell_ids, k1, k2, ex_k1, ex_k2, *rest = messages_to_columns(messages, existing_winners)
+    if not rest[-1]:  # canonical flag
+        return None
     (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns([cell_ids, k1, k2, ex_k1, ex_k2], n)
     xor_mask, upsert_mask = plan_merge(
         jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
@@ -289,7 +326,9 @@ def plan_batch_device_full(
     if n == 0:
         return [], [], {}
     with span("kernel:merge", "plan_batch_device_full", n=n):
-        cell_ids, k1, k2, ex_k1, ex_k2, *_ = messages_to_columns(messages, existing_winners)
+        cell_ids, k1, k2, ex_k1, ex_k2, *rest = messages_to_columns(messages, existing_winners)
+        if not rest[-1]:  # canonical flag
+            return _host_fallback(messages, existing_winners, n, with_deltas=True)
         (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns(
             [cell_ids, k1, k2, ex_k1, ex_k2], n
         )

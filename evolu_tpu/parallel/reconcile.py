@@ -34,7 +34,7 @@ from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
 from evolu_tpu.ops.merge import _PAD_CELL, messages_to_columns, plan_merge_sorted_core, unpermute_masks
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, sharding
-from evolu_tpu.utils.log import span
+from evolu_tpu.utils.log import log, span
 
 
 
@@ -113,21 +113,30 @@ def build_owner_columns(
     """Host-side layout: per-owner columnarization → shard assignment →
     flat padded global columns + bookkeeping to scatter results back.
 
-    Returns (cols, index) where index maps owner → (global_positions
-    array aligned with that owner's message order, owner_ix).
+    Returns (cols, index, host_owners): `host_owners` are owners whose
+    batch (or stored winners) contain non-canonical hex case — the
+    device's numeric order / canonical-render hash would diverge from
+    the reference's raw-string semantics for them, so they are excluded
+    from the layout and must be planned on the host. Owners are
+    independent, so this quarantine is per owner, not per batch.
     """
     n_shards = mesh.devices.size
-    owners = list(owner_batches)
-    owner_ix = {o: i for i, o in enumerate(owners)}
+    owners = []
+    host_owners = []
     per_owner = {}
     cell_base = 0
-    for o in owners:
+    for o in owner_batches:
         msgs = owner_batches[o]
         cols = messages_to_columns(msgs, existing_winners.get(o, {}))
-        cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node = cols
+        cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node, canonical = cols
+        if not canonical:
+            host_owners.append(o)
+            continue
+        owners.append(o)
         cell_ids = cell_ids + cell_base
         cell_base += len(msgs)  # intern ids are < len(msgs)
         per_owner[o] = (cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node)
+    owner_ix = {o: i for i, o in enumerate(owners)}
 
     shards = assign_owners_to_shards({o: len(owner_batches[o]) for o in owners}, n_shards)
     shard_len = max((sum(len(owner_batches[o]) for o in s) for s in shards), default=0)
@@ -158,7 +167,7 @@ def build_owner_columns(
             out["owner_ix"][sl] = owner_ix[o]
             index[o] = (np.arange(pos, pos + n), owner_ix[o])
             pos += n
-    return out, index
+    return out, index, host_owners
 
 
 def reconcile_owner_batches(
@@ -182,20 +191,43 @@ def reconcile_owner_batches(
 
 
 def _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners):
-    cols, index = build_owner_columns(mesh, owner_batches, existing_winners)
-    xor_s, upsert_s, i_s, owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid, digest = (
-        reconcile_columns_sharded(mesh, cols)
-    )
-    shard_size = len(cols["cell_id"]) // mesh.devices.size
-    xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s, block_size=shard_size)
-    deltas_by_ix = decode_owner_minute_deltas(
-        owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid
-    )
-
+    cols, index, host_owners = build_owner_columns(mesh, owner_batches, existing_winners)
     results = {}
-    for owner, (positions, o_ix) in index.items():
-        messages = owner_batches[owner]
-        o_xor = [bool(xor_mask[p]) for p in positions]
-        upserts = [m for j, m in enumerate(messages) if upsert_mask[positions[j]]]
-        results[owner] = (o_xor, upserts, deltas_by_ix.get(o_ix, {}))
-    return results, int(digest)
+    digest = 0
+    if index:
+        xor_s, upsert_s, i_s, owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid, dev_digest = (
+            reconcile_columns_sharded(mesh, cols)
+        )
+        shard_size = len(cols["cell_id"]) // mesh.devices.size
+        xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s, block_size=shard_size)
+        deltas_by_ix = decode_owner_minute_deltas(
+            owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid
+        )
+        digest = int(dev_digest)
+        for owner, (positions, o_ix) in index.items():
+            messages = owner_batches[owner]
+            o_xor = [bool(xor_mask[p]) for p in positions]
+            upserts = [m for j, m in enumerate(messages) if upsert_mask[positions[j]]]
+            results[owner] = (o_xor, upserts, deltas_by_ix.get(o_ix, {}))
+    for owner in host_owners:
+        log("kernel:reconcile", "non-canonical hex case: host-planner fallback",
+            owner=owner, n=len(owner_batches[owner]))
+        plan, owner_digest = _host_owner_plan(
+            owner_batches[owner], existing_winners.get(owner, {})
+        )
+        results[owner] = plan
+        digest ^= owner_digest
+    return results, digest
+
+
+def _host_owner_plan(messages, winners):
+    """Oracle-exact host plan for one quarantined owner: raw-string LWW
+    order + the shared verbatim-case hash fold."""
+    from evolu_tpu.core.merkle import minute_deltas_host
+    from evolu_tpu.storage.apply import plan_batch
+
+    xor_mask, upserts = plan_batch(messages, winners)
+    deltas, digest = minute_deltas_host(
+        m.timestamp for flag, m in zip(xor_mask, messages) if flag
+    )
+    return (xor_mask, upserts, deltas), digest

@@ -59,9 +59,9 @@ def select_planner(config: Config) -> Callable:
 
     def planner(batch, existing):
         if len(batch) >= threshold:
-            # Returns (xor_mask, upserts, deltas): the device also
-            # computes the Merkle minute deltas, so the apply path does
-            # no per-message Python hashing.
+            # Always (xor_mask, upserts, deltas): minute deltas come
+            # from the device kernel, or from the host fold when the
+            # batch carries non-canonical hex case.
             return plan_batch_device_full(batch, existing)
         return plan_batch(batch, existing)
 

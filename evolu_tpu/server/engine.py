@@ -36,7 +36,7 @@ from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_se
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, create_mesh, sharding
 from evolu_tpu.parallel.reconcile import xor_allreduce
 from evolu_tpu.server.relay import RelayStore
-from evolu_tpu.utils.log import span
+from evolu_tpu.utils.log import log, span
 from evolu_tpu.sync import protocol
 
 
@@ -69,17 +69,69 @@ def owner_minute_deltas(
     mesh: Mesh, owner_rows: Dict[str, Sequence[str]]
 ) -> Tuple[Dict[str, Dict[str, int]], int]:
     """Device pass: {owner: [timestamp strings]} → per-owner
-    {minute-key: xor delta} plus the global batch digest."""
-    owners = list(owner_rows)
-    with span("kernel:merkle", "owner_minute_deltas", owners=len(owners),
+    {minute-key: xor delta} plus the global batch digest.
+
+    The device hash re-renders the node hex lowercase; the reference
+    hashes the parsed node verbatim (timestampToHash of the parsed
+    Timestamp, index.ts:155). Owners whose rows carry non-canonical hex
+    case are quarantined to the shared host fold (the per-row case flag
+    rides out of the batch parse, costing nothing extra); the other
+    owners in the batch stay on device — owners are independent."""
+    with span("kernel:merkle", "owner_minute_deltas",
+              owners=len(owner_rows),
               n=sum(len(v) for v in owner_rows.values())):
         return _owner_minute_deltas_timed(mesh, owner_rows)
 
 
+def _owner_minute_deltas_host(
+    owner_rows: Dict[str, Sequence[str]]
+) -> Tuple[Dict[str, Dict[str, int]], int]:
+    """Oracle-exact host fallback: the shared verbatim-case fold."""
+    from evolu_tpu.core.merkle import minute_deltas_host
+
+    deltas: Dict[str, Dict[str, int]] = {}
+    digest = 0
+    for o, rows in owner_rows.items():
+        deltas[o], d = minute_deltas_host(rows)
+        digest ^= d
+    return deltas, digest
+
+
 def _owner_minute_deltas_timed(mesh, owner_rows):
     owners = list(owner_rows)
-    owner_ix = {o: i for i, o in enumerate(owners)}
-    shards = assign_owners_to_shards({o: len(owner_rows[o]) for o in owners}, mesh.devices.size)
+    # ONE vectorized parse for every owner's timestamps (per-owner calls
+    # would pay the numpy setup ~owners times); the per-row case flags
+    # mark owners that must take the host fold.
+    flat = [ts for o in owners for ts in owner_rows[o]]
+    all_m, all_c, all_n, case_ok = parse_timestamp_strings(flat, with_case=True)
+    bounds: Dict[str, slice] = {}
+    host_owners: List[str] = []
+    pos = 0
+    for o in owners:
+        k = len(owner_rows[o])
+        bounds[o] = slice(pos, pos + k)
+        if k and not case_ok[bounds[o]].all():
+            host_owners.append(o)
+        pos += k
+
+    deltas: Dict[str, Dict[str, int]] = {o: {} for o in owners}
+    digest = 0
+    if host_owners:
+        log("kernel:merkle", "non-canonical hex case: host hashing fallback",
+            owners=len(host_owners))
+        host_deltas, host_digest = _owner_minute_deltas_host(
+            {o: owner_rows[o] for o in host_owners}
+        )
+        deltas.update(host_deltas)
+        digest ^= host_digest
+
+    quarantined = set(host_owners)
+    good = [o for o in owners if o not in quarantined]
+    if not any(len(owner_rows[o]) for o in good):
+        return deltas, digest
+
+    owner_ix = {o: i for i, o in enumerate(good)}
+    shards = assign_owners_to_shards({o: len(owner_rows[o]) for o in good}, mesh.devices.size)
     shard_len = max((sum(len(owner_rows[o]) for o in s) for s in shards), default=0)
     shard_size = bucket_size(max(shard_len, 1))
     total = mesh.devices.size * shard_size
@@ -89,41 +141,33 @@ def _owner_minute_deltas_timed(mesh, owner_rows):
     node = np.zeros(total, np.uint64)
     valid = np.zeros(total, bool)
     oix = np.zeros(total, np.int64)
-    # ONE vectorized parse for every owner's timestamps (per-owner calls
-    # would pay the numpy setup ~owners times), then slice into the
-    # shard-contiguous layout.
-    ordered = [(o, owner_rows[o]) for shard in shards for o in shard]
-    flat = [ts for _, rows in ordered for ts in rows]
-    all_m, all_c, all_n = parse_timestamp_strings(flat)
-    src = 0
     pos_by_shard = [si * shard_size for si in range(len(shards))]
     shard_of_owner = {o: si for si, shard in enumerate(shards) for o in shard}
-    for o, rows in ordered:
-        n = len(rows)
+    for o in good:
+        src = bounds[o]
+        n = src.stop - src.start
         if not n:
             continue
         si = shard_of_owner[o]
         pos = pos_by_shard[si]
         sl = slice(pos, pos + n)
-        millis[sl] = all_m[src : src + n]
-        counter[sl] = all_c[src : src + n]
-        node[sl] = all_n[src : src + n]
+        millis[sl] = all_m[src]
+        counter[sl] = all_c[src]
+        node[sl] = all_n[src]
         valid[sl] = True
         oix[sl] = owner_ix[o]
         pos_by_shard[si] = pos + n
-        src += n
 
     shd = sharding(mesh)
     args = [jax.device_put(a, shd) for a in (millis, counter, node, valid, oix)]
-    owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, digest = (
+    owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, dev_digest = (
         _compiled_merkle_kernel(mesh)(*args)
     )
 
     by_ix = decode_owner_minute_deltas(owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted)
-    deltas: Dict[str, Dict[str, int]] = {o: {} for o in owners}
     for o_ix, d in by_ix.items():
-        deltas[owners[o_ix]] = d
-    return deltas, int(digest)
+        deltas[good[o_ix]] = d
+    return deltas, digest ^ int(dev_digest)
 
 
 class BatchReconciler:

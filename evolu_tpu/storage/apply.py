@@ -25,9 +25,12 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
-from evolu_tpu.core.merkle import insert_into_merkle_tree, apply_prefix_xors, minutes_base3
-from evolu_tpu.core.murmur import to_int32
-from evolu_tpu.core.timestamp import timestamp_from_string, timestamp_to_hash
+from evolu_tpu.core.merkle import (
+    apply_prefix_xors,
+    insert_into_merkle_tree,
+    minute_deltas_host,
+)
+from evolu_tpu.core.timestamp import timestamp_from_string
 from evolu_tpu.core.types import CrdtMessage
 from evolu_tpu.storage.sqlite import PySqliteDatabase, quote_ident
 
@@ -165,19 +168,14 @@ def apply_messages(
             xor_mask, upserts, deltas = plan
         else:
             xor_mask, upserts = plan
-            # Merkle deltas: aggregate XOR per minute key. Computed BEFORE
-            # any write so a malformed timestamp rolls the whole batch
-            # back — committing messages whose hashes never reach the tree
-            # would diverge the digest permanently. Hash the canonical
-            # re-rendered form (timestamp_to_hash), exactly as the
-            # sequential oracle does — raw wire strings may be
-            # non-canonical.
-            deltas = {}
-            for i, m in enumerate(messages):
-                if xor_mask[i]:
-                    ts = timestamp_from_string(m.timestamp)
-                    key = minutes_base3(ts.millis)
-                    deltas[key] = to_int32(deltas.get(key, 0) ^ timestamp_to_hash(ts))
+            # Merkle deltas: the shared oracle-exact fold (verbatim node
+            # case). Computed BEFORE any write so a malformed timestamp
+            # rolls the whole batch back — committing messages whose
+            # hashes never reach the tree would diverge the digest
+            # permanently.
+            deltas, _ = minute_deltas_host(
+                m.timestamp for i, m in enumerate(messages) if xor_mask[i]
+            )
 
         if hasattr(db, "apply_planned"):
             # C++ backend: upserts + bulk __message insert in one call.
@@ -239,26 +237,27 @@ def apply_messages_chunked(
     exceeding HBM (SURVEY.md §5 long-context analog). Each chunk commits
     its own transaction, bounding both device and transaction memory.
 
-    `on_chunk(tree, applied_count)` runs after each committed chunk so
-    callers can persist the tree incrementally; if a later chunk fails,
-    `ChunkedApplyError` carries the partial tree covering everything
-    committed so far (unlike `apply_messages`, failure here is not
-    all-or-nothing — earlier chunks stay committed).
+    `on_chunk(tree, applied_count)` runs INSIDE the chunk's transaction,
+    so the chunk's rows and whatever the callback persists (typically
+    the clock with the updated tree) commit atomically — a crash can
+    never leave committed __message rows whose hashes missed the
+    persisted tree, which would be a permanent digest divergence (the
+    re-received winner XORs with xor=false and its hash could never
+    re-enter the tree). If a chunk or its callback fails, that whole
+    chunk rolls back and `ChunkedApplyError` carries the tree and count
+    covering the chunks that DID commit (unlike `apply_messages`,
+    failure here is not all-or-nothing — earlier chunks stay committed).
     """
     applied = 0
     for i in range(0, len(messages), chunk_size):
         chunk = messages[i : i + chunk_size]
         try:
-            merkle_tree = apply_messages(db, merkle_tree, chunk, planner)
+            with db.transaction():
+                next_tree = apply_messages(db, merkle_tree, chunk, planner)
+                if on_chunk is not None:
+                    on_chunk(next_tree, applied + len(chunk))
         except Exception as e:
             raise ChunkedApplyError(merkle_tree, applied, e) from e
+        merkle_tree = next_tree
         applied += len(chunk)
-        if on_chunk is not None:
-            try:
-                on_chunk(merkle_tree, applied)
-            except Exception as e:
-                # The chunk IS committed; the caller still needs the tree
-                # covering it, so persistence-callback failures use the
-                # same partial-tree contract.
-                raise ChunkedApplyError(merkle_tree, applied, e) from e
     return merkle_tree

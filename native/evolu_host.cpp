@@ -251,9 +251,16 @@ int eh_fetch_winners(sqlite3 *db, int64_t n, const char *const *tables,
     int rc = sqlite3_step(st);
     char *dst = out + i * out_cap;
     if (rc == SQLITE_ROW) {
+      // NULL is possible despite the PK (SQLite's legacy non-INTEGER
+      // BLOB PRIMARY KEY quirk allows NULL in tampered/corrupt DBs);
+      // treat it as no-winner rather than reading a null pointer.
       const unsigned char *t = sqlite3_column_text(st, 0);
-      std::strncpy(dst, reinterpret_cast<const char *>(t), out_cap - 1);
-      dst[out_cap - 1] = '\0';
+      if (t == nullptr) {
+        dst[0] = '\0';
+      } else {
+        std::strncpy(dst, reinterpret_cast<const char *>(t), out_cap - 1);
+        dst[out_cap - 1] = '\0';
+      }
     } else if (rc == SQLITE_DONE) {
       dst[0] = '\0';
     } else {
@@ -293,8 +300,13 @@ int eh_apply_sequential(sqlite3 *db, int64_t n, const char *const *timestamps,
     bool has_winner = rc == SQLITE_ROW;
     if (!has_winner && rc != SQLITE_DONE) return 1;
     std::string winner;
-    if (has_winner)
-      winner = reinterpret_cast<const char *>(sqlite3_column_text(sel, 0));
+    if (has_winner) {
+      const unsigned char *w = sqlite3_column_text(sel, 0);
+      if (w == nullptr)  // tampered DB: NULL in the BLOB PK column
+        has_winner = false;
+      else
+        winner = reinterpret_cast<const char *>(w);
+    }
     sqlite3_reset(sel);
     sqlite3_clear_bindings(sel);
 

@@ -228,6 +228,37 @@ def test_chunked_apply_matches_single_batch():
     a.close(), b.close()
 
 
+def test_chunked_apply_callback_failure_rolls_back_chunk_atomically():
+    """The chunk's rows and whatever on_chunk persists (the clock)
+    commit atomically: a callback failure — simulating a crash between
+    apply and clock persist — rolls back the WHOLE chunk, so committed
+    __message rows can never outrun the persisted tree (which would be
+    a permanent digest divergence on resync)."""
+    from evolu_tpu.storage.apply import ChunkedApplyError, apply_messages_chunked
+
+    msgs = make_contention_workload(n_replicas=4, n_rows=5, writes_per_replica=5)
+    half = len(msgs) // 2
+    db = fresh_db()
+    calls = []
+
+    def persist_then_crash(tree, n):
+        calls.append(n)
+        if len(calls) == 2:
+            raise RuntimeError("crash before clock persist")
+
+    with pytest.raises(ChunkedApplyError) as ei:
+        apply_messages_chunked(db, {}, msgs, chunk_size=half, on_chunk=persist_then_crash)
+    err = ei.value
+    assert calls == [half, len(msgs)] and err.applied == half
+    # The failed chunk's rows rolled back with the callback: end state ==
+    # first chunk only, and the error's tree covers exactly those rows.
+    fresh = fresh_db()
+    expect_tree = apply_messages(fresh, {}, msgs[:half])
+    assert dump(db) == dump(fresh)
+    assert merkle_tree_to_string(err.partial_tree) == merkle_tree_to_string(expect_tree)
+    db.close(), fresh.close()
+
+
 def test_chunked_apply_failure_carries_partial_tree():
     from evolu_tpu.storage.apply import ChunkedApplyError, apply_messages_chunked
 

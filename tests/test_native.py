@@ -230,3 +230,27 @@ def test_trailing_comments_accepted_like_python():
     assert db.exec("SELECT 1; -- done") == [(1,)]
     assert db.exec("SELECT 2; /* trailing\n block */ ;") == [(2,)]
     db.close()
+
+
+def test_null_timestamp_row_does_not_crash_native_backend():
+    """SQLite's legacy quirk lets a non-INTEGER BLOB PRIMARY KEY hold
+    NULL; a tampered DB must yield defined behavior (NULL = no winner),
+    not a null-pointer read, on both the fetch_winners and
+    apply_sequential hot paths (ADVICE r1 low)."""
+    db = open_database(backend="native")
+    bootstrap(db)
+    db.run(
+        'INSERT INTO "__message" ("timestamp", "table", "row", "column", "value") '
+        "VALUES (NULL, 'todo', 'r1', 'title', 'ghost')"
+    )
+    # fetch_winners: the NULL row is the only row for the cell. MAX/
+    # ORDER BY DESC places NULL last, so it is also what the scan sees.
+    winners = db.fetch_winners([("todo", "r1", "title")])
+    assert winners == [None] or winners == [""] or winners[0] is None
+    # apply_sequential: NULL winner treated as absent -> message wins.
+    m = CrdtMessage(ts(1_700_000_000_000), "todo", "r1", "title", "real")
+    mask = db.apply_sequential([m])
+    assert list(mask) == [True]
+    rows = db.exec('SELECT "title" FROM "todo" WHERE "id" = \'r1\'')
+    assert rows == [("real",)]
+    db.close()

@@ -287,6 +287,66 @@ def test_plan_batch_device_full_matches_python_deltas():
     assert deltas == expect
 
 
+def test_non_canonical_hex_case_routes_to_host_oracle():
+    """ADVICE r1 (medium): an uppercase-node wire timestamp is valid per
+    the parser but the device kernel hashes a lowercased re-render and
+    orders by numeric keys, both diverging from the reference's raw
+    string semantics — e.g. nodes ABCDEF… and abcdef… parse to the SAME
+    u64 yet are DIFFERENT timestamps under string order. Non-canonical
+    batches must produce oracle-identical state on every entry point."""
+    from test_apply import dump, make_db
+
+    from evolu_tpu.core.types import CrdtMessage
+    from evolu_tpu.ops.host_parse import parse_timestamp_strings
+    from evolu_tpu.ops.merge import plan_batch_device, plan_batch_device_full
+    from evolu_tpu.storage.apply import apply_messages, apply_messages_sequential
+
+    *_, case_ok = parse_timestamp_strings(
+        ["2022-07-03T18:41:40.000Z-0000-" + "a" * 16,
+         "2022-07-03T18:41:40.000Z-0000-ABCDEF0123456789",
+         "2022-07-03T18:41:40.000Z-00ab-" + "a" * 16],
+        with_case=True,
+    )
+    assert list(case_ok) == [True, False, False]
+
+    row = "r" * 21
+    msgs = [
+        # Same millis/counter; same node u64, different node STRINGS.
+        CrdtMessage("2022-07-03T18:41:40.000Z-0000-ABCDEF0123456789", "todo", row, "title", "U"),
+        CrdtMessage("2022-07-03T18:41:40.000Z-0000-abcdef0123456789", "todo", row, "title", "L"),
+        CrdtMessage("2022-07-03T18:41:41.000Z-0000-" + "b" * 16, "todo", row, "isCompleted", 1),
+    ]
+    for planner in (plan_batch_device, plan_batch_device_full):
+        db_seq, db_dev = make_db(), make_db()
+        tree_seq = apply_messages_sequential(db_seq, {}, msgs)
+        tree_dev = apply_messages(db_dev, {}, msgs, planner=planner)
+        assert dump(db_seq) == dump(db_dev)
+        assert tree_seq == tree_dev
+
+
+def test_server_deltas_non_canonical_owner_quarantined():
+    """The relay hashes the parsed timestamp with node case verbatim
+    (index.ts:155); an owner with non-canonical rows is quarantined to
+    the host fold while canonical co-batched owners stay on device —
+    the merged result must equal the reference fold for every owner."""
+    from evolu_tpu.core.merkle import minute_deltas_host
+    from evolu_tpu.parallel.mesh import create_mesh
+    from evolu_tpu.server.engine import owner_minute_deltas
+
+    rows = {
+        "weird": ["2022-07-03T18:41:40.000Z-0000-ABCDEF0123456789",
+                  "2022-07-03T18:41:40.000Z-0001-" + "c" * 16],
+        "clean": [f"2022-07-03T18:4{i}:00.000Z-0000-" + "d" * 16 for i in range(4)],
+    }
+    deltas, digest = owner_minute_deltas(create_mesh(), rows)
+    expect_digest = 0
+    for o, ts_list in rows.items():
+        expect, d = minute_deltas_host(ts_list)
+        assert deltas[o] == expect, o
+        expect_digest ^= d
+    assert digest == expect_digest
+
+
 def test_vectorized_parse_field_range_and_case_parity():
     import pytest as _pytest
 

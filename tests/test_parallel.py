@@ -97,6 +97,40 @@ def test_sharded_reconcile_respects_existing_winners():
     assert xor_mask == [True] * len(msgs)  # hashes still enter the tree
 
 
+def test_non_canonical_owner_quarantined_to_host_path():
+    """An owner whose batch carries non-canonical hex case (uppercase
+    node) is planned on the host with raw-string order and verbatim-case
+    hashing; canonical owners stay on device; the combined digest covers
+    both."""
+    from evolu_tpu.core.merkle import minutes_base3
+
+    mesh = create_mesh()
+    clean = _mk_messages("c" * 16, 23)
+    weird = [
+        CrdtMessage("2022-07-03T18:41:40.000Z-0000-ABCDEF0123456789", "todo", "r", "title", "U"),
+        CrdtMessage("2022-07-03T18:41:40.000Z-0000-abcdef0123456789", "todo", "r", "title", "L"),
+        CrdtMessage("2022-07-03T18:41:41.000Z-0000-" + "b" * 16, "todo", "r2", "title", "x"),
+    ]
+    batches = {"clean": clean, "weird": weird}
+    results, digest = reconcile_owner_batches(mesh, batches, {o: {} for o in batches})
+
+    expected_digest = 0
+    for owner, msgs in batches.items():
+        xor_mask, upserts, deltas = results[owner]
+        exp_xor, exp_upserts = plan_batch(msgs, {})
+        assert xor_mask == exp_xor, owner
+        assert set(upserts) == set(exp_upserts), owner
+        exp_deltas = {}
+        for i, m in enumerate(msgs):
+            if exp_xor[i]:
+                ts = timestamp_from_string(m.timestamp)
+                k = minutes_base3(ts.millis)
+                exp_deltas[k] = to_int32(exp_deltas.get(k, 0) ^ timestamp_to_hash(ts))
+                expected_digest ^= timestamp_to_hash(ts) & 0xFFFFFFFF
+        assert deltas == exp_deltas, owner
+    assert digest == expected_digest
+
+
 def test_single_owner_many_devices_and_empty():
     mesh = create_mesh()
     results, digest = reconcile_owner_batches(mesh, {}, {})
