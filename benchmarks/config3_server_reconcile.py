@@ -1,13 +1,17 @@
 """BASELINE config 3, full system: batch-reconcile encrypted messages
 across many owners through the relay's BatchReconciler — protobuf-shaped
 requests in, SQLite + per-owner Merkle trees out, device pass for the
-per-(owner, minute) XOR deltas. The end state is identical to running
-`store.sync` per request (asserted on a sample).
+per-(owner, minute) XOR deltas, storage sharded per owner with parallel
+shard writers. The end state is identical to running `store.sync` per
+request (asserted on a sample).
+
+Steady-state shape: each client pushes its own new messages with its
+post-apply tree (how the reference sync protocol actually behaves), so
+responses are empty; a separate cold-sync leg measures full-history
+response packing for restored devices with empty trees.
 
 The kernel-only number for this shape is bench.py; this measures the
-whole server path a pod would run.
-
-Prints one JSON line.
+whole server path a pod would run. Prints one JSON line.
 """
 
 import json
@@ -18,13 +22,20 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from evolu_tpu.core.merkle import (
+    apply_prefix_xors,
+    merkle_tree_to_string,
+    minute_deltas_host,
+)
 from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
 from evolu_tpu.server.engine import BatchReconciler
-from evolu_tpu.server.relay import RelayStore
+from evolu_tpu.server.relay import RelayStore, ShardedRelayStore
 from evolu_tpu.sync import protocol
 
-N = int(os.environ.get("CONFIG3_N", 200_000))
-OWNERS = int(os.environ.get("CONFIG3_OWNERS", 200))
+N = int(os.environ.get("CONFIG3_N", 1_000_000))
+OWNERS = int(os.environ.get("CONFIG3_OWNERS", 1000))
+SHARDS = int(os.environ.get("CONFIG3_SHARDS", 8))
+COLD = int(os.environ.get("CONFIG3_COLD", 25))
 
 
 def build_requests(n=N, owners=OWNERS, seed=3):
@@ -37,13 +48,17 @@ def build_requests(n=N, owners=OWNERS, seed=3):
         per_owner.setdefault(o, []).append(
             protocol.EncryptedCrdtMessage(timestamp_to_string(t), b"\x00" * 64)
         )
-    from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_string
-
-    empty = merkle_tree_to_string(create_initial_merkle_tree())
-    return [
-        protocol.SyncRequest(tuple(msgs), f"owner{o:04d}", "f" * 16, empty)
-        for o, msgs in per_owner.items()
-    ]
+    requests = []
+    for o, msgs in per_owner.items():
+        # Steady state: the client's tree already covers its own pushed
+        # messages (send applies locally before syncing), and the server
+        # holds nothing else for this owner.
+        deltas, _ = minute_deltas_host(m.timestamp for m in msgs)
+        tree = merkle_tree_to_string(apply_prefix_xors({}, deltas))
+        requests.append(
+            protocol.SyncRequest(tuple(msgs), f"owner{o:04d}", "f" * 16, tree)
+        )
+    return requests
 
 
 def main():
@@ -52,14 +67,15 @@ def main():
 
     # Warm the jit with the SAME batch shape (jit traces per bucket
     # size) on a throwaway store, so the timed run measures steady state.
-    warm = BatchReconciler(RelayStore())
+    warm = BatchReconciler(ShardedRelayStore(shards=SHARDS))
     warm.reconcile(build_requests())
 
-    store = RelayStore()
+    store = ShardedRelayStore(shards=SHARDS)
     engine = BatchReconciler(store, warm.mesh)
     t0 = time.perf_counter()
     responses = engine.reconcile(requests)
     elapsed = time.perf_counter() - t0
+    assert all(r.messages == () for r in responses), "steady state must answer empty"
 
     # Spot-check: per-request sync on a fresh store gives the same tree.
     sample = requests[0]
@@ -67,7 +83,22 @@ def main():
     solo_resp = solo.sync(sample)
     assert responses[0].merkle_tree == solo_resp.merkle_tree, "batch != per-request"
 
-    stored = store.db.exec('SELECT COUNT(*) FROM "message"')[0][0]
+    # Cold-sync leg: restored devices (empty tree, different node) pull
+    # their owner's full history.
+    cold = [
+        protocol.SyncRequest((), r.user_id, "e" * 16, "{}")
+        for r in requests[:COLD]
+    ]
+    t1 = time.perf_counter()
+    cold_responses = engine.reconcile(cold)
+    cold_elapsed = time.perf_counter() - t1
+    cold_msgs = sum(len(r.messages) for r in cold_responses)
+    assert cold_msgs == sum(len(r.messages) for r in requests[:COLD])
+
+    stored = sum(
+        s.db.exec('SELECT COUNT(*) FROM "message"')[0][0] for s in store.shards
+    )
+    assert stored == n_msgs
     print(json.dumps({
         "metric": "config3_server_reconcile_msgs_per_sec",
         "value": round(n_msgs / elapsed),
@@ -76,7 +107,10 @@ def main():
             "messages": n_msgs, "owners": len(requests), "stored": stored,
             "elapsed_s": round(elapsed, 3),
             "devices": engine.mesh.devices.size,
-            "backend": type(store.db).__name__,
+            "storage_shards": SHARDS,
+            "cold_sync_msgs_per_sec": round(cold_msgs / cold_elapsed),
+            "cold_requests": COLD,
+            "backend": type(store.shards[0].db).__name__,
         },
     }))
     store.close(), solo.close(), warm.store.close()

@@ -68,7 +68,11 @@ def parse_timestamp_strings(
     joined = "".join(timestamps)
     if not joined.isascii():
         raise TimestampParseError("malformed timestamp in batch")
-    buf = np.frombuffer(joined.encode("ascii"), np.uint8).reshape(n, _LEN)
+    packed = joined.encode("ascii")
+    native = parse_packed_timestamps(packed, n, with_case=with_case, strict=False)
+    if native is not None:
+        return native
+    buf = np.frombuffer(packed, np.uint8).reshape(n, _LEN)
 
     # Fixed separators.
     seps = {4: ord("-"), 7: ord("-"), 10: ord("T"), 13: ord(":"), 16: ord(":"),
@@ -129,6 +133,46 @@ def parse_timestamp_strings(
             | ((nb >= ord("A")) & (nb <= ord("F"))).any(axis=1)
         )
         return millis, counter, node, case_ok
+    return millis, counter, node
+
+
+def parse_packed_timestamps(
+    packed: bytes, n: int, with_case: bool = False, strict: bool = True
+):
+    """Native (C) batch parse over an already-packed buffer of n
+    46-byte records — one pass instead of ~40 vectorized numpy passes,
+    and no join when the caller already built the buffer (the packed
+    relay ingest reuses its insert buffer here).
+
+    Returns the same tuple as `parse_timestamp_strings`. With
+    `strict=False`, returns None when the native library is
+    unavailable so the caller can fall back to numpy."""
+    from evolu_tpu.storage.native import load_library
+
+    lib = load_library()
+    if lib is None:
+        if strict:
+            raise RuntimeError("native host library unavailable")
+        return None
+    if len(packed) != n * _LEN:
+        raise TimestampParseError("malformed timestamp in batch")
+    import ctypes
+
+    millis = np.empty(n, np.int64)
+    counter = np.empty(n, np.int32)
+    node = np.empty(n, np.uint64)
+    case_ok = np.empty(n, np.uint8)
+    rc = lib.eh_parse_timestamps(
+        packed, n,
+        millis.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        counter.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        node.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        case_ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if rc != 0:
+        raise TimestampParseError("malformed timestamp in batch")
+    if with_case:
+        return millis, counter, node, case_ok.astype(bool)
     return millis, counter, node
 
 

@@ -141,9 +141,11 @@ def unpermute_masks(xor_sorted, upsert_sorted, i_s, block_size: int = 0):
     order. With `block_size` > 0 the arrays are concatenated per-shard
     blocks whose `i_s` values are shard-local (the shard_map layout);
     each block unpermutes within its own span."""
-    xor_sorted = np.asarray(xor_sorted)
-    upsert_sorted = np.asarray(upsert_sorted)
-    i_s = np.asarray(i_s).astype(np.int64)
+    from evolu_tpu.ops import to_host
+
+    xor_sorted = to_host(xor_sorted)
+    upsert_sorted = to_host(upsert_sorted)
+    i_s = to_host(i_s).astype(np.int64)
     if block_size:
         base = (np.arange(len(i_s), dtype=np.int64) // block_size) * block_size
         i_s = i_s + base

@@ -28,7 +28,7 @@ import numpy as np
 
 from evolu_tpu.core.merkle import minutes_base3
 from evolu_tpu.core.murmur import to_int32
-from evolu_tpu.ops import with_x64
+from evolu_tpu.ops import to_host, with_x64
 from evolu_tpu.ops.encode import timestamp_hashes
 
 
@@ -98,10 +98,10 @@ def decode_owner_minute_deltas(
     never splits an owner so keys are unique there, but the hot-owner
     cell sharding produces one partial delta per shard per minute and
     relies on the XOR merge being exact (associative/commutative)."""
-    owner_sorted = np.asarray(owner_sorted)
-    minute_sorted = np.asarray(minute_sorted)
-    ends = np.asarray(seg_end) & np.asarray(valid_sorted)
-    xs = np.asarray(seg_xor)
+    owner_sorted = to_host(owner_sorted)
+    minute_sorted = to_host(minute_sorted)
+    ends = to_host(seg_end) & to_host(valid_sorted)
+    xs = to_host(seg_xor)
     out: Dict[int, Dict[str, int]] = {}
     for i in np.nonzero(ends)[0]:
         o_ix, minute = int(owner_sorted[i]), int(minute_sorted[i])

@@ -31,7 +31,7 @@ from jax import shard_map
 from evolu_tpu.core.merkle import apply_prefix_xors, merkle_tree_to_string
 from evolu_tpu.ops import bucket_size, with_x64
 from evolu_tpu.ops.encode import timestamp_hashes
-from evolu_tpu.ops.host_parse import parse_timestamp_strings
+from evolu_tpu.ops.host_parse import parse_packed_timestamps, parse_timestamp_strings
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, create_mesh, sharding
 from evolu_tpu.parallel.reconcile import xor_allreduce
@@ -83,20 +83,6 @@ def owner_minute_deltas(
         return _owner_minute_deltas_timed(mesh, owner_rows)
 
 
-def _owner_minute_deltas_host(
-    owner_rows: Dict[str, Sequence[str]]
-) -> Tuple[Dict[str, Dict[str, int]], int]:
-    """Oracle-exact host fallback: the shared verbatim-case fold."""
-    from evolu_tpu.core.merkle import minute_deltas_host
-
-    deltas: Dict[str, Dict[str, int]] = {}
-    digest = 0
-    for o, rows in owner_rows.items():
-        deltas[o], d = minute_deltas_host(rows)
-        digest ^= d
-    return deltas, digest
-
-
 def _owner_minute_deltas_timed(mesh, owner_rows):
     owners = list(owner_rows)
     # ONE vectorized parse for every owner's timestamps (per-owner calls
@@ -104,35 +90,56 @@ def _owner_minute_deltas_timed(mesh, owner_rows):
     # mark owners that must take the host fold.
     flat = [ts for o in owners for ts in owner_rows[o]]
     all_m, all_c, all_n, case_ok = parse_timestamp_strings(flat, with_case=True)
-    bounds: Dict[str, slice] = {}
-    host_owners: List[str] = []
+    owner_index: Dict[str, np.ndarray] = {}
     pos = 0
     for o in owners:
         k = len(owner_rows[o])
-        bounds[o] = slice(pos, pos + k)
-        if k and not case_ok[bounds[o]].all():
-            host_owners.append(o)
+        owner_index[o] = np.arange(pos, pos + k)
         pos += k
+    return deltas_from_columns(
+        mesh, owner_index, all_m, all_c, all_n, case_ok, flat
+    )
 
+
+@with_x64
+def deltas_from_columns(
+    mesh: Mesh,
+    owner_index: Dict[str, np.ndarray],
+    all_m: np.ndarray,
+    all_c: np.ndarray,
+    all_n: np.ndarray,
+    case_ok: np.ndarray,
+    ts_strings: Sequence[str],
+) -> Tuple[Dict[str, Dict[str, int]], int]:
+    """Device Merkle pass over already-parsed columns: `owner_index`
+    maps owner → row indices to hash (callers pre-filter to the rows
+    that were actually inserted). Owners touching any non-canonical row
+    are quarantined to the shared host fold (`ts_strings` provides the
+    raw strings for it); everyone else rides one sharded dispatch."""
+    owners = list(owner_index)
     deltas: Dict[str, Dict[str, int]] = {o: {} for o in owners}
     digest = 0
+    host_owners = [
+        o for o, ix in owner_index.items() if len(ix) and not case_ok[ix].all()
+    ]
     if host_owners:
         log("kernel:merkle", "non-canonical hex case: host hashing fallback",
             owners=len(host_owners))
-        host_deltas, host_digest = _owner_minute_deltas_host(
-            {o: owner_rows[o] for o in host_owners}
-        )
-        deltas.update(host_deltas)
-        digest ^= host_digest
+        from evolu_tpu.core.merkle import minute_deltas_host
+
+        for o in host_owners:
+            deltas[o], d = minute_deltas_host(ts_strings[i] for i in owner_index[o])
+            digest ^= d
 
     quarantined = set(host_owners)
-    good = [o for o in owners if o not in quarantined]
-    if not any(len(owner_rows[o]) for o in good):
+    sizes = {o: len(owner_index[o]) for o in owners}
+    good = [o for o in owners if o not in quarantined and sizes[o]]
+    if not good:
         return deltas, digest
 
     owner_ix = {o: i for i, o in enumerate(good)}
-    shards = assign_owners_to_shards({o: len(owner_rows[o]) for o in good}, mesh.devices.size)
-    shard_len = max((sum(len(owner_rows[o]) for o in s) for s in shards), default=0)
+    shards = assign_owners_to_shards({o: sizes[o] for o in good}, mesh.devices.size)
+    shard_len = max((sum(sizes[o] for o in s) for s in shards), default=0)
     shard_size = bucket_size(max(shard_len, 1))
     total = mesh.devices.size * shard_size
 
@@ -144,16 +151,14 @@ def _owner_minute_deltas_timed(mesh, owner_rows):
     pos_by_shard = [si * shard_size for si in range(len(shards))]
     shard_of_owner = {o: si for si, shard in enumerate(shards) for o in shard}
     for o in good:
-        src = bounds[o]
-        n = src.stop - src.start
-        if not n:
-            continue
+        ix = owner_index[o]
+        n = len(ix)
         si = shard_of_owner[o]
         pos = pos_by_shard[si]
         sl = slice(pos, pos + n)
-        millis[sl] = all_m[src]
-        counter[sl] = all_c[src]
-        node[sl] = all_n[src]
+        millis[sl] = all_m[ix]
+        counter[sl] = all_c[ix]
+        node[sl] = all_n[ix]
         valid[sl] = True
         oix[sl] = owner_ix[o]
         pos_by_shard[si] = pos + n
@@ -170,12 +175,30 @@ def _owner_minute_deltas_timed(mesh, owner_rows):
     return deltas, digest ^ int(dev_digest)
 
 
-class BatchReconciler:
-    """Reconcile a batch of SyncRequests against one RelayStore."""
+class _PackedRows:
+    """Lazy timestamp-string accessor over per-shard packed 46-byte
+    buffers (used only for the rare non-canonical host fold)."""
 
-    def __init__(self, store: RelayStore, mesh: Optional[Mesh] = None):
+    def __init__(self, buffers: List[bytes], offsets: List[int]):
+        self._buffers = buffers
+        self._offsets = offsets
+
+    def __getitem__(self, i: int) -> str:
+        import bisect
+
+        j = bisect.bisect_right(self._offsets, i) - 1
+        local = i - self._offsets[j]
+        return self._buffers[j][local * 46 : (local + 1) * 46].decode("ascii")
+
+
+class BatchReconciler:
+    """Reconcile a batch of SyncRequests against one RelayStore or a
+    ShardedRelayStore (parallel per-shard ingest)."""
+
+    def __init__(self, store, mesh: Optional[Mesh] = None):
         self.store = store
         self.mesh = mesh or create_mesh()
+        self._executor = None
 
     def _new_messages(
         self, requests: Sequence[protocol.SyncRequest]
@@ -215,6 +238,181 @@ class BatchReconciler:
     ) -> List[protocol.SyncResponse]:
         """One batched pass; responses align with `requests` order.
         End state is identical to running `store.sync` per request."""
+        from evolu_tpu.server.relay import ShardedRelayStore
+
+        if isinstance(self.store, ShardedRelayStore):
+            if all(hasattr(s.db, "relay_insert_packed") for s in self.store.shards):
+                trees = self._ingest_packed(requests)
+            else:
+                # Sharded python-backend: per-request per-shard path.
+                trees = {
+                    r.user_id: self.store.add_messages(r.user_id, r.messages)
+                    for r in requests
+                }
+        elif hasattr(self.store.db, "relay_insert_packed"):
+            trees = self._ingest_packed(requests)
+        else:
+            trees = self._ingest_generic(requests)
+        return self._respond(requests, trees)
+
+    def _shards(self):
+        from evolu_tpu.server.relay import ShardedRelayStore
+
+        if isinstance(self.store, ShardedRelayStore):
+            return self.store.shards, self.store.shard_index
+        return [self.store], (lambda _u: 0)
+
+    def _pool(self, n: int):
+        """One worker per storage shard (sized to the store, not to the
+        current batch, so a small first batch can't cap later ones)."""
+        if self._executor is None and n > 1:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(max_workers=n, thread_name_prefix="evolu-ingest")
+        return self._executor
+
+    def close(self) -> None:
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
+
+    def _ingest_packed(self, requests) -> Dict[str, dict]:
+        """The packed columnar ingest. Per storage shard: pack the
+        shard's timestamps and ciphertexts into flat buffers and INSERT
+        OR IGNORE them in ONE native call (the PK dedups, including
+        in-batch duplicates, with per-row was-new flags —
+        index.ts:153-158 semantics), then parse the packed buffer
+        natively. Shards ingest in parallel threads (the C calls drop
+        the GIL). The new rows of every shard ride ONE device dispatch
+        for the per-(owner, minute) hashes, and each shard's inserts +
+        tree updates commit in one transaction, so rows can never
+        outrun their tree. A failure anywhere rolls every uncommitted
+        shard back."""
+        stores, shard_index = self._shards()
+        per_shard: List[List[protocol.SyncRequest]] = [[] for _ in stores]
+        for r in requests:
+            per_shard[shard_index(r.user_id)].append(r)
+        live = [si for si, reqs in enumerate(per_shard) if any(len(r.messages) for r in reqs)]
+        trees: Dict[str, dict] = {}
+        if not live:
+            return trees
+        n_total = sum(len(r.messages) for r in requests)
+
+        def ingest_shard(si: int):
+            db = stores[si].db
+            reqs = per_shard[si]
+            gu = [r.user_id for r in reqs]
+            gc = [len(r.messages) for r in reqs]
+            n = sum(gc)
+            # Per-string width check BEFORE packing: a total-length
+            # check alone would accept ["", "<two stamps concatenated>"]
+            # and commit rows with shifted timestamp/content pairing
+            # (same invariant as parse_timestamp_strings).
+            if any(len(m.timestamp) != 46 for r in reqs for m in r.messages):
+                raise ValueError("non-canonical timestamp width in batch")
+            ts_packed = "".join(
+                m.timestamp for r in reqs for m in r.messages
+            ).encode("ascii")
+            contents = [m.content for r in reqs for m in r.messages]
+            was_new = db.relay_insert_packed(
+                gu, gc, ts_packed, b"".join(contents),
+                np.fromiter(map(len, contents), np.int32, count=n),
+            )
+            cols = parse_packed_timestamps(ts_packed, n, with_case=True)
+            return gu, gc, ts_packed, was_new, cols
+
+        def ingest_all():
+            pool = self._pool(len(stores))
+            if pool is not None and len(live) > 1:
+                # Wait for EVERY worker before raising: a rollback while
+                # a worker is still running would let its insert land in
+                # autocommit mode — committed rows outside any tree.
+                futures = [pool.submit(ingest_shard, si) for si in live]
+                results, first_err = [], None
+                for f in futures:
+                    try:
+                        results.append(f.result())
+                    except BaseException as e:  # noqa: BLE001
+                        first_err = first_err or e
+                if first_err is not None:
+                    raise first_err
+            else:
+                results = [ingest_shard(si) for si in live]
+
+            # Merge shard results into one flat column space.
+            owner_index: Dict[str, List[np.ndarray]] = {}
+            buffers, offsets = [], []
+            col_parts = ([], [], [], [])
+            off = 0
+            for (gu, gc, ts_packed, was_new, cols) in results:
+                pos = 0
+                for u, k in zip(gu, gc):
+                    ix = np.nonzero(was_new[pos : pos + k])[0] + (pos + off)
+                    if len(ix):
+                        owner_index.setdefault(u, []).append(ix)
+                    pos += k
+                buffers.append(ts_packed)
+                offsets.append(off)
+                for part, c in zip(col_parts, cols):
+                    part.append(c)
+                off += len(was_new)
+            merged = {
+                u: (v[0] if len(v) == 1 else np.concatenate(v))
+                for u, v in owner_index.items()
+            }
+            all_m, all_c, all_n, case_ok = (
+                (p[0] if len(p) == 1 else np.concatenate(p)) for p in col_parts
+            )
+            deltas_by_owner, _digest = deltas_from_columns(
+                self.mesh, merged, all_m, all_c, all_n, case_ok,
+                _PackedRows(buffers, offsets),
+            )
+            tree_rows: List[List[Tuple[str, str]]] = [[] for _ in stores]
+            for o, deltas in deltas_by_owner.items():
+                if not deltas:
+                    continue
+                si = shard_index(o)
+                tree = apply_prefix_xors(stores[si].get_merkle_tree(o), deltas)
+                trees[o] = tree
+                tree_rows[si].append((o, merkle_tree_to_string(tree)))
+            for si in live:
+                if tree_rows[si]:
+                    stores[si].db.run_many(
+                        'INSERT OR REPLACE INTO "merkleTree" ("userId", "merkleTree") '
+                        "VALUES (?, ?)",
+                        tree_rows[si],
+                    )
+
+        with span("kernel:merkle", "reconcile_ingest",
+                  owners=len({r.user_id for r in requests}), n=n_total,
+                  shards=len(live)):
+            # One open transaction per live shard, held across the
+            # device dispatch so inserts + trees commit atomically.
+            # Short-lock begin/commit (not the lock-holding context
+            # manager) so the worker threads can execute inside them;
+            # each shard has exactly one logical writer (its worker).
+            begun: List[int] = []
+            try:
+                for si in live:
+                    stores[si].db.begin()
+                    begun.append(si)
+                ingest_all()
+            except BaseException:
+                for si in begun:
+                    stores[si].db.rollback()
+                raise
+            commit_err: Optional[Exception] = None
+            for si in begun:
+                try:
+                    stores[si].db.commit()
+                except Exception as e:  # noqa: BLE001
+                    commit_err = commit_err or e
+            if commit_err is not None:
+                raise commit_err
+        return trees
+
+    def _ingest_generic(self, requests) -> Dict[str, dict]:
+        """Python-backend fallback: temp-table set-diff + bulk SQL."""
         new_by_owner = self._new_messages(requests)
 
         # Device: per-(owner, minute) XOR deltas for all new timestamps.
@@ -226,6 +424,7 @@ class BatchReconciler:
 
         # Host: bulk insert + tree updates in one transaction.
         db = self.store.db
+        trees: Dict[str, dict] = {}
         with db.transaction():
             rows = [
                 (m.timestamp, o, m.content)
@@ -238,7 +437,6 @@ class BatchReconciler:
                     "VALUES (?, ?, ?)",
                     rows,
                 )
-            trees: Dict[str, dict] = {}
             for o, deltas in deltas_by_owner.items():
                 tree = apply_prefix_xors(self.store.get_merkle_tree(o), deltas)
                 trees[o] = tree
@@ -246,11 +444,14 @@ class BatchReconciler:
                     'INSERT OR REPLACE INTO "merkleTree" ("userId", "merkleTree") VALUES (?, ?)',
                     (o, merkle_tree_to_string(tree)),
                 )
+        return trees
 
-        # Responses: standard diff per request against the updated trees.
+    def _respond(self, requests, trees: Dict[str, dict]) -> List[protocol.SyncResponse]:
+        """Standard diff per request against the updated trees."""
         from evolu_tpu.core.merkle import merkle_tree_from_string
 
         responses = []
+        tree_strings: Dict[str, str] = {}
         for r in requests:
             tree = trees.get(r.user_id)
             if tree is None:
@@ -258,5 +459,8 @@ class BatchReconciler:
                 trees[r.user_id] = tree
             client_tree = merkle_tree_from_string(r.merkle_tree)
             messages = self.store.get_messages(r.user_id, r.node_id, tree, client_tree)
-            responses.append(protocol.SyncResponse(messages, merkle_tree_to_string(tree)))
+            ts = tree_strings.get(r.user_id)
+            if ts is None:
+                ts = tree_strings[r.user_id] = merkle_tree_to_string(tree)
+            responses.append(protocol.SyncResponse(messages, ts))
         return responses

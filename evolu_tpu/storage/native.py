@@ -82,6 +82,8 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.eh_apply_sequential.argtypes = [p, i64, sp, sp, sp, sp, i32p, i64p, dp, sp, i32p, u8p]
     lib.eh_apply_planned.argtypes = [p, i64, sp, sp, sp, sp, i32p, i64p, dp, sp, i32p, u8p]
     lib.eh_relay_insert.argtypes = [p, i64, sp, sp, sp, i32p, u8p]
+    lib.eh_relay_insert_packed.argtypes = [p, i64, sp, i64p, s, s, i32p, u8p]
+    lib.eh_parse_timestamps.argtypes = [s, i64, i64p, i32p, c.POINTER(c.c_uint64), u8p]
     lib.eh_run_many_tb.argtypes = [p, s, i64, c.c_int32, sp, i32p, i32p]
     lib.eh_get_messages.argtypes = [
         p, s, s, s,
@@ -339,6 +341,39 @@ class CppSqliteDatabase:
             self._check_open()
             return self._lib.eh_total_changes(self._db)
 
+    # Explicit transaction control for the shard-parallel relay ingest:
+    # unlike the `transaction()` context manager (which holds this
+    # db's lock across its body — correct for the single-writer
+    # runtime), these toggle the transaction in one short locked call
+    # each, so OTHER threads can run statements inside the open
+    # transaction. The caller owns exclusivity: exactly one logical
+    # writer per database (the engine assigns one worker per shard).
+
+    def begin(self) -> None:
+        with self._lock:
+            self._check_open()
+            if self._in_txn:
+                raise UnknownError("begin inside an open transaction")
+            if self._lib.eh_exec(self._db, b"BEGIN") != 0:
+                raise self._err()
+            self._in_txn = True
+
+    def commit(self) -> None:
+        with self._lock:
+            self._check_open()
+            if not self._in_txn:
+                raise UnknownError("commit without an open transaction")
+            self._in_txn = False
+            if self._lib.eh_exec(self._db, b"COMMIT") != 0:
+                raise self._err()
+
+    def rollback(self) -> None:
+        with self._lock:
+            if not self._db or not self._in_txn:
+                return
+            self._in_txn = False
+            self._lib.eh_exec(self._db, b"ROLLBACK")
+
     @contextmanager
     def transaction(self):
         with self._lock:
@@ -479,6 +514,45 @@ class CppSqliteDatabase:
             out.append((ts, content_raw[off : off + ln]))
             off += ln
         return out
+
+    def relay_insert_packed(
+        self,
+        group_users: Sequence[str],
+        group_counts: Sequence[int],
+        ts_packed: bytes,
+        content_packed: bytes,
+        content_lens,
+    ):
+        """Grouped one-call ingest for the batch reconciler: timestamps
+        as ONE fixed-width 46-byte buffer, ciphertexts as ONE packed
+        blob buffer. Returns the per-row was-new flags as a numpy bool
+        array (in-batch duplicates dedup through the PK, exactly like
+        sequential INSERT OR IGNORE)."""
+        import numpy as np
+
+        n = len(content_lens)
+        if n * 46 != len(ts_packed):
+            raise UnknownError("relay_insert_packed: timestamp buffer size mismatch")
+        if n == 0:
+            return np.zeros(0, bool)
+        lens = np.ascontiguousarray(content_lens, dtype=np.int32)
+        if int(lens.sum()) != len(content_packed):
+            raise UnknownError("relay_insert_packed: content buffer size mismatch")
+        counts = np.ascontiguousarray(group_counts, dtype=np.int64)
+        out = (ctypes.c_uint8 * n)()
+        with self._lock:
+            self._check_open()
+            rc = self._lib.eh_relay_insert_packed(
+                self._db, len(group_users),
+                _str_array(group_users),
+                counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ts_packed, content_packed,
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                out,
+            )
+        if rc != 0:
+            raise self._err()
+        return np.frombuffer(out, np.uint8).astype(bool)
 
     def relay_insert(self, rows: Sequence[Tuple[str, str, bytes]]) -> List[bool]:
         """Bulk INSERT OR IGNORE into the relay's message table; returns

@@ -370,6 +370,133 @@ int eh_apply_planned(sqlite3 *db, int64_t n, const char *const *timestamps,
 // --- relay hot path: bulk (timestamp, userId, content) insert with
 // per-row "was new" flags (INSERT OR IGNORE changes()==1 semantics,
 // apps/server/src/index.ts:148-159). content is a blob. ---
+// --- packed fixed-width timestamp parse ---
+//
+// The host-side batch columnarization (ops/host_parse.py) is the same
+// loop in numpy; this is its native twin for the hot server/client
+// paths (one pass over the packed 46-byte records instead of ~40
+// vectorized passes). Validation is identical: exact separators,
+// digit ranges with real calendar rules, hex fields accepting both
+// cases. out_case_ok[i] = 1 iff the row uses the canonical encoder's
+// case (UPPERCASE counter / lowercase node). Returns 0, or 1 on any
+// malformed row (callers abort the batch, like the numpy path).
+
+static inline int64_t days_from_civil(int64_t y, int m, int d) {
+  y -= m <= 2;
+  int64_t era = (y >= 0 ? y : y - 399) / 400;
+  int yoe = (int)(y - era * 400);
+  int doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  int doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return era * 146097 + doe - 719468;
+}
+
+static inline bool is_leap(int64_t y) {
+  return (y % 4 == 0 && y % 100 != 0) || y % 400 == 0;
+}
+
+int eh_parse_timestamps(const char *ts_packed, int64_t n, int64_t *out_millis,
+                        int32_t *out_counter, uint64_t *out_node,
+                        uint8_t *out_case_ok) {
+  static const int month_days[13] = {0, 31, 28, 31, 30, 31, 30,
+                                     31, 31, 30, 31, 30, 31};
+  for (int64_t i = 0; i < n; ++i) {
+    const unsigned char *t =
+        reinterpret_cast<const unsigned char *>(ts_packed) + i * 46;
+    if (t[4] != '-' || t[7] != '-' || t[10] != 'T' || t[13] != ':' ||
+        t[16] != ':' || t[19] != '.' || t[23] != 'Z' || t[24] != '-' ||
+        t[29] != '-')
+      return 1;
+    int64_t nums[7];  // y, mo, d, hh, mi, ss, ms
+    static const int spans[7][2] = {{0, 4},   {5, 7},   {8, 10},  {11, 13},
+                                    {14, 16}, {17, 19}, {20, 23}};
+    for (int f = 0; f < 7; ++f) {
+      int64_t v = 0;
+      for (int j = spans[f][0]; j < spans[f][1]; ++j) {
+        if (t[j] < '0' || t[j] > '9') return 1;
+        v = v * 10 + (t[j] - '0');
+      }
+      nums[f] = v;
+    }
+    int64_t y = nums[0];
+    int mo = (int)nums[1], d = (int)nums[2];
+    if (y < 1 || mo < 1 || mo > 12 || d < 1) return 1;
+    int dim = month_days[mo] + ((mo == 2 && is_leap(y)) ? 1 : 0);
+    if (d > dim || nums[3] > 23 || nums[4] > 59 || nums[5] > 59) return 1;
+    out_millis[i] =
+        ((days_from_civil(y, mo, d) * 86400 + nums[3] * 3600 + nums[4] * 60 +
+          nums[5]) *
+         1000) +
+        nums[6];
+    bool canonical = true;
+    uint32_t counter = 0;
+    for (int j = 25; j < 29; ++j) {
+      unsigned char c = t[j];
+      uint32_t nib;
+      if (c >= '0' && c <= '9') nib = c - '0';
+      else if (c >= 'A' && c <= 'F') nib = c - 'A' + 10;
+      else if (c >= 'a' && c <= 'f') { nib = c - 'a' + 10; canonical = false; }
+      else return 1;
+      counter = (counter << 4) | nib;
+    }
+    out_counter[i] = (int32_t)counter;
+    uint64_t node = 0;
+    for (int j = 30; j < 46; ++j) {
+      unsigned char c = t[j];
+      uint64_t nib;
+      if (c >= '0' && c <= '9') nib = c - '0';
+      else if (c >= 'a' && c <= 'f') nib = c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') { nib = c - 'A' + 10; canonical = false; }
+      else return 1;
+      node = (node << 4) | nib;
+    }
+    out_node[i] = node;
+    out_case_ok[i] = canonical ? 1 : 0;
+  }
+  return 0;
+}
+
+// Packed, grouped variant of eh_relay_insert: the batch reconciler's
+// one-call ingest. Timestamps arrive as ONE fixed-width 46-byte
+// buffer and contents as ONE packed blob buffer with per-row lengths;
+// rows are grouped per requesting user (group_users/group_counts), so
+// the host passes n_groups pointers instead of n. In-batch duplicates
+// dedup through the PK exactly like sequential INSERT OR IGNORE: the
+// first occurrence reports was-new, later ones don't (index.ts:148-159
+// changes()==1 semantics).
+int eh_relay_insert_packed(sqlite3 *db, int64_t n_groups,
+                           const char *const *group_users,
+                           const int64_t *group_counts,
+                           const char *ts_packed,
+                           const unsigned char *content_packed,
+                           const int32_t *content_lens, uint8_t *out_new) {
+  sqlite3_stmt *st = nullptr;
+  const char *sql =
+      "INSERT OR IGNORE INTO \"message\" (\"timestamp\", \"userId\", \"content\") "
+      "VALUES (?, ?, ?)";
+  if (sqlite3_prepare_v2(db, sql, -1, &st, nullptr) != SQLITE_OK) return 1;
+  int64_t i = 0;
+  int64_t content_off = 0;
+  for (int64_t g = 0; g < n_groups; ++g) {
+    const char *user = group_users[g];
+    for (int64_t k = 0; k < group_counts[g]; ++k, ++i) {
+      sqlite3_bind_text(st, 1, ts_packed + i * 46, 46, SQLITE_STATIC);
+      sqlite3_bind_text(st, 2, user, -1, SQLITE_STATIC);
+      sqlite3_bind_blob(st, 3, content_packed + content_off, content_lens[i],
+                        SQLITE_STATIC);
+      content_off += content_lens[i];
+      int rc = sqlite3_step(st);
+      sqlite3_reset(st);
+      if (rc != SQLITE_DONE) {
+        sqlite3_finalize(st);
+        return 1;
+      }
+      out_new[i] = sqlite3_changes(db) == 1 ? 1 : 0;
+    }
+  }
+  sqlite3_finalize(st);
+  return 0;
+}
+
 int eh_relay_insert(sqlite3 *db, int64_t n, const char *const *timestamps,
                     const char *const *user_ids, const char *const *contents,
                     const int32_t *content_lens, uint8_t *out_new) {
