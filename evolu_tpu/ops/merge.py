@@ -317,18 +317,22 @@ def _plan_full_kernel(cell_id, k1, k2, ex_k1, ex_k2):
 def plan_batch_device_full(
     messages: Sequence[CrdtMessage],
     existing_winners: Dict[Tuple[str, str, str], str],
+    cols=None,
 ):
     """Like `plan_batch_device` but ALSO returns the per-minute Merkle
     XOR deltas computed on device — `(xor_mask, upserts, deltas)` — so
     the apply path never hashes timestamps in Python (the reference's
-    hot loop #4 eliminated host-side)."""
+    hot loop #4 eliminated host-side). `cols` optionally reuses a
+    caller's `messages_to_columns` result."""
     from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas
 
     n = len(messages)
     if n == 0:
         return [], [], {}
     with span("kernel:merge", "plan_batch_device_full", n=n):
-        cell_ids, k1, k2, ex_k1, ex_k2, *rest = messages_to_columns(messages, existing_winners)
+        cell_ids, k1, k2, ex_k1, ex_k2, *rest = (
+            cols if cols is not None else messages_to_columns(messages, existing_winners)
+        )
         if not rest[-1]:  # canonical flag
             return _host_fallback(messages, existing_winners, n, with_deltas=True)
         (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns(

@@ -49,23 +49,60 @@ def _now_millis() -> int:
 
 def select_planner(config: Config) -> Callable:
     """Pick the merge planner per config.backend: the host oracle below
-    `min_device_batch`, the device kernel at/above it ("auto"/"tpu")."""
+    `min_device_batch`, the device kernel at/above it ("auto"/"tpu"),
+    and the cell-range-sharded hot-owner kernel for huge single-owner
+    batches on multi-device hosts."""
     if config.backend == "cpu":
         return plan_batch
 
     from evolu_tpu.ops.merge import plan_batch_device_full
 
     threshold = 0 if config.backend == "tpu" else config.min_device_batch
+    hot_min = config.hot_owner_min_batch
 
     def planner(batch, existing):
+        cols = None
+        if hot_min is not None and len(batch) >= hot_min:
+            plan, cols = _plan_hot_owner(batch, existing)
+            if plan is not None:
+                return plan
         if len(batch) >= threshold:
             # Always (xor_mask, upserts, deltas): minute deltas come
             # from the device kernel, or from the host fold when the
-            # batch carries non-canonical hex case.
-            return plan_batch_device_full(batch, existing)
+            # batch carries non-canonical hex case. `cols` reuses the
+            # hot path's columnarization when it declined the batch.
+            return plan_batch_device_full(batch, existing, cols=cols)
         return plan_batch(batch, existing)
 
     return planner
+
+
+def _plan_hot_owner(batch, existing):
+    """One client is one owner; a batch above hot_owner_min_batch
+    shards by cell-id ranges over every local device (per-cell LWW
+    merges are independent — SURVEY.md §5 "within one hot owner, by
+    cell-id ranges"). Returns (plan, cols): the standard 3-tuple plan,
+    or plan=None when the host should route normally (single device, or
+    non-canonical hex case — the device order/hash contract doesn't
+    hold there and plan_batch_device_full's own fallback takes over);
+    `cols` carries the columnarization for reuse either way."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        return None, None
+    from evolu_tpu.ops.merge import messages_to_columns
+    from evolu_tpu.parallel.hot_owner import reconcile_hot_owner
+    from evolu_tpu.parallel.mesh import create_mesh
+
+    cols = messages_to_columns(batch, existing)
+    cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node, canonical = cols
+    if not canonical:
+        return None, cols
+    xor_mask, upsert_mask, deltas, _digest = reconcile_hot_owner(
+        create_mesh(), cell_id, k1, k2, ex_k1, ex_k2, millis, counter, node
+    )
+    upserts = [m for i, m in enumerate(batch) if upsert_mask[i]]
+    return (list(map(bool, xor_mask)), upserts, deltas), cols
 
 
 class DbWorker:

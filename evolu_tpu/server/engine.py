@@ -9,7 +9,9 @@ across 1k owners), and:
    INSERT OR IGNORE dedup, batched via a temp-table join);
 2. hashes every new timestamp and reduces per-(owner, minute) XOR
    deltas on device (`owner_minute_segments` over int32 owner/minute
-   key pairs, sharded over the mesh — owners never split);
+   key pairs, sharded over the mesh; an owner bigger than an even
+   shard's worth of rows row-splits across shards — safe because the
+   decoder XOR-merges repeated (owner, minute) partials exactly);
 3. applies the deltas to each owner's sparse tree, persists, and
    answers each request with the standard diff response.
 
@@ -138,8 +140,23 @@ def deltas_from_columns(
         return deltas, digest
 
     owner_ix = {o: i for i, o in enumerate(good)}
-    shards = assign_owners_to_shards({o: sizes[o] for o in good}, mesh.devices.size)
-    shard_len = max((sum(sizes[o] for o in s) for s in shards), default=0)
+    # Hot-owner split: hashing needs no cell locality, and the decoder
+    # XOR-merges repeated (owner, minute) keys exactly, so an owner
+    # bigger than an even shard's worth of rows splits row-wise across
+    # shards instead of capping one shard's load (SURVEY.md §5).
+    n_good_rows = sum(sizes[o] for o in good)
+    target = max(1, -(-n_good_rows // mesh.devices.size))  # ceil
+    units: Dict[Tuple[str, int], np.ndarray] = {}
+    for o in good:
+        ix = owner_index[o]
+        if len(ix) <= target:
+            units[(o, 0)] = ix
+        else:
+            for j, start in enumerate(range(0, len(ix), target)):
+                units[(o, j)] = ix[start : start + target]
+    shards = assign_owners_to_shards({u: len(ix) for u, ix in units.items()},
+                                     mesh.devices.size)
+    shard_len = max((sum(len(units[u]) for u in s) for s in shards), default=0)
     shard_size = bucket_size(max(shard_len, 1))
     total = mesh.devices.size * shard_size
 
@@ -149,18 +166,17 @@ def deltas_from_columns(
     valid = np.zeros(total, bool)
     oix = np.zeros(total, np.int64)
     pos_by_shard = [si * shard_size for si in range(len(shards))]
-    shard_of_owner = {o: si for si, shard in enumerate(shards) for o in shard}
-    for o in good:
-        ix = owner_index[o]
+    shard_of_unit = {u: si for si, shard in enumerate(shards) for u in shard}
+    for u, ix in units.items():
         n = len(ix)
-        si = shard_of_owner[o]
+        si = shard_of_unit[u]
         pos = pos_by_shard[si]
         sl = slice(pos, pos + n)
         millis[sl] = all_m[ix]
         counter[sl] = all_c[ix]
         node[sl] = all_n[ix]
         valid[sl] = True
-        oix[sl] = owner_ix[o]
+        oix[sl] = owner_ix[u[0]]
         pos_by_shard[si] = pos + n
 
     shd = sharding(mesh)

@@ -29,6 +29,11 @@ class Config:
     # None = whole-batch transactions always (reference semantics).
     receive_chunk_size: "int | None" = 1 << 20
     min_device_batch: int = 1024  # below this, the CPU oracle path is faster than dispatch
+    # A single-owner batch at/above this size shards by CELL RANGES over
+    # every local device (parallel/hot_owner.py) instead of planning on
+    # one device — the "hot owner" path (SURVEY.md §5). Only engages
+    # when >1 device is visible. None disables.
+    hot_owner_min_batch: "int | None" = 1 << 18
 
 
 default_config = Config()
