@@ -9,7 +9,7 @@ routes the XOR-combine collectives over ICI.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, Hashable, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -37,12 +37,17 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def assign_owners_to_shards(
-    owner_sizes: Dict[str, int], n_shards: int
-) -> List[List[str]]:
-    """Greedy LPT balance: owners (with their message counts) onto
-    shards, heaviest first — owners never split across shards, so all
-    merge/Merkle work stays device-local."""
-    shards: List[List[str]] = [[] for _ in range(n_shards)]
+    owner_sizes: Dict[Hashable, int], n_shards: int
+) -> List[List[Hashable]]:
+    """Greedy LPT balance: work units (with their message counts) onto
+    shards, heaviest first. A unit is never split across shards. Units
+    are usually whole owners (keyed by owner id), keeping merge/Merkle
+    work device-local — but callers may pre-split a hot owner into
+    finer units, e.g. `engine.deltas_from_columns` passes
+    (owner, chunk-index) tuples whose partial digests are XOR-merged
+    after the pass; this function only balances whatever units it is
+    given."""
+    shards: List[List[Hashable]] = [[] for _ in range(n_shards)]
     loads = [0] * n_shards
     for owner in sorted(owner_sizes, key=owner_sizes.get, reverse=True):
         i = loads.index(min(loads))

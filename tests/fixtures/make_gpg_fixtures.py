@@ -1,0 +1,70 @@
+"""Regenerate the GnuPG-produced golden interop fixtures.
+
+The reference encrypts sync payloads with OpenPGP.js v5 symmetric
+encryption (packages/evolu/src/sync.worker.ts:59-91, AES-256 SKESK +
+SEIPD/MDC, iterated+salted SHA-256 S2K with s2kIterationCountByte: 0 =
+1024 octets). OpenPGP.js itself cannot run in this environment (no
+Node runtime), so the fixtures are produced by GnuPG — an independent,
+interoperable RFC 4880 implementation — with the exact same packet
+parameters. A ciphertext gpg produces and OpenPGP.js produces for
+these parameters differ only in random salt/prefix; the packet grammar
+our decoder must consume is identical.
+
+Run: python tests/fixtures/make_gpg_fixtures.py
+Requires: gpg >= 2.1 on PATH. Output is committed; tests read the
+frozen bytes and do NOT regenerate.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+import tempfile
+
+HERE = pathlib.Path(__file__).resolve().parent
+sys.path.insert(0, str(HERE.parent.parent))
+
+from evolu_tpu.sync.protocol import encode_content  # noqa: E402
+
+# Matches the shape a reference client encrypts: one CrdtMessageContent.
+PASSWORD = "legal winner thank year wave sausage worth useful legal winner thank yellow"
+PLAINTEXT = encode_content(
+    "todo", "B4UsGiFxpnc7SQaBSNy1u", "title", "Buy milk ✓ café"
+)
+
+VARIANTS = {
+    # The reference's exact parameters: AES-256, S2K iterated+salted
+    # SHA-256 count 1024 (count byte 0), no compression.
+    "gpg_aes256_s2k1024_none.pgp": ["--compress-algo", "none"],
+    # OpenPGP.js may emit compressed payloads; gpg's zip/zlib exercise
+    # the same Compressed Data packet paths (tags 8/1 and 8/2).
+    "gpg_aes256_s2k1024_zip.pgp": ["--compress-algo", "zip"],
+    "gpg_aes256_s2k1024_zlib.pgp": ["--compress-algo", "zlib"],
+}
+
+
+def main() -> None:
+    (HERE / "gpg_plaintext.bin").write_bytes(PLAINTEXT)
+    (HERE / "gpg_password.txt").write_text(PASSWORD + "\n")
+    with tempfile.TemporaryDirectory() as home:
+        for name, extra in VARIANTS.items():
+            out = HERE / name
+            out.unlink(missing_ok=True)
+            subprocess.run(
+                [
+                    "gpg", "--homedir", home, "--batch", "--yes",
+                    "--pinentry-mode", "loopback", "--passphrase", PASSWORD,
+                    "--symmetric", "--cipher-algo", "AES256",
+                    "--s2k-mode", "3", "--s2k-digest-algo", "SHA256",
+                    "--s2k-count", "1024", *extra,
+                    "--output", str(out), str(HERE / "gpg_plaintext.bin"),
+                ],
+                check=True,
+                capture_output=True,
+            )
+            print(f"wrote {out.name} ({out.stat().st_size} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Regenerate the protoc-runtime-produced SyncRequest golden fixture.
+
+The reference wire format is produced by protobuf-ts
+(packages/evolu/protos/protobuf.proto, generated protobuf.ts). That
+codegen cannot run here (no Node runtime), so the fixture bytes come
+from the google.protobuf runtime parsing the same schema — both are
+conformant proto3 encoders that serialize scalar fields in
+field-number order, so for these messages (no maps, no packed arrays)
+the bytes are the canonical encoding a protobuf-ts client emits.
+
+Run: python tests/fixtures/make_protobuf_fixtures.py
+Output is committed; tests read the frozen bytes.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+HERE = pathlib.Path(__file__).resolve().parent
+sys.path.insert(0, str(HERE.parent.parent))
+
+
+def build_classes():
+    from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+    pool = descriptor_pool.DescriptorPool()
+    f = descriptor_pb2.FileDescriptorProto()
+    f.name = "evolu.proto"
+    f.syntax = "proto3"
+
+    content = f.message_type.add()
+    content.name = "CrdtMessageContent"
+    for i, (name, type_) in enumerate(
+        [("table", 9), ("row", 9), ("column", 9), ("stringValue", 9), ("numberValue", 5)],
+        start=1,
+    ):
+        fld = content.field.add()
+        fld.name, fld.number, fld.type, fld.label = name, i, type_, 1
+
+    enc = f.message_type.add()
+    enc.name = "EncryptedCrdtMessage"
+    t = enc.field.add()
+    t.name, t.number, t.type, t.label = "timestamp", 1, 9, 1
+    c = enc.field.add()
+    c.name, c.number, c.type, c.label = "content", 2, 12, 1
+
+    req = f.message_type.add()
+    req.name = "SyncRequest"
+    msgs = req.field.add()
+    msgs.name, msgs.number, msgs.type, msgs.label = "messages", 1, 11, 3
+    msgs.type_name = ".EncryptedCrdtMessage"
+    for i, name in enumerate(["userId", "nodeId", "merkleTree"], start=2):
+        fld = req.field.add()
+        fld.name, fld.number, fld.type, fld.label = name, i, 9, 1
+
+    pool.Add(f)
+    mk = lambda n: message_factory.GetMessageClass(pool.FindMessageTypeByName(n))
+    return mk("CrdtMessageContent"), mk("EncryptedCrdtMessage"), mk("SyncRequest")
+
+
+def main() -> None:
+    Content, Encrypted, Request = build_classes()
+    content = Content(
+        table="todo", row="B4UsGiFxpnc7SQaBSNy1u", column="title", stringValue="hello"
+    ).SerializeToString()
+    req = Request(
+        messages=[
+            Encrypted(
+                timestamp="2024-01-31T10:20:30.444Z-0000-a1b2c3d4e5f60718",
+                content=content,
+            ),
+            Encrypted(
+                timestamp="2024-01-31T10:20:30.444Z-0001-a1b2c3d4e5f60718",
+                content=b"\x01\x02\x03",
+            ),
+        ],
+        userId="9f3c2b1a0d4e5f60718293a",
+        nodeId="a1b2c3d4e5f60718",
+        merkleTree='{"hash":12345,"2":{"hash":12345}}',
+    )
+    out = HERE / "protoc_sync_request.bin"
+    out.write_bytes(req.SerializeToString())
+    print(f"wrote {out.name} ({out.stat().st_size} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -159,6 +159,11 @@ def test_server_hot_owner_rows_split_across_shards():
     hot = [m.timestamp for m in _mk_messages("a" * 16, 5000)]
     small = [m.timestamp for m in _mk_messages("b" * 16, 40)]
     rows = {"hot": hot, "small": small}
+    # Pin that the row-split path actually engages: the hot owner must
+    # exceed an even shard's worth (engine splits when len > ceil(n/D)),
+    # otherwise this test silently degrades to the unsplit path.
+    even_share = -(-(len(hot) + len(small)) // mesh.devices.size)
+    assert mesh.devices.size > 1 and len(hot) > even_share
     deltas, digest = owner_minute_deltas(mesh, rows)
     expect_digest = 0
     for o, ts_list in rows.items():
