@@ -30,7 +30,7 @@ OWNERS = 1_000
 TARGET_PER_CHIP = 12_500_000.0
 
 
-def build_columns(n=N, owners=OWNERS, seed=7):
+def build_columns(n=N, owners=OWNERS, seed=7, stored_winners=False):
     rng = np.random.default_rng(seed)
     base = 1_700_000_000_000
     # ~4 messages/cell contention, clustered minutes (realistic sync bursts).
@@ -42,12 +42,26 @@ def build_columns(n=N, owners=OWNERS, seed=7):
     counter = rng.integers(0, 256, n).astype(np.int32)
     node = rng.integers(1, 2**63, n).astype(np.uint64)
     k1 = (millis.astype(np.uint64) << np.uint64(16)) | counter.astype(np.uint64)
+    ex_k1 = np.zeros(n, np.uint64)
+    ex_k2 = np.zeros(n, np.uint64)
+    if stored_winners:
+        # ~60% of cells carry a winner persisted by prior batches, drawn
+        # from the same time window — so roughly half the incoming
+        # messages LOSE to the stored winner, exercising both arms of
+        # the _lex_max(p, e) seed and the `beats` compare (merge.py),
+        # which the all-zero sentinel never touches.
+        has = rng.random(cells) < 0.6
+        w_millis = (base + rng.integers(0, 86_400_000, cells)).astype(np.uint64)
+        w_k1 = ((w_millis << np.uint64(16)) | rng.integers(0, 256, cells).astype(np.uint64))
+        w_k2 = rng.integers(1, 2**63, cells).astype(np.uint64)
+        ex_k1 = np.where(has, w_k1, 0)[cell_id].astype(np.uint64)
+        ex_k2 = np.where(has, w_k2, 0)[cell_id].astype(np.uint64)
     return {
         "cell_id": cell_id,
         "k1": k1,
         "k2": node,
-        "ex_k1": np.zeros(n, np.uint64),
-        "ex_k2": np.zeros(n, np.uint64),
+        "ex_k1": ex_k1,
+        "ex_k2": ex_k2,
         "millis": millis,
         "counter": counter,
         "node": node,
@@ -97,11 +111,8 @@ def main():
 
     mesh = create_mesh()  # all local devices (1 chip under axon)
     n_dev = mesh.devices.size
-    cols, total = shard_layout(build_columns(), n_dev)
-
     shd = sharding(mesh)
     names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
-    args = [jax.device_put(cols[k], shd) for k in names]
 
     # Sustained throughput: run INNER_ITERS back-to-back pipeline
     # iterations inside ONE dispatch (a fori_loop chaining on a checksum,
@@ -110,13 +121,21 @@ def main():
     # streaming reconcile service sees it, not the per-dispatch host
     # round-trip (which under the axon tunnel is ~80ms of pure RTT).
     spec = P("owners")
+    pad_cell = jnp.int32(0x7FFFFFFF)
 
     def shard_loop(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
         def body(i, acc):
-            # Perturb the HLC tie-break key per iteration so XLA cannot
-            # CSE iterations; cell structure and padding stay intact.
+            # Perturb per iteration so XLA cannot CSE iterations: the
+            # HLC tie-break key flips low node bits, and the cell ids
+            # are bijectively relabeled (cells < 2^18, so XOR-ing bits
+            # 18+ keeps groups intact but reshuffles the sort order —
+            # each iteration does real, different data movement).
+            # Padding rows keep the planner's sentinel cell.
+            cid = jnp.where(
+                cell_id == pad_cell, cell_id, cell_id ^ (i << 18).astype(jnp.int32)
+            )
             outs = _shard_kernel(
-                cell_id, k1, k2 ^ i.astype(jnp.uint64), ex_k1, ex_k2, owner_ix,
+                cid, k1, k2 ^ i.astype(jnp.uint64), ex_k1, ex_k2, owner_ix,
             )
             # Fold outputs into the carry so every iteration's pipeline
             # is live; psum makes the carry replicated across shards.
@@ -125,6 +144,7 @@ def main():
 
         return jax.lax.fori_loop(0, INNER_ITERS, body, jnp.int64(0))
 
+    results = {}
     with jax.enable_x64(True):
         looped = jax.jit(
             shard_map(
@@ -135,28 +155,44 @@ def main():
                 check_vma=False,
             )
         )
-        np.asarray(looped(*args))  # compile + warm
-        times = []
-        for _ in range(8):
-            t0 = time.perf_counter()
-            np.asarray(looped(*args))
-            times.append(time.perf_counter() - t0)
-    p50 = statistics.median(times)
-    per_chip = INNER_ITERS * N / p50 / n_dev
+        for label, stored in (("empty_store", False), ("stored_winners", True)):
+            cols, _ = shard_layout(build_columns(stored_winners=stored), n_dev)
+            args = [jax.device_put(cols[k], shd) for k in names]
+            np.asarray(looped(*args))  # compile + warm
+            times = []
+            for _ in range(8):
+                t0 = time.perf_counter()
+                np.asarray(looped(*args))
+                times.append(time.perf_counter() - t0)
+            p50 = statistics.median(times)
+            results[label] = {
+                "per_chip": INNER_ITERS * N / p50 / n_dev,
+                "p50_ms": round(p50 * 1e3, 3),
+                "per_iter_ms": round(p50 * 1e3 / INNER_ITERS, 3),
+            }
+
+    # Headline = the stored-winners config: every kernel branch live
+    # (winner-compare against a populated store, cells relabeled per
+    # iteration). The empty-store config is reported alongside.
+    head = results["stored_winners"]["per_chip"]
     print(
         json.dumps(
             {
                 "metric": "crdt_messages_merged_per_sec_per_chip",
-                "value": round(per_chip),
+                "value": round(head),
                 "unit": "msgs/sec/chip",
-                "vs_baseline": round(per_chip / TARGET_PER_CHIP, 4),
+                "vs_baseline": round(head / TARGET_PER_CHIP, 4),
                 "detail": {
                     "batch": N,
                     "owners": OWNERS,
                     "devices": n_dev,
                     "inner_iters": INNER_ITERS,
-                    "p50_ms": round(p50 * 1e3, 3),
-                    "per_iter_ms": round(p50 * 1e3 / INNER_ITERS, 3),
+                    "stored_winners": True,
+                    "rotating_cells": True,
+                    "configs": {
+                        k: {**v, "per_chip": round(v["per_chip"])}
+                        for k, v in results.items()
+                    },
                     "platform": jax.devices()[0].platform,
                 },
             }

@@ -129,9 +129,10 @@ def test_wrong_password_fails():
 # iterated+salted SHA-256 S2K, count 1024) must decrypt, and gpg must
 # decrypt our encryptor's output live.
 
-GPG_PASSWORD = (
-    "legal winner thank year wave sausage worth useful legal winner thank yellow"
-)
+# Read from the committed fixture so the test stays in lockstep with
+# regeneration (make_gpg_fixtures.py writes password + plaintext +
+# ciphertexts together).
+GPG_PASSWORD = (FIXTURES / "gpg_password.txt").read_text().strip()
 
 
 @pytest.mark.parametrize(
