@@ -63,6 +63,7 @@ def __getattr__(name):
         "QueryView": ("evolu_tpu.api.hooks", "QueryView"),
         "QueryBuilder": ("evolu_tpu.api.query", "QueryBuilder"),
         "table": ("evolu_tpu.api.query", "table"),
+        "fn": ("evolu_tpu.api.query", "fn"),
         "model": ("evolu_tpu.api", "model"),
         "connect": ("evolu_tpu.sync.client", "connect"),
         "RelayServer": ("evolu_tpu.server.relay", "RelayServer"),
@@ -113,6 +114,7 @@ __all__ = [
     "QueryView",
     "QueryBuilder",
     "table",
+    "fn",
     "model",
     "connect",
     "RelayServer",

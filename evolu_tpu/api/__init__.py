@@ -9,9 +9,9 @@ package's query builder and model validators.
 """
 
 from evolu_tpu.api import model
-from evolu_tpu.api.query import QueryBuilder, table
+from evolu_tpu.api.query import Fn, QueryBuilder, fn, table
 
-__all__ = ["model", "QueryBuilder", "table", "Hooks", "QueryView", "create_hooks"]
+__all__ = ["model", "QueryBuilder", "table", "fn", "Fn", "Hooks", "QueryView", "create_hooks"]
 
 
 def __getattr__(name):

@@ -5,7 +5,11 @@ DummyDriver: queries are *compiled* to `{sql, parameters}` but never
 executed by the builder; execution belongs to the DbWorker
 (createHooks.ts:28-37). This module is the same idea natively: a small
 immutable fluent builder whose `.serialize()` yields the
-`SqlQueryString` the runtime subscribes with.
+`SqlQueryString` the runtime subscribes with. The surface mirrors what
+the reference's Kysely instance exposes to apps: selects with aliases,
+inner/left joins (`innerJoin("todoCategory", "todoCategory.id",
+"todo.categoryId")`), aggregate functions (`fn.count`), group by,
+having, order/limit/offset.
 
 Identifiers are always double-quoted; values always travel as bound
 parameters — the builder never interpolates values into SQL.
@@ -19,6 +23,7 @@ from typing import List, Optional, Sequence, Tuple, Union
 from evolu_tpu.runtime.messages import serialize_query
 
 _OPS = ("=", "!=", "<>", "<", "<=", ">", ">=", "like", "not like", "is", "is not", "in")
+_FNS = ("count", "sum", "avg", "min", "max", "total", "group_concat")
 
 
 def _quote(identifier: str) -> str:
@@ -27,22 +32,120 @@ def _quote(identifier: str) -> str:
     return '"' + identifier.replace('"', '""') + '"'
 
 
+def _quote_ref(ref: str) -> str:
+    """Quote a possibly table-qualified reference: `todo.title` →
+    `"todo"."title"`, `title` → `"title"`."""
+    return ".".join(_quote(part) for part in ref.split("."))
+
+
+@dataclass(frozen=True)
+class Fn:
+    """An aggregate select expression, e.g. `fn.count("id").as_("n")`.
+    `ref=None` means `*` (COUNT only)."""
+
+    name: str
+    ref: Optional[str]
+    alias: Optional[str] = None
+    distinct: bool = False
+
+    def as_(self, alias: str) -> "Fn":
+        return replace(self, alias=alias)
+
+    def sql(self) -> str:
+        inner = "*" if self.ref is None else _quote_ref(self.ref)
+        if self.distinct:
+            inner = "distinct " + inner
+        out = f"{self.name}({inner})"
+        if self.alias is not None:
+            out += f" as {_quote(self.alias)}"
+        return out
+
+
+class fn:
+    """Aggregate helpers, the Kysely `fn` namespace analog."""
+
+    @staticmethod
+    def _make(name: str, ref: Optional[str], distinct: bool = False) -> Fn:
+        if name not in _FNS:
+            raise ValueError(f"unsupported function: {name}")
+        if ref is None and name != "count":
+            raise ValueError(f"{name} requires a column")
+        return Fn(name, ref, None, distinct)
+
+    @staticmethod
+    def count(ref: Optional[str] = None, distinct: bool = False) -> Fn:
+        return fn._make("count", ref, distinct)
+
+    @staticmethod
+    def sum(ref: str) -> Fn:
+        return fn._make("sum", ref)
+
+    @staticmethod
+    def avg(ref: str) -> Fn:
+        return fn._make("avg", ref)
+
+    @staticmethod
+    def min(ref: str) -> Fn:
+        return fn._make("min", ref)
+
+    @staticmethod
+    def max(ref: str) -> Fn:
+        return fn._make("max", ref)
+
+    @staticmethod
+    def total(ref: str) -> Fn:
+        return fn._make("total", ref)
+
+    @staticmethod
+    def group_concat(ref: str, distinct: bool = False) -> Fn:
+        return fn._make("group_concat", ref, distinct)
+
+
+# A select item: a (possibly qualified) column ref, a (ref, alias)
+# pair, or an aggregate Fn.
+SelectItem = Union[str, Tuple[str, str], Fn]
+
+
+def _select_sql(item: SelectItem) -> str:
+    if isinstance(item, Fn):
+        return item.sql()
+    if isinstance(item, tuple):
+        ref, alias = item
+        return f"{_quote_ref(ref)} as {_quote(alias)}"
+    return _quote_ref(item)
+
+
 @dataclass(frozen=True)
 class QueryBuilder:
     """An immutable SELECT builder; every method returns a new builder."""
 
     _table: str
-    _columns: Tuple[str, ...] = ()
+    _columns: Tuple[SelectItem, ...] = ()
+    _joins: Tuple[Tuple[str, str, str, str], ...] = ()  # (kind, table, left, right)
     _wheres: Tuple[Tuple[str, str, object], ...] = ()
+    _group_by: Tuple[str, ...] = ()
+    _havings: Tuple[Tuple[Union[str, Fn], str, object], ...] = ()
     _order_by: Tuple[Tuple[str, str], ...] = ()
     _limit: Optional[int] = None
     _offset: Optional[int] = None
 
-    def select(self, *columns: str) -> "QueryBuilder":
+    def select(self, *columns: SelectItem) -> "QueryBuilder":
         return replace(self, _columns=self._columns + columns)
 
     def select_all(self) -> "QueryBuilder":
         return replace(self, _columns=())
+
+    def inner_join(self, other: str, left_ref: str, right_ref: str) -> "QueryBuilder":
+        """`inner_join("todoCategory", "todoCategory.id",
+        "todo.categoryId")` — the Kysely innerJoin signature."""
+        return replace(
+            self, _joins=self._joins + (("inner", other, left_ref, right_ref),)
+        )
+
+    def left_join(self, other: str, left_ref: str, right_ref: str) -> "QueryBuilder":
+        return replace(
+            self, _joins=self._joins + (("left", other, left_ref, right_ref),)
+        )
 
     def where(self, column: str, op: str, value: object) -> "QueryBuilder":
         if op.lower() not in _OPS:
@@ -55,6 +158,14 @@ class QueryBuilder:
         op, v = ("is", 1) if deleted else ("is not", 1)
         return self.where("isDeleted", op, v)
 
+    def group_by(self, *refs: str) -> "QueryBuilder":
+        return replace(self, _group_by=self._group_by + refs)
+
+    def having(self, target: Union[str, Fn], op: str, value: object) -> "QueryBuilder":
+        if op.lower() not in _OPS:
+            raise ValueError(f"unsupported operator: {op}")
+        return replace(self, _havings=self._havings + ((target, op.lower(), value),))
+
     def order_by(self, column: str, direction: str = "asc") -> "QueryBuilder":
         if direction.lower() not in ("asc", "desc"):
             raise ValueError(f"bad direction: {direction}")
@@ -66,27 +177,54 @@ class QueryBuilder:
     def offset(self, n: int) -> "QueryBuilder":
         return replace(self, _offset=int(n))
 
+    @staticmethod
+    def _condition(target: Union[str, Fn], op: str, value: object, parameters: List[object]) -> str:
+        if isinstance(target, Fn):
+            # Reusing a selected-and-aliased Fn in having() is the
+            # natural flow; the alias belongs to the select list only.
+            lhs = replace(target, alias=None).sql()
+        else:
+            lhs = _quote_ref(target)
+        if op == "in":
+            values = list(value)  # type: ignore[arg-type]
+            marks = ", ".join("?" for _ in values)
+            parameters.extend(values)
+            return f"{lhs} in ({marks})"
+        if op in ("is", "is not") and value is None:
+            return f"{lhs} {op} null"
+        parameters.append(value)
+        return f"{lhs} {op} ?"
+
     def compile(self) -> Tuple[str, List[object]]:
         """→ (sql, parameters), like Kysely's `.compile()`."""
-        cols = ", ".join(_quote(c) for c in self._columns) if self._columns else "*"
+        cols = ", ".join(_select_sql(c) for c in self._columns) if self._columns else "*"
         sql = f"SELECT {cols} FROM {_quote(self._table)}"
+        for kind, other, left_ref, right_ref in self._joins:
+            sql += (
+                f" {kind} join {_quote(other)}"
+                f" on {_quote_ref(left_ref)} = {_quote_ref(right_ref)}"
+            )
         parameters: List[object] = []
         if self._wheres:
-            terms = []
-            for column, op, value in self._wheres:
-                if op == "in":
-                    values = list(value)  # type: ignore[arg-type]
-                    marks = ", ".join("?" for _ in values)
-                    terms.append(f"{_quote(column)} in ({marks})")
-                    parameters.extend(values)
-                elif op in ("is", "is not") and value is None:
-                    terms.append(f"{_quote(column)} {op} null")
-                else:
-                    terms.append(f"{_quote(column)} {op} ?")
-                    parameters.append(value)
+            terms = [
+                self._condition(column, op, value, parameters)
+                for column, op, value in self._wheres
+            ]
             sql += " WHERE " + " AND ".join(terms)
+        if self._group_by:
+            sql += " GROUP BY " + ", ".join(_quote_ref(r) for r in self._group_by)
+        if self._havings:
+            if not self._group_by:
+                raise ValueError("having requires group_by")
+            terms = [
+                self._condition(target, op, value, parameters)
+                for target, op, value in self._havings
+            ]
+            sql += " HAVING " + " AND ".join(terms)
         if self._order_by:
-            sql += " ORDER BY " + ", ".join(f"{_quote(c)} {d}" for c, d in self._order_by)
+            sql += " ORDER BY " + ", ".join(
+                f"{_quote_ref(c)} {d}" for c, d in self._order_by
+            )
         if self._limit is not None:
             sql += " LIMIT ?"
             parameters.append(self._limit)

@@ -76,6 +76,63 @@ def test_query_builder_quotes_identifiers():
     assert '"t""x"' in sql and '"c""ol"' in sql
 
 
+def test_query_builder_joins_and_aliases():
+    """The Kysely innerJoin/leftJoin surface (kysely.ts exposes the full
+    Kysely select builder; reference apps join e.g. todo to
+    todoCategory)."""
+    sql, params = (
+        table("todo")
+        .select(("todo.title", "title"), ("todoCategory.name", "category"))
+        .inner_join("todoCategory", "todoCategory.id", "todo.categoryId")
+        .where("todo.isDeleted", "is not", 1)
+        .order_by("todo.title")
+        .compile()
+    )
+    assert sql == (
+        'SELECT "todo"."title" as "title", "todoCategory"."name" as "category" '
+        'FROM "todo" inner join "todoCategory" '
+        'on "todoCategory"."id" = "todo"."categoryId" '
+        'WHERE "todo"."isDeleted" is not ? ORDER BY "todo"."title" asc'
+    )
+    assert params == [1]
+    left, _ = (
+        table("todo")
+        .left_join("todoCategory", "todoCategory.id", "todo.categoryId")
+        .compile()
+    )
+    assert 'left join "todoCategory"' in left
+
+
+def test_query_builder_aggregates_group_by_having():
+    from evolu_tpu.api.query import fn
+
+    sql, params = (
+        table("todo")
+        .select("categoryId", fn.count("id").as_("n"), fn.min("createdAt").as_("first"))
+        .group_by("categoryId")
+        .having(fn.count("id"), ">", 1)
+        .order_by("n", "desc")
+        .compile()
+    )
+    assert sql == (
+        'SELECT "categoryId", count("id") as "n", min("createdAt") as "first" '
+        'FROM "todo" GROUP BY "categoryId" HAVING count("id") > ? '
+        'ORDER BY "n" desc'
+    )
+    assert params == [1]
+    assert fn.count().sql() == "count(*)"
+    assert fn.count("id", distinct=True).sql() == 'count(distinct "id")'
+    # Reusing the selected-and-aliased Fn in having() must not leak the
+    # alias into the HAVING clause (invalid SQL).
+    n = fn.count("id").as_("n")
+    sql2, _ = table("todo").select("categoryId", n).group_by("categoryId").having(n, ">", 1).compile()
+    assert 'HAVING count("id") > ?' in sql2
+    with pytest.raises(ValueError):
+        table("t").having(fn.count(), ">", 0).compile()  # having without group_by
+    with pytest.raises(ValueError):
+        fn.sum(None)
+
+
 # --- model casts (model.ts:100-112) ---
 
 
@@ -446,6 +503,58 @@ def test_create_hooks_analog():
         assert len(view.rows) == 2 and len(changes) == 1  # unsubscribed
         assert hooks.use_owner() is hooks.evolu.owner
         view.dispose()
+    finally:
+        hooks.evolu.dispose()
+
+
+def test_joined_reactive_query_drives_query_view():
+    """A two-table join as a live subscription: mutations to EITHER
+    side re-run the query and notify the view (the reference re-runs
+    all subscribed queries after every send/receive, send.ts:121)."""
+    from evolu_tpu.api.hooks import create_hooks
+    from evolu_tpu.api.query import fn
+
+    schema = {
+        "todo": ("title", "isCompleted", "categoryId"),
+        "todoCategory": ("name",),
+    }
+    hooks = create_hooks(schema)
+    try:
+        mutate = hooks.use_mutation()
+        home = mutate("todoCategory", {"name": "home"})
+        work = mutate("todoCategory", {"name": "work"})
+        mutate("todo", {"title": "dishes", "categoryId": home})
+        mutate("todo", {"title": "report", "categoryId": work})
+        mutate("todo", {"title": "email", "categoryId": work})
+
+        view = hooks.use_query(
+            lambda t: t("todo")
+            .select(("todo.title", "title"), ("todoCategory.name", "category"))
+            .inner_join("todoCategory", "todoCategory.id", "todo.categoryId")
+            .order_by("todo.title")
+        )
+        counts = hooks.use_query(
+            lambda t: t("todo")
+            .select("categoryId", fn.count("id").as_("n"))
+            .group_by("categoryId")
+            .having(fn.count("id"), ">", 1)
+        )
+        changes = []
+        view.subscribe(lambda: changes.append(True))
+        hooks.evolu.worker.flush()
+        assert view.rows == [
+            {"title": "dishes", "category": "home"},
+            {"title": "email", "category": "work"},
+            {"title": "report", "category": "work"},
+        ]
+        assert counts.rows == [{"categoryId": work, "n": 2}]
+
+        # Mutating the JOINED side (rename a category) must re-render.
+        mutate("todoCategory", {"id": home, "name": "chores"})
+        hooks.evolu.worker.flush()
+        assert changes
+        assert view.rows[0] == {"title": "dishes", "category": "chores"}
+        view.dispose(), counts.dispose()
     finally:
         hooks.evolu.dispose()
 
