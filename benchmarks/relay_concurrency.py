@@ -1,0 +1,125 @@
+"""Relay latency under 25 concurrent clients (the reference deploy's
+fly.io concurrency allowance, examples/server-nodejs/fly.toml) — p50/p99
+per-request, single-store vs owner-sharded store.
+
+Each client = one owner posting rounds of 100 encrypted messages over
+HTTP (protobuf SyncRequest), like the reference hot loop
+apps/server/src/index.ts:148-159 sees from many devices.
+
+Prints one JSON line.
+"""
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.server.relay import RelayServer, RelayStore, ShardedRelayStore
+from evolu_tpu.sync import protocol
+
+CLIENTS = 25
+ROUNDS = 8
+MSGS_PER_ROUND = 100
+BASE = 1_700_000_000_000
+
+
+def _msgs(node: str, start: int, n: int):
+    return tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(Timestamp(BASE + (start + i) * 1000, 0, node)),
+            b"x" * 64,
+        )
+        for i in range(n)
+    )
+
+
+def _post(url: str, req: protocol.SyncRequest) -> protocol.SyncResponse:
+    body = protocol.encode_sync_request(req)
+    r = urllib.request.urlopen(
+        urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/octet-stream"}
+        ),
+        timeout=60,
+    )
+    return protocol.decode_sync_response(r.read())
+
+
+def run(store) -> dict:
+    server = RelayServer(store).start()
+    latencies: list = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(CLIENTS)
+    errors = []
+
+    def client(i: int):
+        user, node = f"user{i:03d}", f"{i + 1:016x}"
+        mine = []
+        try:
+            barrier.wait(timeout=30)
+            for rnd in range(ROUNDS):
+                req = protocol.SyncRequest(
+                    _msgs(node, rnd * MSGS_PER_ROUND, MSGS_PER_ROUND), user, node, "{}"
+                )
+                t0 = time.perf_counter()
+                _post(server.url, req)
+                mine.append(time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+        with lock:
+            latencies.extend(mine)
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(CLIENTS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    finally:
+        server.stop()
+    if errors:
+        raise errors[0]
+    latencies.sort()
+    total_msgs = CLIENTS * ROUNDS * MSGS_PER_ROUND
+    return {
+        "p50_ms": round(statistics.median(latencies) * 1e3, 2),
+        "p99_ms": round(latencies[int(len(latencies) * 0.99) - 1] * 1e3, 2),
+        "max_ms": round(latencies[-1] * 1e3, 2),
+        "requests": len(latencies),
+        "msgs_per_sec": round(total_msgs / wall),
+    }
+
+
+def main() -> None:
+    results = {
+        "single_store": run(RelayStore()),
+        "sharded_store": run(ShardedRelayStore(shards=8)),
+    }
+    head = results["sharded_store"]
+    print(
+        json.dumps(
+            {
+                "metric": "relay_concurrent_sync_p99_ms",
+                "value": head["p99_ms"],
+                "unit": "ms @ 25 clients",
+                "detail": {
+                    "clients": CLIENTS,
+                    "rounds": ROUNDS,
+                    "msgs_per_round": MSGS_PER_ROUND,
+                    "configs": results,
+                    "cpus": os.cpu_count(),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

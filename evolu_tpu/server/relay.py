@@ -234,13 +234,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(out)
 
 
+class _RelayHTTPServer(ThreadingHTTPServer):
+    # The reference's deploy allows 25 concurrent connections
+    # (examples/server-nodejs/fly.toml); socketserver's default listen
+    # backlog of 5 resets simultaneous connects well below that.
+    request_queue_size = 128
+
+
 class RelayServer:
     """ThreadingHTTPServer wrapper; `url` once started."""
 
     def __init__(self, store: Optional[RelayStore] = None, host: str = "127.0.0.1", port: int = 0):
         self.store = store or RelayStore()
         handler = type("BoundHandler", (_Handler,), {"store": self.store})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd = _RelayHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 
     @property

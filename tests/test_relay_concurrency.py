@@ -1,0 +1,150 @@
+"""Relay under concurrency.
+
+The reference deploys behind fly.io with 25 allowed concurrent
+connections (examples/server-nodejs/fly.toml services.concurrency) but
+never tests concurrent access. Here: many clients hammer the HTTP
+relay simultaneously — distinct owners spread over the sharded store
+(each shard its own single-writer SQLite), and many writers contending
+on ONE owner (the per-database RLock serialization path) with
+overlapping duplicate batches exercising the changes==1 Merkle gate
+under racing inserts. End state must equal a sequentially-fed oracle.
+
+The latency numbers for this scenario live in
+benchmarks/relay_concurrency.py / docs/BENCHMARKS.md.
+"""
+
+import threading
+import urllib.request
+
+import pytest
+
+from evolu_tpu.core.merkle import merkle_tree_to_string
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.server.relay import RelayServer, RelayStore, ShardedRelayStore
+from evolu_tpu.sync import protocol
+
+BASE = 1_700_000_000_000
+FRESH_NODE = "f" * 16  # a node id no message carries (own-msg exclusion no-op)
+
+
+def _msgs(node: str, start: int, n: int):
+    return tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(Timestamp(BASE + (start + i) * 1000, 0, node)),
+            b"ct-%d" % (start + i),
+        )
+        for i in range(n)
+    )
+
+
+def _post(url: str, req: protocol.SyncRequest) -> protocol.SyncResponse:
+    body = protocol.encode_sync_request(req)
+    r = urllib.request.urlopen(
+        urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/octet-stream"}
+        ),
+        timeout=30,
+    )
+    return protocol.decode_sync_response(r.read())
+
+
+def _run_threads(workers):
+    barrier = threading.Barrier(len(workers))
+    errors = []
+
+    def wrap(fn):
+        try:
+            barrier.wait(timeout=30)
+            fn()
+        except Exception as e:  # noqa: BLE001 - collected and re-raised
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(fn,)) for fn in workers]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "stress thread hung"
+    if errors:
+        raise errors[0]
+
+
+def test_25_concurrent_distinct_owners_match_sequential_oracle():
+    """25 clients (the fly.io concurrency limit), distinct owners, 3
+    rounds each, racing through the ThreadingHTTPServer into the
+    sharded store. Every owner's final relay state must be exactly what
+    a sequential single-store run produces."""
+    server = RelayServer(ShardedRelayStore(shards=4)).start()
+    try:
+        users = [f"user{i:02d}" for i in range(25)]
+        nodes = [f"{i:016x}" for i in range(1, 26)]
+
+        def client(u, node):
+            def run():
+                for rnd in range(3):
+                    req = protocol.SyncRequest(
+                        _msgs(node, rnd * 30, 30), u, node, "{}"
+                    )
+                    resp = _post(server.url, req)
+                    assert resp.merkle_tree  # tree always returned
+            return run
+
+        _run_threads([client(u, n) for u, n in zip(users, nodes)])
+
+        oracle = RelayStore()
+        try:
+            for u, node in zip(users, nodes):
+                tree = oracle.add_messages(u, _msgs(node, 0, 90))
+                got = _post(
+                    server.url, protocol.SyncRequest((), u, FRESH_NODE, "{}")
+                )
+                assert got.merkle_tree == merkle_tree_to_string(tree), u
+                assert [m.timestamp for m in got.messages] == [
+                    m.timestamp for m in _msgs(node, 0, 90)
+                ], u
+                assert [m.content for m in got.messages] == [
+                    m.content for m in _msgs(node, 0, 90)
+                ], u
+        finally:
+            oracle.close()
+    finally:
+        server.stop()
+
+
+def test_single_owner_contention_duplicates_race():
+    """8 writers racing on ONE owner through one SQLite handle: each
+    posts its own slice plus a shared duplicate slice (every thread
+    re-sends messages 0..19). INSERT OR IGNORE + the changes==1 XOR
+    gate must keep the tree exact — a duplicate that double-XORed under
+    the race would corrupt the digest permanently."""
+    server = RelayServer(RelayStore()).start()
+    try:
+        user = "hot-owner"
+        shared = _msgs("a" * 16, 0, 20)
+
+        def writer(i):
+            own = _msgs(f"{i + 1:016x}", 100 + i * 20, 20)
+
+            def run():
+                _post(server.url, protocol.SyncRequest(shared + own, user, f"{i + 1:016x}", "{}"))
+                _post(server.url, protocol.SyncRequest(shared, user, f"{i + 1:016x}", "{}"))
+            return run
+
+        _run_threads([writer(i) for i in range(8)])
+
+        oracle = RelayStore()
+        try:
+            expect = list(shared) + [
+                m for i in range(8) for m in _msgs(f"{i + 1:016x}", 100 + i * 20, 20)
+            ]
+            tree = oracle.add_messages(user, tuple(expect))
+            got = _post(server.url, protocol.SyncRequest((), user, FRESH_NODE, "{}"))
+            assert got.merkle_tree == merkle_tree_to_string(tree)
+            assert sorted(m.timestamp for m in got.messages) == sorted(
+                m.timestamp for m in expect
+            )
+            assert len(got.messages) == len(expect)  # duplicates stored once
+        finally:
+            oracle.close()
+    finally:
+        server.stop()
