@@ -78,6 +78,30 @@ def to_host(x):
     return np.concatenate([np.asarray(d) for _, d in pairs])
 
 
+def to_host_many(*xs):
+    """Batched device→host pull: start EVERY copy asynchronously first,
+    then materialize — one transfer wave instead of one blocking
+    round-trip per array. Under a tunneled device (axon) each blocking
+    pull pays full RTT, so fetching a kernel's 7-9 outputs one by one
+    costs ~7-9× RTT; this brings it down to ~1×. Per-array conversion
+    still goes through `to_host` (sharded-aware). Returns a tuple in
+    input order; numpy inputs pass through."""
+    for x in xs:
+        shards = getattr(x, "addressable_shards", None)
+        if shards:
+            for s in shards:
+                try:
+                    s.data.copy_to_host_async()
+                except AttributeError:
+                    break
+        else:
+            try:
+                x.copy_to_host_async()
+            except AttributeError:
+                pass
+    return tuple(to_host(x) for x in xs)
+
+
 def bucket_size(n: int, multiple: int = 64) -> int:
     """Power-of-two batch bucket ≥ max(n, multiple). One policy for
     every host→device batch (SURVEY.md §7 "dynamic shapes": pad to

@@ -33,9 +33,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import operator
+
 from evolu_tpu.core.timestamp import timestamp_from_string
 from evolu_tpu.core.types import CrdtMessage
-from evolu_tpu.ops import bucket_size, with_x64
+from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import node_hex_to_u64, pack_ts_key_host
 from evolu_tpu.utils.log import span
 
@@ -69,7 +71,7 @@ def _segmented_max_scan(flags, k1, k2, reverse: bool = False):
     return m1, m2
 
 
-def plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras=()):
+def plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras=(), return_winners=False):
     """The device LWW planner in SORTED order (traceable core).
 
     Sorts by (cell, batch order) and returns the masks in that sorted
@@ -133,6 +135,13 @@ def plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras=()):
     real = c != _PAD_CELL
     upsert_sorted = first_eligible & beats & real
     xor_sorted = xor_sorted & real
+    if return_winners:
+        # (beats1, beats2) IS lex_max(segment total max, stored winner)
+        # — the cell's updated winner; meaningful at seg_end rows. The
+        # HBM winner cache scatters these back over its slots.
+        return xor_sorted, upsert_sorted, i_s, s1, s2, extras_sorted, (
+            beats1, beats2, seg_end, real,
+        )
     return xor_sorted, upsert_sorted, i_s, s1, s2, extras_sorted
 
 
@@ -140,7 +149,9 @@ def unpermute_masks(xor_sorted, upsert_sorted, i_s, block_size: int = 0):
     """Host side: sorted-order masks + permutation → original batch
     order. With `block_size` > 0 the arrays are concatenated per-shard
     blocks whose `i_s` values are shard-local (the shard_map layout);
-    each block unpermutes within its own span."""
+    each block unpermutes within its own span. Callers on the hot path
+    pre-pull device outputs with `to_host_many` (one transfer wave);
+    `to_host` below then no-ops on the numpy arrays."""
     from evolu_tpu.ops import to_host
 
     xor_sorted = to_host(xor_sorted)
@@ -176,6 +187,29 @@ def plan_merge_core(cell_id, k1, k2, ex_k1, ex_k2, num_segments: int):
 
 
 plan_merge = jax.jit(plan_merge_core, static_argnames=("num_segments",))
+
+
+class PlannedBatch(tuple):
+    """A planner result that unpacks as the usual (xor_mask, upserts,
+    deltas) 3-tuple but also carries the positional bool `upsert_mask`,
+    so `storage.apply.apply_messages` can hand the mask straight to the
+    C++ `apply_planned` instead of rebuilding it from `upserts` with a
+    per-message Python pass."""
+
+    def __new__(cls, xor_mask, upserts, deltas, upsert_mask=None):
+        self = super().__new__(cls, (xor_mask, upserts, deltas))
+        self.upsert_mask = upsert_mask
+        return self
+
+
+def select_messages(messages: Sequence[CrdtMessage], mask: np.ndarray) -> List[CrdtMessage]:
+    """messages[i] for mask[i], without a per-message Python loop."""
+    ix = np.nonzero(mask)[0]
+    if len(ix) == 0:
+        return []
+    if len(ix) == 1:
+        return [messages[int(ix[0])]]
+    return list(operator.itemgetter(*ix)(messages))
 
 
 def messages_to_columns(
@@ -284,14 +318,11 @@ def _plan_batch_device_timed(messages, existing_winners):
     if not rest[-1]:  # canonical flag
         return None
     (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns([cell_ids, k1, k2, ex_k1, ex_k2], n)
-    xor_mask, upsert_mask = plan_merge(
+    xor_mask, upsert_mask = to_host_many(*plan_merge(
         jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
         jnp.asarray(ex_k1), jnp.asarray(ex_k2), num_segments=size,
-    )
-    xor_mask = np.asarray(xor_mask)[:n]
-    upsert_mask = np.asarray(upsert_mask)[:n]
-    upserts: List[CrdtMessage] = [m for i, m in enumerate(messages) if upsert_mask[i]]
-    return list(map(bool, xor_mask)), upserts
+    ))
+    return xor_mask[:n].tolist(), select_messages(messages, upsert_mask[:n])
 
 
 @jax.jit
@@ -338,14 +369,18 @@ def plan_batch_device_full(
         (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns(
             [cell_ids, k1, k2, ex_k1, ex_k2], n
         )
-        xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = _plan_full_kernel(
+        outs = _plan_full_kernel(
             jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
             jnp.asarray(ex_k1), jnp.asarray(ex_k2),
         )
+        # ONE transfer wave for all 7 outputs (per-array pulls pay one
+        # tunnel RTT each).
+        xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = to_host_many(*outs)
         xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s)
         xor_mask, upsert_mask = xor_mask[:n], upsert_mask[:n]
         deltas = decode_owner_minute_deltas(
             np.zeros(size, np.int32), minute_sorted, seg_end, seg_xor, valid
         ).get(0, {})
-        upserts: List[CrdtMessage] = [m for i, m in enumerate(messages) if upsert_mask[i]]
-        return list(map(bool, xor_mask)), upserts, deltas
+        return PlannedBatch(
+            xor_mask.tolist(), select_messages(messages, upsert_mask), deltas, upsert_mask
+        )

@@ -26,7 +26,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 import functools
 
-from evolu_tpu.ops import bucket_size, with_x64
+from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
 from evolu_tpu.ops.merge import _PAD_CELL, plan_merge_sorted_core, unpermute_masks
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
@@ -117,8 +117,9 @@ def reconcile_hot_owner(
         shd = sharding(mesh)
         args = [jax.device_put(cols[k], shd) for k in
                 ("cell_id", "k1", "k2", "ex_k1", "ex_k2")]
+        # ONE transfer wave for all 8 outputs (ops.to_host_many).
         xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid, digest = (
-            _compiled_kernel(mesh)(*args)
+            to_host_many(*_compiled_kernel(mesh)(*args))
         )
 
         xor_flat, upsert_flat = unpermute_masks(xor_s, upsert_s, i_s, block_size=chunk)
@@ -127,7 +128,6 @@ def reconcile_hot_owner(
 
         # XOR-combine per-minute deltas across shards (exact: XOR
         # monoid; the shared decoder merges repeated minute keys).
-        minute_sorted = np.asarray(minute_sorted)
         by_owner = decode_owner_minute_deltas(
             np.zeros_like(minute_sorted), minute_sorted, seg_end, seg_xor, valid
         )

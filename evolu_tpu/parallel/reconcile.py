@@ -29,9 +29,15 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from evolu_tpu.core.types import CrdtMessage
-from evolu_tpu.ops import bucket_size, with_x64
+from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
-from evolu_tpu.ops.merge import _PAD_CELL, messages_to_columns, plan_merge_sorted_core, unpermute_masks
+from evolu_tpu.ops.merge import (
+    _PAD_CELL,
+    messages_to_columns,
+    plan_merge_sorted_core,
+    select_messages,
+    unpermute_masks,
+)
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, sharding
 from evolu_tpu.utils.log import log, span
@@ -195,8 +201,10 @@ def _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners):
     results = {}
     digest = 0
     if index:
+        # ONE transfer wave for all 9 kernel outputs — per-array pulls
+        # pay one tunnel RTT each (see ops.to_host_many).
         xor_s, upsert_s, i_s, owner_sorted, minute_sorted, seg_end, seg_xor, seg_valid, dev_digest = (
-            reconcile_columns_sharded(mesh, cols)
+            to_host_many(*reconcile_columns_sharded(mesh, cols))
         )
         shard_size = len(cols["cell_id"]) // mesh.devices.size
         xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s, block_size=shard_size)
@@ -206,9 +214,12 @@ def _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners):
         digest = int(dev_digest)
         for owner, (positions, o_ix) in index.items():
             messages = owner_batches[owner]
-            o_xor = [bool(xor_mask[p]) for p in positions]
-            upserts = [m for j, m in enumerate(messages) if upsert_mask[positions[j]]]
-            results[owner] = (o_xor, upserts, deltas_by_ix.get(o_ix, {}))
+            o_mask = upsert_mask[positions]
+            results[owner] = (
+                xor_mask[positions].tolist(),
+                select_messages(messages, o_mask),
+                deltas_by_ix.get(o_ix, {}),
+            )
     for owner in host_owners:
         log("kernel:reconcile", "non-canonical hex case: host-planner fallback",
             owner=owner, n=len(owner_batches[owner]))

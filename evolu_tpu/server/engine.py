@@ -31,7 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from evolu_tpu.core.merkle import apply_prefix_xors, merkle_tree_to_string
-from evolu_tpu.ops import bucket_size, with_x64
+from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import timestamp_hashes
 from evolu_tpu.ops.host_parse import parse_packed_timestamps, parse_timestamp_strings
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
@@ -181,8 +181,9 @@ def deltas_from_columns(
 
     shd = sharding(mesh)
     args = [jax.device_put(a, shd) for a in (millis, counter, node, valid, oix)]
+    # ONE transfer wave for all 6 outputs (ops.to_host_many).
     owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, dev_digest = (
-        _compiled_merkle_kernel(mesh)(*args)
+        to_host_many(*_compiled_merkle_kernel(mesh)(*args))
     )
 
     by_ix = decode_owner_minute_deltas(owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted)

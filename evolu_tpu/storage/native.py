@@ -22,6 +22,8 @@ import ctypes
 import os
 import subprocess
 import threading
+
+import numpy as np
 from contextlib import contextmanager
 from typing import Iterable, List, Optional, Sequence, Tuple
 
@@ -459,7 +461,8 @@ class CppSqliteDatabase:
         if n == 0:
             return
         kinds, ivals, dvals, svals, blens = _columnar_values([m.value for m in messages])
-        mask = (ctypes.c_uint8 * n)(*[1 if b else 0 for b in upsert_mask])
+        mask_np = np.ascontiguousarray(np.asarray(upsert_mask, dtype=np.uint8))
+        mask = (ctypes.c_uint8 * n).from_buffer_copy(mask_np)
         with self._lock:
             self._check_open()
             rc = self._lib.eh_apply_planned(
