@@ -1,0 +1,109 @@
+"""Winner-source experiment (SURVEY.md §7 hard part 4): stream stored
+winners from SQLite per batch vs keep them HBM-resident across batches
+(`ops/winner_cache.py`), on the config-2 full-system shape — steady
+state: several successive 100k-message batches over a persistent cell
+population, SQLite end states asserted equal.
+
+Prints one JSON line.
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.core.merkle import merkle_tree_to_string
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.ops.merge import plan_batch_device_full
+from evolu_tpu.ops.winner_cache import DeviceWinnerCache
+from evolu_tpu.storage.apply import apply_messages
+from evolu_tpu.storage.native import open_database
+from evolu_tpu.storage.schema import init_db_model
+
+N = 100_000
+BATCHES = 4
+
+
+def build_batch(batch_no, n=N, seed=2):
+    rng = random.Random(seed + batch_no)
+    tables = [("todo", ("title", "isCompleted", "categoryId")),
+              ("todoCategory", ("name",)),
+              ("todoNote", ("text",))]
+    nodes = [f"{rng.getrandbits(64):016x}" for _ in range(8)]
+    base = 1_700_000_000_000 + batch_no * 40_000_000
+    out = []
+    for i in range(n):
+        table, cols = rng.choice(tables)
+        out.append(CrdtMessage(
+            timestamp_to_string(Timestamp(base + i // 4, i % 4, rng.choice(nodes))),
+            table, f"row{rng.randrange(5000)}", rng.choice(cols), f"v{i}",
+        ))
+    return out
+
+
+def fresh_db():
+    db = open_database(backend="auto")
+    init_db_model(db, mnemonic=None)
+    for t in ("todo", "todoCategory", "todoNote"):
+        db.exec(
+            f'CREATE TABLE "{t}" ("id" TEXT PRIMARY KEY, "title" BLOB, '
+            '"isCompleted" BLOB, "categoryId" BLOB, "name" BLOB, "text" BLOB)'
+        )
+    return db
+
+
+def run(planner_for):
+    db = fresh_db()
+    planner = planner_for(db)
+    tree = {}
+    # Warm compiles outside the timed region (both planners share
+    # bucket-size-keyed jits; the cache also compiles its seed kernel).
+    warm = build_batch(99, n=1024)
+    tree_w = apply_messages(db, {}, warm, planner=planner)
+    per_batch = []
+    for b in range(BATCHES):
+        batch = build_batch(b)
+        t0 = time.perf_counter()
+        tree = apply_messages(db, tree, batch, planner=planner)
+        per_batch.append(time.perf_counter() - t0)
+    dump = (
+        db.exec('SELECT COUNT(*), MIN("timestamp"), MAX("timestamp") FROM "__message"'),
+        db.exec('SELECT COUNT(*) FROM "todo"'),
+    )
+    db.close()
+    steady = per_batch[1:]  # batch 0 populates the store / cache
+    return {
+        "per_batch_s": [round(t, 3) for t in per_batch],
+        "steady_msgs_per_sec": round(N * len(steady) / sum(steady)),
+        "tree": merkle_tree_to_string(tree),
+        "dump": repr(dump),
+    }
+
+
+def main():
+    streamed = run(lambda db: plan_batch_device_full)
+    cached = run(lambda db: DeviceWinnerCache(db, capacity=1 << 15).plan_batch)
+    assert streamed["tree"] == cached["tree"], "digest divergence"
+    assert streamed["dump"] == cached["dump"], "end-state divergence"
+    import jax
+
+    print(json.dumps({
+        "metric": "winner_source_steady_msgs_per_sec",
+        "value": cached["steady_msgs_per_sec"],
+        "unit": "msgs/sec",
+        "detail": {
+            "batches": BATCHES, "batch_size": N,
+            "streamed_sqlite": {k: streamed[k] for k in ("per_batch_s", "steady_msgs_per_sec")},
+            "hbm_cache": {k: cached[k] for k in ("per_batch_s", "steady_msgs_per_sec")},
+            "end_state_equal": True,
+            "platform": jax.devices()[0].platform,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,276 @@
+"""HBM-resident per-cell winner cache (SURVEY.md §7 hard part 4).
+
+The round-1 design streamed each batch's stored winners out of SQLite
+(`storage.apply.fetch_existing_winners`) and shipped them to the device
+as `ex_k1/ex_k2` columns. This module is the measured alternative the
+round-1 review asked for: the per-cell winner table LIVES in device
+memory across batches — the kernel gathers stored winners from HBM,
+plans the batch, and scatter-updates the winners in place (donated
+buffers, so XLA reuses the allocation) — with SQLite as the durable
+write-behind it always was. Per steady-state batch this removes the
+SQLite winner read, the winner-string parse, and the 16·N-byte ex
+column host→device transfer.
+
+Coherence contract:
+- SQLite remains the source of truth. Cache slots are seeded lazily:
+  the first time a cell is seen, its winner is read from SQLite (one
+  batched read for all new cells). After that the kernel's scatter
+  keeps the slot exactly equal to SQLite's `MAX(timestamp)` for the
+  cell, because every apply goes through `plan_batch` below.
+- The scatter runs at plan time, inside the caller's transaction. If
+  the transaction fails the cache is ahead of SQLite, so
+  `on_transaction_failed()` (hooked by `storage.apply.apply_messages`)
+  drops everything — the next batch re-seeds from SQLite. Cheap and
+  always safe.
+- Non-canonical hex case (messages or stored winners) cannot be
+  ordered by numeric keys (reference semantics are raw-string order);
+  such batches fall back to the host oracle planner and every touched
+  cell is invalidated, mirroring `merge._host_fallback`.
+
+Memory: 16 bytes/cell (two uint64 keys), power-of-two capacity grown by
+doubling — 1M cells = 16 MiB of HBM. Invalidated cells release their
+slots to a free list; re-assignment always rewrites the slot (winner or
+zeros), so a reused slot cannot leak a previous cell's keys.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.ops import bucket_size, to_host_many, with_x64
+from evolu_tpu.ops.encode import pack_ts_key_host, timestamp_hashes, unpack_ts_keys
+from evolu_tpu.ops.host_parse import intern_cells, parse_timestamp_strings
+from evolu_tpu.ops.merge import (
+    _PAD_CELL,
+    PlannedBatch,
+    plan_merge_sorted_core,
+    select_messages,
+    unpermute_masks,
+)
+from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
+from evolu_tpu.utils.log import span
+
+Cell = Tuple[str, str, str]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _cached_plan_kernel(w1, w2, slots, cell_id, k1, k2):
+    """Gather stored winners from the HBM cache, plan, scatter the
+    updated winners back — one dispatch, cache buffers donated (updated
+    in place). Padding rows carry slot 0; their gathered value is dead
+    (masked by the pad cell) and their scatter target is the
+    out-of-range dump index (dropped)."""
+    e1 = w1[slots]
+    e2 = w2[slots]
+    xor_s, upsert_s, i_s, s1, s2, (slots_s,), (win1, win2, seg_end, real) = (
+        plan_merge_sorted_core(
+            cell_id, k1, k2, e1, e2, extras=(slots,), return_winners=True
+        )
+    )
+    millis_s, counter_s = unpack_ts_keys(s1)
+    hashes = jnp.where(xor_s, timestamp_hashes(millis_s, counter_s, s2), jnp.uint32(0))
+    zero_owner = jnp.zeros((), jnp.int32)
+    _, minute_sorted, m_seg_end, seg_xor, valid_sorted = owner_minute_segments(
+        zero_owner, millis_s, hashes, xor_s
+    )
+    cap = jnp.int32(w1.shape[0])
+    tgt = jnp.where(seg_end & real, slots_s, cap)
+    w1 = w1.at[tgt].set(win1, mode="drop")
+    w2 = w2.at[tgt].set(win2, mode="drop")
+    return w1, w2, xor_s, upsert_s, i_s, minute_sorted, m_seg_end, seg_xor, valid_sorted
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _seed_kernel(w1, w2, idx, v1, v2):
+    """Write seed winners into cache slots (padding rows target the
+    out-of-range dump index and are dropped)."""
+    w1 = w1.at[idx].set(v1, mode="drop")
+    w2 = w2.at[idx].set(v2, mode="drop")
+    return w1, w2
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("new_cap",))
+def _grow_kernel(w, new_cap):
+    out = jnp.zeros(new_cap, w.dtype)
+    return jax.lax.dynamic_update_slice(out, w, (0,))
+
+
+class DeviceWinnerCache:
+    """Keeps (k1, k2) winner keys per cell in device memory across
+    batches. `plan_batch` matches the planner contract of
+    `storage.apply.apply_messages` but advertises
+    `fetches_winners = False`: apply skips its SQLite winner read and
+    the cache seeds misses itself."""
+
+    fetches_winners = False
+
+    def __init__(self, db, capacity: int = 1 << 15):
+        self._db = db
+        self._slots: Dict[Cell, int] = {}
+        self._free: List[int] = []  # invalidated slots, reused first
+        self._next_slot = 0
+        self.capacity = capacity
+        with jax.enable_x64(True):
+            self._w1 = jnp.zeros(capacity, jnp.uint64)
+            self._w2 = jnp.zeros(capacity, jnp.uint64)
+
+    # -- slot management --
+
+    def _grow_to(self, needed: int) -> None:
+        new_cap = self.capacity
+        while new_cap < needed:
+            new_cap *= 2
+        if new_cap != self.capacity:
+            with jax.enable_x64(True):
+                self._w1 = _grow_kernel(self._w1, new_cap=new_cap)
+                self._w2 = _grow_kernel(self._w2, new_cap=new_cap)
+            self.capacity = new_cap
+
+    def _seed_new_cells(self, new_cells: List[Cell]) -> bool:
+        """Assign slots to first-seen cells (reusing invalidated slots
+        first) and load their winners from SQLite in one batched read.
+        Every assigned slot is written — winner keys for cells with
+        history, zeros for the rest, so a reused slot can never leak a
+        previous cell's stale keys. Returns False when any seed winner
+        is non-canonical (the caller must take the host path; the
+        non-canonical cells stay unassigned)."""
+        from evolu_tpu.storage.apply import fetch_existing_winners
+
+        winners = fetch_existing_winners(self._db, new_cells)
+        n = len(new_cells)
+        v1 = np.zeros(n, np.uint64)
+        v2 = np.zeros(n, np.uint64)
+        seed_ix = [j for j, c in enumerate(new_cells) if c in winners]
+        if seed_ix:
+            millis, counter, node, case_ok = parse_timestamp_strings(
+                [winners[new_cells[j]] for j in seed_ix], with_case=True
+            )
+            if not bool(case_ok.all()):
+                # A stored non-canonical winner cannot live in the
+                # numeric cache. Keep every cell of this batch
+                # uncached; the caller falls back to the host planner.
+                return False
+            v1[seed_ix] = pack_ts_key_host(millis, counter)
+            v2[seed_ix] = node
+        reused = min(len(self._free), n)
+        self._grow_to(self._next_slot + n - reused)
+        idx = np.empty(n, np.int32)
+        for j, c in enumerate(new_cells):
+            if self._free:
+                slot = self._free.pop()
+            else:
+                slot = self._next_slot
+                self._next_slot += 1
+            idx[j] = self._slots[c] = slot
+        (idx_p, v1_p, v2_p), _ = _pad_seed(idx, v1, v2, self.capacity)
+        with jax.enable_x64(True):
+            self._w1, self._w2 = _seed_kernel(
+                self._w1, self._w2, jnp.asarray(idx_p),
+                jnp.asarray(v1_p), jnp.asarray(v2_p),
+            )
+        return True
+
+    def invalidate(self, cells) -> None:
+        for c in cells:
+            slot = self._slots.pop(c, None)
+            if slot is not None:
+                self._free.append(slot)
+
+    def reset(self) -> None:
+        self._slots.clear()
+        self._free.clear()
+        self._next_slot = 0
+        with jax.enable_x64(True):
+            self._w1 = jnp.zeros(self.capacity, jnp.uint64)
+            self._w2 = jnp.zeros(self.capacity, jnp.uint64)
+
+    def on_transaction_failed(self) -> None:
+        """The plan-time scatter already advanced the cache; a rolled
+        back transaction leaves SQLite behind it, so drop everything
+        and re-seed lazily."""
+        self.reset()
+
+    # -- the planner --
+
+    @with_x64
+    def plan_batch(self, messages: Sequence[CrdtMessage], existing_winners=None):
+        """Planner with the `plan_batch_device_full` contract
+        ((xor_mask, upserts, deltas) + positional upsert mask), winners
+        sourced from HBM instead of the `existing_winners` argument
+        (which apply passes as {} — `fetches_winners = False`)."""
+        n = len(messages)
+        if n == 0:
+            return PlannedBatch([], [], {}, np.zeros(0, bool))
+        with span("kernel:merge", "winner_cache.plan_batch", n=n):
+            millis, counter, node, case_ok = parse_timestamp_strings(
+                [m.timestamp for m in messages], with_case=True
+            )
+            cell_ids, cells = intern_cells(
+                [m.table for m in messages], [m.row for m in messages],
+                [m.column for m in messages],
+            )
+            if not bool(case_ok.all()):
+                return self._host_fallback(messages, cells)
+            new_cells = [c for c in cells if c not in self._slots]
+            if new_cells and not self._seed_new_cells(new_cells):
+                return self._host_fallback(messages, cells)
+
+            slot_of = np.fromiter(
+                (self._slots[c] for c in cells), np.int32, len(cells)
+            )
+            slots = slot_of[cell_ids]
+            k1 = pack_ts_key_host(millis, counter)
+            size = bucket_size(n)
+            pad = size - n
+            cell_p = np.concatenate([cell_ids, np.full(pad, int(_PAD_CELL), np.int32)])
+            slots_p = np.concatenate([slots, np.zeros(pad, np.int32)])
+            k1_p = np.concatenate([k1, np.zeros(pad, np.uint64)])
+            k2_p = np.concatenate([node, np.zeros(pad, np.uint64)])
+
+            self._w1, self._w2, *outs = _cached_plan_kernel(
+                self._w1, self._w2, jnp.asarray(slots_p),
+                jnp.asarray(cell_p), jnp.asarray(k1_p), jnp.asarray(k2_p),
+            )
+            xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = (
+                to_host_many(*outs)
+            )
+            xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s)
+            xor_mask, upsert_mask = xor_mask[:n], upsert_mask[:n]
+            deltas = decode_owner_minute_deltas(
+                np.zeros(size, np.int32), minute_sorted, seg_end, seg_xor, valid
+            ).get(0, {})
+            return PlannedBatch(
+                xor_mask.tolist(), select_messages(messages, upsert_mask),
+                deltas, upsert_mask,
+            )
+
+    def _host_fallback(self, messages, cells):
+        """Non-canonical hex case: invalidate every touched cell —
+        their SQLite winners may now be non-canonical, which the
+        numeric cache cannot represent — then delegate to the shared
+        host-oracle fallback (raw-string order, verbatim-case hashing;
+        one implementation to keep in sync)."""
+        from evolu_tpu.ops.merge import _host_fallback
+        from evolu_tpu.storage.apply import fetch_existing_winners
+
+        self.invalidate(cells)
+        existing = fetch_existing_winners(self._db, cells)
+        return _host_fallback(messages, existing, len(messages), with_deltas=True)
+
+
+def _pad_seed(idx, k1, k2, capacity: int):
+    """Pad seed columns to a power-of-two bucket; pad rows target the
+    out-of-range dump index (dropped by the scatter)."""
+    size = bucket_size(len(idx), multiple=16)
+    pad = size - len(idx)
+    return (
+        np.concatenate([idx, np.full(pad, capacity, np.int32)]),
+        np.concatenate([k1, np.zeros(pad, np.uint64)]),
+        np.concatenate([k2, np.zeros(pad, np.uint64)]),
+    ), size

@@ -47,11 +47,18 @@ def _now_millis() -> int:
     return int(time.time() * 1000)
 
 
-def select_planner(config: Config) -> Callable:
+def select_planner(config: Config, db: Optional[PySqliteDatabase] = None) -> Callable:
     """Pick the merge planner per config.backend: the host oracle below
     `min_device_batch`, the device kernel at/above it ("auto"/"tpu"),
     and the cell-range-sharded hot-owner kernel for huge single-owner
-    batches on multi-device hosts."""
+    batches on multi-device hosts.
+
+    With `db` and `config.winner_cache`, device-planned batches source
+    stored winners from the HBM-resident cache (ops/winner_cache.py —
+    measured faster than streaming them from SQLite per batch) — the
+    returned planner then owns winner fetching (`fetches_winners =
+    False`) and any batch planned OUTSIDE the cache (host oracle,
+    hot-owner) invalidates its touched cells, keeping cache == SQLite."""
     if config.backend == "cpu":
         return plan_batch
 
@@ -59,22 +66,57 @@ def select_planner(config: Config) -> Callable:
 
     threshold = 0 if config.backend == "tpu" else config.min_device_batch
     hot_min = config.hot_owner_min_batch
+    cache = None
+    if db is not None and config.winner_cache:
+        from evolu_tpu.ops.winner_cache import DeviceWinnerCache
+
+        cache = DeviceWinnerCache(db)
 
     def planner(batch, existing):
+        hot_route = (
+            hot_min is not None and len(batch) >= hot_min and _multi_device()
+        )
+        touched = None
+        if cache is not None:
+            if not hot_route and len(batch) >= threshold and not existing:
+                # The standard device route: winners live in HBM.
+                return cache.plan_batch(batch)
+            # A non-cache route plans this batch (hot-owner, host
+            # oracle, or a caller handed explicit winners). It needs
+            # real stored winners if apply gave us none, and afterwards
+            # the cache entries for its cells are stale — the plan
+            # bypasses the cache scatter — so invalidate them.
+            touched = {(m.table, m.row, m.column) for m in batch}
+            if not existing:
+                from evolu_tpu.storage.apply import fetch_existing_winners
+
+                existing = fetch_existing_winners(db, touched)
         cols = None
-        if hot_min is not None and len(batch) >= hot_min:
+        if hot_route:
             plan, cols = _plan_hot_owner(batch, existing)
             if plan is not None:
+                if touched is not None:
+                    cache.invalidate(touched)
                 return plan
+        if touched is not None:
+            cache.invalidate(touched)
         if len(batch) >= threshold:
-            # Always (xor_mask, upserts, deltas): minute deltas come
-            # from the device kernel, or from the host fold when the
-            # batch carries non-canonical hex case. `cols` reuses the
-            # hot path's columnarization when it declined the batch.
+            # `cols` reuses the hot path's columnarization when it
+            # declined the batch (non-canonical hex case).
             return plan_batch_device_full(batch, existing, cols=cols)
         return plan_batch(batch, existing)
 
+    if cache is not None:
+        planner.fetches_winners = False
+        planner.on_transaction_failed = cache.on_transaction_failed
+        planner.cache = cache
     return planner
+
+
+def _multi_device() -> bool:
+    import jax
+
+    return len(jax.devices()) >= 2
 
 
 def _plan_hot_owner(batch, existing):
@@ -82,14 +124,11 @@ def _plan_hot_owner(batch, existing):
     shards by cell-id ranges over every local device (per-cell LWW
     merges are independent — SURVEY.md §5 "within one hot owner, by
     cell-id ranges"). Returns (plan, cols): the standard 3-tuple plan,
-    or plan=None when the host should route normally (single device, or
-    non-canonical hex case — the device order/hash contract doesn't
-    hold there and plan_batch_device_full's own fallback takes over);
-    `cols` carries the columnarization for reuse either way."""
-    import jax
-
-    if len(jax.devices()) < 2:
-        return None, None
+    or plan=None when the host should route normally (non-canonical hex
+    case — the device order/hash contract doesn't hold there and
+    plan_batch_device_full's own fallback takes over); `cols` carries
+    the columnarization for reuse either way. Callers gate on
+    `_multi_device()`."""
     from evolu_tpu.ops.merge import messages_to_columns
     from evolu_tpu.parallel.hot_owner import reconcile_hot_owner
     from evolu_tpu.parallel.mesh import create_mesh
@@ -127,7 +166,7 @@ class DbWorker:
         self.sync_lock = sync_lock or get_sync_lock(db.path)
         self.owner: Optional[Owner] = None
         self.queries_rows_cache: Dict[str, List[dict]] = {}
-        self._planner = select_planner(self.config)
+        self._planner = select_planner(self.config, self.db)
         self._staged_effects: List = []
         self._staged_cache: Dict[str, List[dict]] = {}
         self._queue: "queue.Queue[object]" = queue.Queue()
@@ -392,9 +431,16 @@ class DbWorker:
             )
         )
 
+    def _drop_winner_cache(self) -> None:
+        """Tables just got dropped; cached winner keys are meaningless."""
+        cache = getattr(self._planner, "cache", None)
+        if cache is not None:
+            cache.reset()
+
     def _reset_owner(self) -> None:
         """resetOwner.ts:7-21."""
         delete_all_tables(self.db)
+        self._drop_winner_cache()
         self._staged_effects.append(self.queries_rows_cache.clear)
         self._emit(msg.ReloadAllTabs())
 
@@ -402,6 +448,7 @@ class DbWorker:
         """restoreOwner.ts:9-23 — wipe, re-seed identity; history returns
         via the first sync against the relay (SURVEY.md §3.5)."""
         delete_all_tables(self.db)
+        self._drop_winner_cache()
         self._staged_effects.append(self.queries_rows_cache.clear)
         self.owner = init_db_model(self.db, mnemonic)
         self._emit(msg.ReloadAllTabs())

@@ -158,50 +158,86 @@ def apply_messages(
     """
     if not messages:
         return merkle_tree
-    with db.transaction():  # whole-batch atomicity, like the reference's dbTransaction
+    planner = planner or plan_batch
+    try:
+        with db.transaction():  # whole-batch atomicity, like the reference's dbTransaction
+            return _apply_messages_in_txn(db, merkle_tree, messages, planner)
+    except BaseException:
+        # A planner that mutates its own state at plan time (the HBM
+        # winner cache) is now ahead of the rolled-back SQLite; let it
+        # resynchronize.
+        _notify_plan_failure(planner)
+        raise
+
+
+def _notify_plan_failure(planner) -> None:
+    """Fire the planner's transaction-failure hook, if any. The hook
+    may sit on the planner function (select_planner's closure) or on a
+    bound method's instance (DeviceWinnerCache.plan_batch)."""
+    on_failed = getattr(planner, "on_transaction_failed", None)
+    if on_failed is None:
+        owner = getattr(planner, "__self__", None)
+        on_failed = getattr(owner, "on_transaction_failed", None)
+    if on_failed is not None:
+        on_failed()
+
+
+def _apply_messages_in_txn(db, merkle_tree, messages, planner):
+    # `fetches_winners` may sit on the planner function or, for bound
+    # methods (DeviceWinnerCache.plan_batch), on the owning instance.
+    owner = getattr(planner, "__self__", None)
+    fetches = getattr(planner, "fetches_winners",
+                      getattr(owner, "fetches_winners", True))
+    if fetches:
         cells = {(m.table, m.row, m.column) for m in messages}
         existing = fetch_existing_winners(db, cells)
-        plan = (planner or plan_batch)(messages, existing)
-        if len(plan) == 3:
-            # Device planner: masks AND per-minute Merkle deltas in one
-            # dispatch (no per-message Python hashing).
-            xor_mask, upserts, deltas = plan
-        else:
-            xor_mask, upserts = plan
-            # Merkle deltas: the shared oracle-exact fold (verbatim node
-            # case). Computed BEFORE any write so a malformed timestamp
-            # rolls the whole batch back — committing messages whose
-            # hashes never reach the tree would diverge the digest
-            # permanently.
-            deltas, _ = minute_deltas_host(
-                m.timestamp for i, m in enumerate(messages) if xor_mask[i]
-            )
+    else:
+        existing = {}  # the planner owns its winner source (HBM cache)
+    plan = planner(messages, existing)
+    if len(plan) == 3:
+        # Device planner: masks AND per-minute Merkle deltas in one
+        # dispatch (no per-message Python hashing).
+        xor_mask, upserts, deltas = plan
+    else:
+        xor_mask, upserts = plan
+        # Merkle deltas: the shared oracle-exact fold (verbatim node
+        # case). Computed BEFORE any write so a malformed timestamp
+        # rolls the whole batch back — committing messages whose
+        # hashes never reach the tree would diverge the digest
+        # permanently.
+        deltas, _ = minute_deltas_host(
+            m.timestamp for i, m in enumerate(messages) if xor_mask[i]
+        )
 
-        if hasattr(db, "apply_planned"):
-            # C++ backend: upserts + bulk __message insert in one call.
-            # The mask is keyed by cell+timestamp (planners may rebuild
-            # message objects), flagging only the FIRST occurrence of
-            # each winner key — a duplicate timestamp with a different
-            # value must not upsert twice, or the end state would
-            # diverge from the Python path, which applies the planner's
-            # single chosen winner.
+    if hasattr(db, "apply_planned"):
+        # C++ backend: upserts + bulk __message insert in one call.
+        mask = getattr(plan, "upsert_mask", None)
+        if mask is None:
+            # Host planners return upserts only; rebuild the
+            # positional mask keyed by cell+timestamp, flagging only
+            # the FIRST occurrence of each winner key — a duplicate
+            # timestamp with a different value must not upsert
+            # twice, or the end state would diverge from the Python
+            # path, which applies the planner's single chosen
+            # winner. (Device planners carry the positional mask,
+            # PlannedBatch, skipping this per-message pass.)
             pending = {(m.table, m.row, m.column, m.timestamp) for m in upserts}
             mask = []
             for m in messages:
                 key = (m.table, m.row, m.column, m.timestamp)
                 mask.append(key in pending)
                 pending.discard(key)
-            db.apply_planned(messages, mask)
-        else:
-            # App tables: only the final winner per cell touches the row.
-            for m in upserts:
-                db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
+        db.apply_planned(messages, mask)
+    else:
+        # App tables: only the final winner per cell touches the row.
+        for m in upserts:
+            db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
 
-            # __message: bulk insert, PK dedup handles duplicates.
-            db.run_many(
-                _INSERT_MESSAGE,
-                [(m.timestamp, m.table, m.row, m.column, m.value) for m in messages],
-            )
+        # __message: bulk insert, PK dedup handles duplicates.
+        db.run_many(
+            _INSERT_MESSAGE,
+            [(m.timestamp, m.table, m.row, m.column, m.value) for m in messages],
+        )
 
     # One sparse-tree pass (pure, cannot fail after commit).
     return apply_prefix_xors(merkle_tree, deltas)
@@ -257,6 +293,15 @@ def apply_messages_chunked(
                 if on_chunk is not None:
                     on_chunk(next_tree, applied + len(chunk))
         except Exception as e:
+            # The inner apply_messages only fires the planner's failure
+            # hook for exceptions raised inside itself; its transaction
+            # JOINS this outer scope, so an `on_chunk` failure rolls
+            # the chunk back here AFTER apply returned — the planner
+            # (HBM winner cache) must still resynchronize or it keeps
+            # phantom winners SQLite never committed (permanent digest
+            # divergence on redelivery). Firing twice is harmless: the
+            # hook is an idempotent reset.
+            _notify_plan_failure(planner or plan_batch)
             raise ChunkedApplyError(merkle_tree, applied, e) from e
         merkle_tree = next_tree
         applied += len(chunk)

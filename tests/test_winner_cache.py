@@ -1,0 +1,232 @@
+"""HBM-resident winner cache (ops/winner_cache.py) — state parity with
+the streamed-winner production path across multi-batch steady state,
+lazy seeding from a pre-populated store, non-canonical fallback with
+invalidation, and the transaction-failure resync hook."""
+
+import numpy as np
+import pytest
+
+from evolu_tpu.core.merkle import merkle_tree_to_string
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.ops.winner_cache import DeviceWinnerCache
+from evolu_tpu.storage.apply import apply_messages
+from evolu_tpu.storage.native import open_database
+from evolu_tpu.storage.schema import init_db_model
+
+BASE = 1_700_000_000_000
+
+
+def _db():
+    db = open_database(":memory:", "auto")
+    init_db_model(db, mnemonic=None)
+    db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title" BLOB, "done" BLOB)')
+    return db
+
+
+def _mk(i, node="a1b2c3d4e5f60718", row=None, col="title", value=None):
+    return CrdtMessage(
+        timestamp_to_string(Timestamp(BASE + i * 977, i % 4, node)),
+        "todo", row or f"r{i % 23}", col, value if value is not None else f"v{i}",
+    )
+
+
+def _dump(db):
+    return (
+        db.exec('SELECT * FROM "__message" ORDER BY "timestamp"'),
+        db.exec('SELECT * FROM "todo" ORDER BY "id"'),
+    )
+
+
+def test_cache_matches_streamed_path_across_batches():
+    """Three successive batches with overlapping cells: the cached
+    planner's SQLite end state and tree must equal the streamed-winner
+    device planner's, batch by batch."""
+    from evolu_tpu.ops.merge import plan_batch_device_full
+
+    rng = np.random.default_rng(11)
+    db_a, db_b = _db(), _db()
+    cache = DeviceWinnerCache(db_b, capacity=64)  # force growth too
+    tree_a, tree_b = {}, {}
+    try:
+        for batch_no in range(3):
+            order = rng.permutation(120)
+            batch = tuple(_mk(int(i) + batch_no * 40) for i in order)
+            tree_a = apply_messages(db_a, tree_a, batch, planner=plan_batch_device_full)
+            tree_b = apply_messages(db_b, tree_b, batch, planner=cache.plan_batch)
+            assert _dump(db_a) == _dump(db_b), f"batch {batch_no}"
+            assert merkle_tree_to_string(tree_a) == merkle_tree_to_string(tree_b)
+    finally:
+        db_a.close(), db_b.close()
+
+
+def test_cache_seeds_from_prepopulated_store():
+    """A cache created over a store that already has history must seed
+    winners lazily from SQLite — a newer-than-stored message upserts, an
+    older one does not."""
+    db = _db()
+    try:
+        tree = apply_messages(db, {}, (_mk(50, row="rX"),))
+        cache = DeviceWinnerCache(db)
+        older = CrdtMessage(
+            timestamp_to_string(Timestamp(BASE + 1, 0, "b" * 16)), "todo", "rX", "title", "OLD"
+        )
+        newer = CrdtMessage(
+            timestamp_to_string(Timestamp(BASE + 10**9, 0, "b" * 16)), "todo", "rX", "title", "NEW"
+        )
+        tree = apply_messages(db, tree, (older,), planner=cache.plan_batch)
+        assert db.exec_sql_query('SELECT "title" FROM "todo" WHERE "id" = ?', ("rX",)) == [{"title": "v50"}]
+        tree = apply_messages(db, tree, (newer,), planner=cache.plan_batch)
+        assert db.exec_sql_query('SELECT "title" FROM "todo" WHERE "id" = ?', ("rX",)) == [{"title": "NEW"}]
+    finally:
+        db.close()
+
+
+def test_non_canonical_batch_falls_back_and_invalidates():
+    """Uppercase node hex routes to the host oracle (raw-string order,
+    verbatim hashing) and drops touched cells so the numeric cache never
+    serves a non-canonical winner. End state equals the default path."""
+    from evolu_tpu.storage.apply import plan_batch, fetch_existing_winners
+
+    db_a, db_b = _db(), _db()
+    cache = DeviceWinnerCache(db_b)
+    weird = (
+        CrdtMessage("2023-09-01T10:00:00.000Z-0000-ABCDEF0123456789", "todo", "rw", "title", "U"),
+        CrdtMessage("2023-09-01T10:00:00.000Z-0000-abcdef0123456789", "todo", "rw", "title", "L"),
+    )
+    clean_then = (_mk(900, row="rw"),)
+    try:
+        tree_a = apply_messages(db_a, {}, weird)
+        tree_b = apply_messages(db_b, {}, weird, planner=cache.plan_batch)
+        assert ("todo", "rw", "title") not in cache._slots  # invalidated
+        tree_a = apply_messages(db_a, tree_a, clean_then)
+        tree_b = apply_messages(db_b, tree_b, clean_then, planner=cache.plan_batch)
+        assert _dump(db_a) == _dump(db_b)
+        assert merkle_tree_to_string(tree_a) == merkle_tree_to_string(tree_b)
+    finally:
+        db_a.close(), db_b.close()
+
+
+def test_production_routing_through_worker():
+    """backend="tpu" + winner_cache (the default) routes client
+    receives through the HBM cache: the planner advertises
+    fetches_winners=False, the cache fills, end state matches a
+    cpu-backend client, and reset_owner drops the cache."""
+    from evolu_tpu.core.merkle import merkle_tree_to_string as tree_str
+    from evolu_tpu.runtime.client import create_evolu
+    from evolu_tpu.storage.clock import read_clock
+    from evolu_tpu.utils.config import Config
+
+    schema = {"todo": ("title", "isCompleted")}
+    hot = create_evolu(schema, config=Config(backend="tpu", winner_cache=True))
+    cpu = create_evolu(schema, config=Config(backend="cpu"), mnemonic=hot.owner.mnemonic)
+    try:
+        cache = hot.worker._planner.cache
+        assert cache is not None and not hot.worker._planner.fetches_winners
+        messages = tuple(_mk(i, node=f"{(i % 5) + 1:016x}") for i in range(300))
+        for c in (hot, cpu):
+            c.receive(messages, "{}", None)
+            c.worker.flush()
+        assert cache._slots, "cache never engaged"
+        assert (
+            hot.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+            == cpu.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+        )
+        assert tree_str(read_clock(hot.db).merkle_tree) == tree_str(
+            read_clock(cpu.db).merkle_tree
+        )
+        hot.reset_owner()
+        hot.worker.flush()
+        assert not cache._slots  # dropped with the tables
+    finally:
+        hot.dispose(), cpu.dispose()
+
+
+def test_slot_reuse_never_leaks_stale_keys():
+    """An invalidated cell's slot goes to the free list; when a NEW
+    cell (with no SQLite history) reuses it, the slot must read as
+    no-winner — not the previous cell's keys, which would wrongly
+    suppress the new cell's first upsert."""
+    db = _db()
+    cache = DeviceWinnerCache(db)
+    try:
+        # Occupy a slot with a large winner for cell rA.
+        tree = apply_messages(db, {}, (_mk(10**6, row="rA"),), planner=cache.plan_batch)
+        slot_a = cache._slots[("todo", "rA", "title")]
+        cache.invalidate([("todo", "rA", "title")])
+        assert slot_a in cache._free
+        # A brand-new cell reuses the slot; its (small) first message
+        # must still upsert.
+        small = CrdtMessage(
+            timestamp_to_string(Timestamp(BASE, 0, "c" * 16)), "todo", "rNEW", "title", "first"
+        )
+        tree = apply_messages(db, tree, (small,), planner=cache.plan_batch)
+        assert cache._slots[("todo", "rNEW", "title")] == slot_a  # reused
+        assert db.exec_sql_query(
+            'SELECT "title" FROM "todo" WHERE "id" = ?', ("rNEW",)
+        ) == [{"title": "first"}]
+        # And the free list does not grow without bound across cycles.
+        assert len(cache._free) == 0
+    finally:
+        db.close()
+
+
+def test_chunked_on_chunk_failure_fires_cache_resync(tmp_path):
+    """apply_messages_chunked: an `on_chunk` failure rolls the chunk
+    back AFTER apply_messages returned — the winner cache (already
+    scatter-advanced) must still resync, or redelivery sees phantom
+    winners (xor=False forever: permanent digest divergence)."""
+    from evolu_tpu.core.merkle import merkle_tree_to_string
+    from evolu_tpu.storage.apply import ChunkedApplyError, apply_messages_chunked
+
+    db = _db()
+    cache = DeviceWinnerCache(db)
+    msgs = tuple(_mk(i, row=f"c{i}") for i in range(6))
+    try:
+        with pytest.raises(ChunkedApplyError):
+            apply_messages_chunked(
+                db, {}, msgs, chunk_size=3, planner=cache.plan_batch,
+                on_chunk=lambda tree, n: (_ for _ in ()).throw(RuntimeError("persist failed")),
+            )
+        assert not cache._slots, "cache kept phantom winners after rollback"
+        # Redelivery must fully apply: rows upserted, hashes in tree.
+        tree = apply_messages(db, {}, msgs, planner=cache.plan_batch)
+        rows = db.exec_sql_query('SELECT COUNT(*) AS n FROM "todo"')
+        assert rows == [{"n": 6}]
+        db_cmp = _db()
+        expect = apply_messages(db_cmp, {}, msgs)
+        assert merkle_tree_to_string(tree) == merkle_tree_to_string(expect)
+        db_cmp.close()
+    finally:
+        db.close()
+
+
+def test_transaction_failure_resets_cache():
+    """If the transaction rolls back after planning, the cache (already
+    scattered forward) must resync — the same message applied again
+    must still XOR/upsert correctly."""
+    db = _db()
+    cache = DeviceWinnerCache(db)
+    msg = _mk(7, row="rF")
+    try:
+        real_apply = db.apply_planned
+        calls = {"n": 0}
+
+        def exploding(messages, mask):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("disk full")
+            return real_apply(messages, mask)
+
+        db.apply_planned = exploding
+        with pytest.raises(RuntimeError):
+            apply_messages(db, {}, (msg,), planner=cache.plan_batch)
+        assert not cache._slots  # reset
+        tree = apply_messages(db, {}, (msg,), planner=cache.plan_batch)
+        assert db.exec_sql_query('SELECT "title" FROM "todo" WHERE "id" = ?', ("rF",)) == [{"title": "v7"}]
+        rows = db.exec_sql_query('SELECT COUNT(*) AS n FROM "__message"')
+        assert rows == [{"n": 1}]
+        assert tree  # hash entered the tree exactly once
+    finally:
+        db.apply_planned = real_apply
+        db.close()
