@@ -1,0 +1,94 @@
+"""The visual demo (examples/web_todo.py) — API-level drive of the
+reference TodoMVC capabilities (examples/nextjs/pages/index.tsx): CRUD
+with soft-delete and categories, long-poll reactivity, owner lifecycle,
+and two demo instances converging through a live relay."""
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "examples"))
+
+from web_todo import DemoApp, DemoServer  # noqa: E402
+
+from evolu_tpu.server.relay import RelayServer  # noqa: E402
+
+
+def _api(base, path, body=None):
+    if body is None:
+        r = urllib.request.urlopen(base + path, timeout=30)
+    else:
+        req = urllib.request.Request(
+            base + path, data=json.dumps(body).encode(),
+            headers={"content-type": "application/json"},
+        )
+        r = urllib.request.urlopen(req, timeout=30)
+    return json.loads(r.read())
+
+
+def test_web_demo_crud_longpoll_and_reset():
+    server = DemoServer(DemoApp()).start()
+    base = server.url
+    try:
+        page = urllib.request.urlopen(base + "/", timeout=10).read().decode()
+        assert "TodoMVC" in page
+        cat = _api(base, "/api/mutate", {"table": "todoCategory", "values": {"name": "home"}})["id"]
+        t1 = _api(base, "/api/mutate", {"table": "todo",
+                  "values": {"title": "Buy milk", "isCompleted": False, "categoryId": cat}})["id"]
+        s1 = _api(base, "/api/state?since=-1")
+        assert [t["title"] for t in s1["todos"]] == ["Buy milk"]
+        assert s1["todos"][0]["categoryId"] == cat
+        assert s1["owner"]["mnemonic"]
+
+        # Long-poll wakes on mutation (the reactive-store contract).
+        got = {}
+        th = threading.Thread(
+            target=lambda: got.update(_api(base, f"/api/state?since={s1['version']}"))
+        )
+        th.start()
+        time.sleep(0.3)
+        _api(base, "/api/mutate", {"table": "todo", "values": {"id": t1, "isCompleted": True}})
+        th.join(timeout=10)
+        assert not th.is_alive() and got["version"] > s1["version"]
+        assert got["todos"][0]["isCompleted"] == 1
+
+        _api(base, "/api/mutate", {"table": "todo", "values": {"id": t1, "isDeleted": True}})
+        assert _api(base, "/api/state?since=-1")["todos"] == []
+
+        _api(base, "/api/reset", {})
+        s = _api(base, "/api/state?since=-1")
+        assert s["todos"] == [] and s["categories"] == []
+    finally:
+        server.stop()
+
+
+def test_two_demos_converge_through_relay():
+    relay = RelayServer().start()
+    a = DemoServer(DemoApp(sync_url=relay.url)).start()
+    mnemonic = a.app.evolu.owner.mnemonic
+    b = DemoServer(DemoApp(sync_url=relay.url, mnemonic=mnemonic)).start()
+    try:
+        _api(a.url, "/api/mutate", {"table": "todo",
+             "values": {"title": "from A", "isCompleted": False}})
+        # B never syncs explicitly: the demo's periodic auto-pull (the
+        # reference's load/online/focus trigger analog) must converge
+        # an IDLE instance on its own.
+        deadline = time.time() + 25
+        titles = []
+        while time.time() < deadline:
+            titles = [t["title"] for t in _api(b.url, "/api/state?since=-1")["todos"]]
+            if titles:
+                break
+            time.sleep(0.4)
+        assert titles == ["from A"]
+    finally:
+        try:
+            a.stop()
+        finally:
+            try:
+                b.stop()
+            finally:
+                relay.stop()
