@@ -82,7 +82,9 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.eh_column_bytes.argtypes = [p, i]
     lib.eh_fetch_winners.argtypes = [p, i64, sp, sp, sp, c.c_char_p, i64]
     lib.eh_apply_sequential.argtypes = [p, i64, sp, sp, sp, sp, i32p, i64p, dp, sp, i32p, u8p]
-    lib.eh_apply_planned.argtypes = [p, i64, sp, sp, sp, sp, i32p, i64p, dp, sp, i32p, u8p]
+    lib.eh_apply_planned_packed.argtypes = [
+        p, i64, s, i32p, s, i32p, s, i32p, s, i32p, i32p, i64p, dp, s, i32p, u8p,
+    ]
     lib.eh_relay_insert.argtypes = [p, i64, sp, sp, sp, i32p, u8p]
     lib.eh_relay_insert_packed.argtypes = [p, i64, sp, i64p, s, s, i32p, u8p]
     lib.eh_parse_timestamps.argtypes = [s, i64, i64p, i32p, c.POINTER(c.c_uint64), u8p]
@@ -456,23 +458,50 @@ class CppSqliteDatabase:
 
     def apply_planned(self, messages, upsert_mask: Sequence[bool]) -> None:
         """Apply a planner-computed upsert mask + bulk __message insert
-        in one C call. Caller manages the transaction."""
+        in one C call. Caller manages the transaction.
+
+        Marshalling is packed: one contiguous buffer + int32 lengths
+        per string column (`b"".join` at C speed) instead of 100k
+        ctypes pointer-array assignments, and every bind carries its
+        byte length so embedded NULs round-trip exactly like the
+        Python backend."""
         n = len(messages)
         if n == 0:
             return
-        kinds, ivals, dvals, svals, blens = _columnar_values([m.value for m in messages])
+        i32p = ctypes.POINTER(ctypes.c_int32)
+
+        def packed(items):
+            enc = [x.encode("utf-8") for x in items]
+            lens = np.fromiter(map(len, enc), np.int32, n)
+            return b"".join(enc), lens.ctypes.data_as(i32p), lens
+
+        ts_buf, ts_lens, _k1 = packed([m.timestamp for m in messages])
+        tbl_buf, tbl_lens, _k2 = packed([m.table for m in messages])
+        row_buf, row_lens, _k3 = packed([m.row for m in messages])
+        col_buf, col_lens, _k4 = packed([m.column for m in messages])
+        vals = [_encode_value(m.value) for m in messages]
+        kinds = np.fromiter((v[0] for v in vals), np.int32, n)
+        ivals = np.fromiter((v[1] for v in vals), np.int64, n)
+        dvals = np.fromiter((v[2] for v in vals), np.float64, n)
+        vlens = np.fromiter((v[4] for v in vals), np.int32, n)
+        val_buf = b"".join(v[3] for v in vals if v[3] is not None)
         mask_np = np.ascontiguousarray(np.asarray(upsert_mask, dtype=np.uint8))
-        mask = (ctypes.c_uint8 * n).from_buffer_copy(mask_np)
+        if len(mask_np) != n:  # C reads n bytes; a short buffer would be OOB
+            raise ValueError(f"upsert_mask length {len(mask_np)} != messages {n}")
         with self._lock:
             self._check_open()
-            rc = self._lib.eh_apply_planned(
+            rc = self._lib.eh_apply_planned_packed(
                 self._db, n,
-                _str_array([m.timestamp for m in messages]),
-                _str_array([m.table for m in messages]),
-                _str_array([m.row for m in messages]),
-                _str_array([m.column for m in messages]),
-                kinds, ivals, dvals, svals, blens, mask,
+                ts_buf, ts_lens, tbl_buf, tbl_lens,
+                row_buf, row_lens, col_buf, col_lens,
+                kinds.ctypes.data_as(i32p),
+                ivals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                dvals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                val_buf, vlens.ctypes.data_as(i32p),
+                mask_np.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
             )
+        if rc == 3:
+            raise UnknownError("identifier contains NUL")
         if rc != 0:
             raise self._err()
 

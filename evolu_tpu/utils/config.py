@@ -36,7 +36,7 @@ class Config:
     hot_owner_min_batch: "int | None" = 1 << 18
     # Keep per-cell stored winners HBM-resident across batches
     # (ops/winner_cache.py) instead of streaming them from SQLite per
-    # batch — measured +19% (tunneled TPU) / +55% (CPU) steady-state
+    # batch — measured +19% (tunneled TPU) / ~+30% (CPU) steady-state
     # end-to-end on the config-2 shape (benchmarks/winner_cache.py).
     # Ignored for backend "cpu".
     winner_cache: bool = True

@@ -85,19 +85,19 @@ function render() {
   $("mnemonic").textContent = state.owner.mnemonic || "";
   const sel = $("newCat"), had = sel.value;
   sel.innerHTML = '<option value="">no category</option>' +
-    state.categories.map(c => `<option value="${c.id}">${esc(c.name)}</option>`).join("");
+    state.categories.map(c => `<option value="${esc(c.id)}">${esc(c.name)}</option>`).join("");
   sel.value = had;
   $("todos").innerHTML = state.todos.map(t => `
-    <li class="${t.isCompleted ? "done" : ""}" data-id="${t.id}">
+    <li class="${t.isCompleted ? "done" : ""}" data-id="${esc(t.id)}">
       <input type="checkbox" ${t.isCompleted ? "checked" : ""} data-a="toggle">
       <span class="t" data-a="rename" title="click to rename">${esc(t.title)}</span>
       <select data-a="cat"><option value="">—</option>${
-        state.categories.map(c => `<option value="${c.id}" ${c.id === t.categoryId ? "selected" : ""}>${esc(c.name)}</option>`).join("")}
+        state.categories.map(c => `<option value="${esc(c.id)}" ${c.id === t.categoryId ? "selected" : ""}>${esc(c.name)}</option>`).join("")}
       </select>
       <button data-a="del">×</button>
     </li>`).join("");
   $("cats").innerHTML = state.categories.map(c => `
-    <li data-id="${c.id}"><span class="t" data-a="renameCat" title="click to rename">${esc(c.name)}</span>
+    <li data-id="${esc(c.id)}"><span class="t" data-a="renameCat" title="click to rename">${esc(c.name)}</span>
     <button data-a="delCat">×</button></li>`).join("");
 }
 const esc = (s) => String(s ?? "").replace(/[&<>"]/g, ch => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[ch]));

@@ -19,7 +19,7 @@
 //   eh_fetch_winners   — batched per-cell winner lookup
 //   eh_apply_sequential — the reference loop (winner check + app-table
 //                         upsert + __message insert), masks out
-//   eh_apply_planned   — apply a device-computed plan (upsert mask)
+//   eh_apply_planned_packed — apply a device-computed plan (upsert mask)
 //
 // Value passing: each message value arrives as (kind, int64, double,
 // text, blob_len) where kind ∈ {0:null, 1:int64, 2:double, 3:text,
@@ -93,6 +93,19 @@ int bind_value(sqlite3_stmt *st, int pos, int kind, int64_t iv, double dv,
     case 2: return sqlite3_bind_double(st, pos, dv);
     case 3: return sqlite3_bind_text(st, pos, sv, byte_len, SQLITE_TRANSIENT);
     case 4: return sqlite3_bind_blob(st, pos, sv, byte_len, SQLITE_TRANSIENT);
+    default: return sqlite3_bind_null(st, pos);
+  }
+}
+
+// Like bind_value, but the caller's buffers outlive the statement step
+// (packed batch entry points), so SQLITE_STATIC skips the copy.
+int bind_value_static(sqlite3_stmt *st, int pos, int kind, int64_t iv, double dv,
+                      const char *sv, int byte_len) {
+  switch (kind) {
+    case 1: return sqlite3_bind_int64(st, pos, iv);
+    case 2: return sqlite3_bind_double(st, pos, dv);
+    case 3: return sqlite3_bind_text(st, pos, sv, byte_len, SQLITE_STATIC);
+    case 4: return sqlite3_bind_blob(st, pos, sv, byte_len, SQLITE_STATIC);
     default: return sqlite3_bind_null(st, pos);
   }
 }
@@ -339,33 +352,56 @@ int eh_apply_sequential(sqlite3 *db, int64_t n, const char *const *timestamps,
 // (upsert_mask) and the Merkle XOR set; this applies the SQL side —
 // upserts for flagged rows, then the bulk __message insert for ALL
 // rows (PK dedup) — inside the caller's transaction.
-int eh_apply_planned(sqlite3 *db, int64_t n, const char *const *timestamps,
-                     const char *const *tables, const char *const *rows,
-                     const char *const *cols, const int32_t *kinds,
-                     const int64_t *ivals, const double *dvals,
-                     const char *const *svals, const int32_t *blob_lens,
-                     const uint8_t *upsert_mask) {
+// Packed variant: each string column arrives as ONE contiguous buffer
+// plus per-row byte lengths — no per-row pointer marshalling on the
+// Python side, and every bind carries its explicit byte length, so
+// embedded NUL bytes in table/row/column round-trip exactly like the
+// Python backend (the pointer variant above truncates at NUL).
+// Returns 0 ok, 1 SQLite error, 3 NUL inside an upserted identifier
+// (the Python backend's quote_ident raises there; whole batch aborts).
+int eh_apply_planned_packed(sqlite3 *db, int64_t n,
+                            const char *ts_buf, const int32_t *ts_lens,
+                            const char *tbl_buf, const int32_t *tbl_lens,
+                            const char *row_buf, const int32_t *row_lens,
+                            const char *col_buf, const int32_t *col_lens,
+                            const int32_t *kinds, const int64_t *ivals,
+                            const double *dvals, const char *val_buf,
+                            const int32_t *val_lens,
+                            const uint8_t *upsert_mask) {
   StmtCache cache(db);
   sqlite3_stmt *ins = cache.get(kInsertMessage);
   if (!ins) return 1;
+  int64_t ts_o = 0, tbl_o = 0, row_o = 0, col_o = 0, val_o = 0;
   for (int64_t i = 0; i < n; ++i) {
+    const char *ts = ts_buf + ts_o;
+    const char *tbl = tbl_buf + tbl_o;
+    const char *row = row_buf + row_o;
+    const char *col = col_buf + col_o;
+    const char *val = val_buf + val_o;
+    const int tsl = ts_lens[i], tbll = tbl_lens[i], rowl = row_lens[i],
+              coll = col_lens[i], vall = val_lens[i];
+    ts_o += tsl; tbl_o += tbll; row_o += rowl; col_o += coll;
+    if (kinds[i] == 3 || kinds[i] == 4) val_o += vall;
     if (upsert_mask[i]) {
-      sqlite3_stmt *up = cache.get(upsert_sql(tables[i], cols[i]));
+      if (memchr(tbl, 0, tbll) || memchr(col, 0, coll)) return 3;
+      std::string tname(tbl, tbll), cname(col, coll);
+      sqlite3_stmt *up = cache.get(upsert_sql(tname.c_str(), cname.c_str()));
       if (!up) return 1;
-      sqlite3_bind_text(up, 1, rows[i], -1, SQLITE_TRANSIENT);
-      bind_value(up, 2, kinds[i], ivals[i], dvals[i], svals[i], blob_lens[i]);
-      bind_value(up, 3, kinds[i], ivals[i], dvals[i], svals[i], blob_lens[i]);
+      sqlite3_bind_text(up, 1, row, rowl, SQLITE_STATIC);
+      bind_value_static(up, 2, kinds[i], ivals[i], dvals[i], val, vall);
+      bind_value_static(up, 3, kinds[i], ivals[i], dvals[i], val, vall);
       if (step_done(up) != SQLITE_OK) return 1;
     }
-    sqlite3_bind_text(ins, 1, timestamps[i], -1, SQLITE_TRANSIENT);
-    sqlite3_bind_text(ins, 2, tables[i], -1, SQLITE_TRANSIENT);
-    sqlite3_bind_text(ins, 3, rows[i], -1, SQLITE_TRANSIENT);
-    sqlite3_bind_text(ins, 4, cols[i], -1, SQLITE_TRANSIENT);
-    bind_value(ins, 5, kinds[i], ivals[i], dvals[i], svals[i], blob_lens[i]);
+    sqlite3_bind_text(ins, 1, ts, tsl, SQLITE_STATIC);
+    sqlite3_bind_text(ins, 2, tbl, tbll, SQLITE_STATIC);
+    sqlite3_bind_text(ins, 3, row, rowl, SQLITE_STATIC);
+    sqlite3_bind_text(ins, 4, col, coll, SQLITE_STATIC);
+    bind_value_static(ins, 5, kinds[i], ivals[i], dvals[i], val, vall);
     if (step_done(ins) != SQLITE_OK) return 1;
   }
   return 0;
 }
+
 
 // --- relay hot path: bulk (timestamp, userId, content) insert with
 // per-row "was new" flags (INSERT OR IGNORE changes()==1 semantics,

@@ -232,6 +232,50 @@ def test_trailing_comments_accepted_like_python():
     db.close()
 
 
+def test_embedded_nul_in_wire_fields_backend_parity():
+    """Hostile wire data: table/row/column strings carrying embedded
+    NUL bytes must produce byte-identical __message rows on both
+    backends (the packed C path binds with explicit byte lengths; a
+    NUL-terminated bind would silently truncate). A NUL inside an
+    UPSERTED identifier aborts on both backends instead."""
+    from evolu_tpu.core.types import UnknownError
+
+    msgs = [
+        CrdtMessage(ts(1_700_000_000_000 + i), "todo", f"r\x00ow{i}", "title", f"v\x00al{i}")
+        for i in range(5)
+    ]
+    dumps = []
+    for backend in ("python", "native"):
+        db = open_database(backend=backend)
+        bootstrap(db)
+        # No upserts planned (mask all False via planner contract):
+        # messages land in __message only, full bytes preserved.
+        if hasattr(db, "apply_planned"):
+            with db.transaction():
+                db.apply_planned(msgs, [False] * len(msgs))
+        else:
+            with db.transaction():
+                db.run_many(
+                    'INSERT INTO "__message" ("timestamp", "table", "row", "column", "value") '
+                    "VALUES (?, ?, ?, ?, ?) ON CONFLICT DO NOTHING",
+                    [(m.timestamp, m.table, m.row, m.column, m.value) for m in msgs],
+                )
+        dumps.append(db.exec('SELECT * FROM "__message" ORDER BY "timestamp"'))
+        db.close()
+    assert dumps[0] == dumps[1]
+    assert "r\x00ow0" in {r[2] for r in dumps[0]}  # NUL survived, not truncated
+
+    # Upsert with a NUL identifier: Python's quote_ident raises; the C
+    # path must refuse too (rc 3), not truncate into a different table.
+    db = open_database(backend="native")
+    bootstrap(db)
+    bad = CrdtMessage(ts(1_700_000_000_001), "to\x00do", "r", "title", "x")
+    with pytest.raises(UnknownError):
+        with db.transaction():
+            db.apply_planned([bad], [True])
+    db.close()
+
+
 def test_null_timestamp_row_does_not_crash_native_backend():
     """SQLite's legacy quirk lets a non-INTEGER BLOB PRIMARY KEY hold
     NULL; a tampered DB must yield defined behavior (NULL = no winner),
