@@ -63,7 +63,15 @@ def apply_messages_sequential(
     On the C++ backend the whole loop (winner check, upsert, insert)
     runs as one native call returning the XOR mask; on the Python
     backend it is O(n) SQL round trips."""
-    if hasattr(db, "apply_sequential"):
+    use_native = hasattr(db, "apply_sequential") and not any(
+        "\x00" in m.timestamp or "\x00" in m.table or "\x00" in m.row
+        or "\x00" in m.column
+        for m in messages
+    )  # the C path's char* ABI is NUL-terminated (binds AND winner
+    # compares); NUL-bearing wire fields must take the Python loop to
+    # bind full bytes like the reference (the batched production path
+    # is NUL-exact natively).
+    if use_native:
         xor_mask = db.apply_sequential(messages)
         for m, flagged in zip(messages, xor_mask):
             if flagged:
