@@ -42,9 +42,11 @@ def _civil_from_days(days):
     return y, m, d
 
 
-_ZERO = jnp.uint32(ord("0"))
-_UPPER_A = jnp.uint32(ord("A") - 10)
-_LOWER_A = jnp.uint32(ord("a") - 10)
+# np scalars, NOT jnp: module-level jnp constants would initialize the
+# XLA backend at import time (breaks jax.distributed.initialize).
+_ZERO = np.uint32(ord("0"))
+_UPPER_A = np.uint32(ord("A") - 10)
+_LOWER_A = np.uint32(ord("a") - 10)
 
 
 def _digits(x, n: int):

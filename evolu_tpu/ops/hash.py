@@ -14,9 +14,12 @@ by construction, so the JS `Math.imul`/`>>>` semantics come for free.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# np scalars, NOT jnp: module-level jnp constants would initialize the
+# XLA backend at import time (breaks jax.distributed.initialize).
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
 
 
 def _rotl(x, r: int):

@@ -41,7 +41,10 @@ from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import node_hex_to_u64, pack_ts_key_host
 from evolu_tpu.utils.log import span
 
-_PAD_CELL = jnp.int32(0x7FFFFFFF)
+# np scalar, NOT jnp: a module-level jnp constant would initialize
+# the XLA backend at import time, breaking jax.distributed.initialize
+# (multi-host join must run before any backend touch).
+_PAD_CELL = np.int32(0x7FFFFFFF)
 
 
 def _lex_max(a1, a2, b1, b2):

@@ -30,7 +30,7 @@ from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
 from evolu_tpu.ops.merge import _PAD_CELL, plan_merge_sorted_core, unpermute_masks
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
-from evolu_tpu.parallel.mesh import OWNERS_AXIS, sharding
+from evolu_tpu.parallel.mesh import OWNERS_AXIS, put_sharded, require_single_process, sharding
 from evolu_tpu.parallel.reconcile import xor_allreduce
 from evolu_tpu.utils.log import span
 
@@ -80,6 +80,7 @@ def reconcile_hot_owner(
     original batch order — identical to running `plan_merge_core` +
     minute deltas on one device (property-tested in tests).
     """
+    require_single_process("reconcile_hot_owner")
     n = len(cell_id)
     n_dev = mesh.devices.size
     with span("kernel:reconcile", "reconcile_hot_owner", n=n, devices=n_dev):
@@ -115,7 +116,7 @@ def reconcile_hot_owner(
             start += loads[d]
 
         shd = sharding(mesh)
-        args = [jax.device_put(cols[k], shd) for k in
+        args = [put_sharded(cols[k], shd) for k in
                 ("cell_id", "k1", "k2", "ex_k1", "ex_k2")]
         # ONE transfer wave for all 8 outputs (ops.to_host_many).
         xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid, digest = (

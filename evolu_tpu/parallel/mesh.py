@@ -36,6 +36,33 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def put_sharded(arr: np.ndarray, shd: NamedSharding):
+    """Host array → sharded device array, for every sharded dispatch
+    site. Single-process: plain device_put. Multi-process (a
+    jax.distributed cluster — the DCN topology): each process serves
+    its ADDRESSABLE shards from the same host-built global layout via
+    make_array_from_callback; jax assembles the global array without
+    any process addressing foreign devices."""
+    if jax.process_count() > 1:
+        return jax.make_array_from_callback(arr.shape, shd, lambda idx: arr[idx])
+    return jax.device_put(arr, shd)
+
+
+def require_single_process(what: str) -> None:
+    """Loud guard for fan-ins that index kernel outputs with GLOBAL
+    positions: on a multi-process cluster `to_host` returns only the
+    ADDRESSABLE shards, so global indexing would be silently wrong.
+    The multi-process pattern is `reconcile_columns_sharded` +
+    `multihost.local_owners`, each process consuming its own shards
+    (see tests/_multihost_worker.py)."""
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            f"{what} assembles per-owner results from GLOBAL output positions "
+            "and runs single-process only; on a jax.distributed cluster use "
+            "reconcile_columns_sharded + multihost.local_owners per process"
+        )
+
+
 def assign_owners_to_shards(
     owner_sizes: Dict[Hashable, int], n_shards: int
 ) -> List[List[Hashable]]:

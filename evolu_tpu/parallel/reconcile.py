@@ -39,7 +39,13 @@ from evolu_tpu.ops.merge import (
     unpermute_masks,
 )
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
-from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, sharding
+from evolu_tpu.parallel.mesh import (
+    OWNERS_AXIS,
+    assign_owners_to_shards,
+    put_sharded,
+    require_single_process,
+    sharding,
+)
 from evolu_tpu.utils.log import log, span
 
 
@@ -102,10 +108,15 @@ def reconcile_columns_sharded(mesh: Mesh, cols: Dict[str, np.ndarray]):
     (xor_sorted, upsert_sorted, i_s, owner_sorted, minute_sorted,
     seg_end, seg_xor, seg_valid, digest) — masks are in per-shard
     cell-sorted order; `unpermute_masks(..., block_size=shard_size)`
-    restores batch order on the host."""
+    restores batch order on the host. Works on a multi-process
+    cluster: every process builds the same global columns, feeds its
+    local shards (`put_sharded`), and pulls back only its addressable
+    outputs (`to_host` concatenates addressable shards) — the digest
+    is replicated by the XOR all-reduce, so every process sees the
+    whole-batch digest while owning only its shards' plans."""
     shd = sharding(mesh)
     args = [
-        jax.device_put(cols[k], shd)
+        put_sharded(cols[k], shd)
         for k in ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
     ]
     return _compiled_kernel(mesh)(*args)
@@ -190,6 +201,7 @@ def reconcile_owner_batches(
     """
     if not owner_batches:
         return {}, 0
+    require_single_process("reconcile_owner_batches")
     n_msgs = sum(len(v) for v in owner_batches.values())
     with span("kernel:reconcile", "reconcile_owner_batches",
               owners=len(owner_batches), n=n_msgs):

@@ -35,7 +35,14 @@ from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import timestamp_hashes
 from evolu_tpu.ops.host_parse import parse_packed_timestamps, parse_timestamp_strings
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
-from evolu_tpu.parallel.mesh import OWNERS_AXIS, assign_owners_to_shards, create_mesh, sharding
+from evolu_tpu.parallel.mesh import (
+    OWNERS_AXIS,
+    assign_owners_to_shards,
+    create_mesh,
+    put_sharded,
+    require_single_process,
+    sharding,
+)
 from evolu_tpu.parallel.reconcile import xor_allreduce
 from evolu_tpu.server.relay import RelayStore
 from evolu_tpu.utils.log import log, span
@@ -118,6 +125,7 @@ def deltas_from_columns(
     that were actually inserted). Owners touching any non-canonical row
     are quarantined to the shared host fold (`ts_strings` provides the
     raw strings for it); everyone else rides one sharded dispatch."""
+    require_single_process("engine.deltas_from_columns")
     owners = list(owner_index)
     deltas: Dict[str, Dict[str, int]] = {o: {} for o in owners}
     digest = 0
@@ -180,7 +188,7 @@ def deltas_from_columns(
         pos_by_shard[si] = pos + n
 
     shd = sharding(mesh)
-    args = [jax.device_put(a, shd) for a in (millis, counter, node, valid, oix)]
+    args = [put_sharded(a, shd) for a in (millis, counter, node, valid, oix)]
     # ONE transfer wave for all 6 outputs (ops.to_host_many).
     owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, dev_digest = (
         to_host_many(*_compiled_merkle_kernel(mesh)(*args))

@@ -1,0 +1,82 @@
+"""Worker process for the multi-process cluster test (not collected by
+pytest — launched by tests/test_multihost_cluster.py).
+
+Joins a jax.distributed cluster (the DCN control-plane leg,
+parallel/multihost.py), then runs the owner-fleet reconcile over the
+GLOBAL mesh: every process builds the same host-side column layout,
+feeds only its addressable shards, and the XOR digest all-reduce makes
+the whole-batch digest visible on every process while each process
+owns only its shards' plans — exactly the multi-host topology
+SURVEY.md §2.15 prescribes.
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+
+# Must run before anything touches the XLA backend.
+from evolu_tpu.parallel.multihost import (  # noqa: E402
+    initialize_multihost,
+    is_multihost,
+    local_owners,
+    local_shard_indices,
+)
+
+mesh = initialize_multihost(f"127.0.0.1:{port}", nproc, pid)
+
+import numpy as np  # noqa: E402
+
+from evolu_tpu.core.merkle import minute_deltas_host  # noqa: E402
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string  # noqa: E402
+from evolu_tpu.core.types import CrdtMessage  # noqa: E402
+from evolu_tpu.ops import to_host  # noqa: E402
+from evolu_tpu.parallel.mesh import assign_owners_to_shards  # noqa: E402
+from evolu_tpu.parallel.reconcile import (  # noqa: E402
+    build_owner_columns,
+    reconcile_columns_sharded,
+)
+
+assert is_multihost(), "expected a >1-process cluster"
+
+BASE = 1_700_000_000_000
+owner_batches = {
+    f"owner{o:02d}": tuple(
+        CrdtMessage(
+            timestamp_to_string(Timestamp(BASE + (o * 997 + i) * 60_000, i % 3, f"{o + 1:016x}")),
+            "todo", f"r{o}-{i}", "title", f"v{i}",
+        )
+        for i in range(10 + o * 3)
+    )
+    for o in range(8)
+}
+
+cols, index, host_owners = build_owner_columns(mesh, owner_batches, {})
+assert not host_owners
+outs = reconcile_columns_sharded(mesh, cols)
+xor_local = to_host(outs[0])  # addressable shards only on this process
+digest = int(np.asarray(outs[-1]))  # replicated via the XOR all-reduce
+
+# Oracle: unique cells + no stored winners => every message XORs; the
+# batch digest is the XOR fold over every owner's timestamps.
+expect_digest = 0
+for msgs in owner_batches.values():
+    _, d = minute_deltas_host(m.timestamp for m in msgs)
+    expect_digest ^= d
+assert digest == expect_digest, (digest, expect_digest)
+
+# This process's shards hold exactly its owners' messages (pad rows
+# are masked by the kernel).
+shards = assign_owners_to_shards(
+    {o: len(b) for o, b in owner_batches.items()}, mesh.devices.size
+)
+mine = local_owners(mesh, shards)
+expect_local = sum(len(owner_batches[o]) for o in mine)
+assert int(xor_local.sum()) == expect_local, (int(xor_local.sum()), expect_local)
+
+print(
+    f"proc {pid}: devices={mesh.devices.size} local_shards={local_shard_indices(mesh)} "
+    f"digest=0x{digest & 0xFFFFFFFF:08x} local_msgs={expect_local} OK",
+    flush=True,
+)
