@@ -164,7 +164,20 @@ def _unwrap_payload(packets: List[Tuple[int, bytes]]) -> bytes:
 
 
 def decrypt_symmetric(message: bytes, password: str) -> bytes:
-    """Inverse of `encrypt_symmetric`; verifies the MDC."""
+    """Inverse of `encrypt_symmetric`; verifies the MDC. ANY malformed
+    input raises PgpError (truncated packet grammar otherwise escapes
+    as IndexError/struct.error — found by fuzzing)."""
+    try:
+        return _decrypt_symmetric(message, password)
+    except PgpError:
+        raise
+    except (IndexError, ValueError, struct.error, zlib.error) as e:
+        # ValueError covers the cryptography layer too (e.g. a
+        # truncated legacy-SED body yields an invalid CFB IV size).
+        raise PgpError(f"malformed OpenPGP message: {e}") from e
+
+
+def _decrypt_symmetric(message: bytes, password: str) -> bytes:
     skesk: Optional[bytes] = None
     seipd: Optional[bytes] = None
     sed: Optional[bytes] = None

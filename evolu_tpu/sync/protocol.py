@@ -23,6 +23,7 @@ express.
 
 from __future__ import annotations
 
+import functools
 import struct
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple, Union
@@ -30,6 +31,25 @@ from typing import List, Optional, Tuple, Union
 from evolu_tpu.core.types import CrdtValue
 
 _INT32_MIN, _INT32_MAX = -(2**31), 2**31 - 1
+
+
+def _wire_decoder(fn):
+    """Typed error contract for the public decoders: ANY malformed
+    input raises ValueError (wire-type mismatches otherwise surface as
+    AttributeError/TypeError from e.g. `int.decode`, found by fuzzing).
+    The relay's handler and the sync client both key off ValueError."""
+
+    @functools.wraps(fn)
+    def wrapper(data: bytes):
+        try:
+            return fn(data)
+        except ValueError:
+            raise
+        except (AttributeError, TypeError, IndexError, OverflowError,
+                struct.error, UnicodeDecodeError) as e:
+            raise ValueError(f"malformed {fn.__name__[7:]} message: {e}") from e
+
+    return wrapper
 
 
 # --- primitive writers ---
@@ -87,6 +107,8 @@ def _read_field(data: bytes, pos: int) -> Tuple[int, int, Union[int, bytes], int
     if wire_type == 0:
         value, pos = _read_varint(data, pos)
     elif wire_type == 1:
+        if pos + 8 > len(data):
+            raise ValueError("truncated fixed64 field")
         value = int.from_bytes(data[pos : pos + 8], "little")
         pos += 8
     elif wire_type == 2:
@@ -96,6 +118,8 @@ def _read_field(data: bytes, pos: int) -> Tuple[int, int, Union[int, bytes], int
             raise ValueError("truncated length-delimited field")
         pos += length
     elif wire_type == 5:
+        if pos + 4 > len(data):
+            raise ValueError("truncated fixed32 field")
         value = int.from_bytes(data[pos : pos + 4], "little")
         pos += 4
     else:
@@ -127,6 +151,7 @@ def encode_content(table: str, row: str, column: str, value: CrdtValue) -> bytes
     return out
 
 
+@_wire_decoder
 def decode_content(data: bytes) -> Tuple[str, str, str, CrdtValue]:
     table = row = column = ""
     value: CrdtValue = None
@@ -165,6 +190,7 @@ def encode_encrypted_message(m: EncryptedCrdtMessage) -> bytes:
     return _string(1, m.timestamp) + _len_delimited(2, m.content)
 
 
+@_wire_decoder
 def decode_encrypted_message(data: bytes) -> EncryptedCrdtMessage:
     timestamp, content = "", b""
     pos = 0
@@ -173,6 +199,11 @@ def decode_encrypted_message(data: bytes) -> EncryptedCrdtMessage:
         if num == 1:
             timestamp = v.decode("utf-8")
         elif num == 2:
+            if wt != 2:
+                # A varint here would make bytes(v) ALLOCATE v zero
+                # bytes — a remote memory-DoS; only length-delimited
+                # content is valid (fuzz finding).
+                raise ValueError(f"content field has wire type {wt}")
             content = bytes(v)
     return EncryptedCrdtMessage(timestamp, content)
 
@@ -199,6 +230,7 @@ def encode_sync_request(r: SyncRequest) -> bytes:
     return out + _string(2, r.user_id) + _string(3, r.node_id) + _string(4, r.merkle_tree)
 
 
+@_wire_decoder
 def decode_sync_request(data: bytes) -> SyncRequest:
     messages: List[EncryptedCrdtMessage] = []
     user_id = node_id = merkle_tree = ""
@@ -221,6 +253,7 @@ def encode_sync_response(r: SyncResponse) -> bytes:
     return out + _string(2, r.merkle_tree)
 
 
+@_wire_decoder
 def decode_sync_response(data: bytes) -> SyncResponse:
     messages: List[EncryptedCrdtMessage] = []
     merkle_tree = ""

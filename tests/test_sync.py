@@ -152,6 +152,56 @@ def test_gpg_golden_ciphertext_decrypts(name):
     )
 
 
+def test_decoder_fuzz_typed_errors():
+    """Malformed wire bytes must raise the typed errors the relay and
+    sync client key off (ValueError / PgpError) — never AttributeError,
+    TypeError or IndexError (all three escaped before the _wire_decoder
+    guard; found by fuzzing)."""
+    import random
+
+    rng = random.Random(5)
+    decoders = (
+        protocol.decode_sync_request,
+        protocol.decode_sync_response,
+        protocol.decode_encrypted_message,
+        protocol.decode_content,
+    )
+    for _ in range(1500):
+        blob = rng.randbytes(rng.randrange(0, 120))
+        for fn in decoders:
+            try:
+                fn(blob)
+            except ValueError:
+                pass  # the contract
+
+    # Truncated fixed-width fields must REJECT, not decode garbage.
+    with pytest.raises(ValueError):
+        protocol.decode_content(b"\x31" + b"\x00\x01\x02")  # doubleValue, 3/8 bytes
+    with pytest.raises(ValueError):
+        protocol.decode_content(b"\x2d" + b"\x00")  # numberValue fixed32, 1/4 bytes
+
+    ct = encrypt_symmetric(b"payload-bytes", "pw")
+    for cut in range(len(ct)):
+        with pytest.raises(PgpError):
+            decrypt_symmetric(ct[:cut], "pw")
+    # Legacy-SED with a short body must be PgpError (cryptography's
+    # invalid-IV ValueError is wrapped), even with a vacuous key check.
+    from evolu_tpu.sync.crypto import _new_packet
+    skesk = ct[:15]  # tag-3 packet: 1 header + 1 len + 13 body bytes
+    assert skesk[0] == 0xC3
+    with pytest.raises(PgpError):
+        decrypt_symmetric(skesk + _new_packet(9, b"\x00" * 10), "pw")
+    for _ in range(800):
+        corrupted = bytearray(ct)
+        corrupted[rng.randrange(len(corrupted))] ^= 1 << rng.randrange(8)
+        if bytes(corrupted) == ct:
+            continue
+        try:
+            decrypt_symmetric(bytes(corrupted), "pw")
+        except PgpError:
+            pass  # the contract (a flip in the literal body may decrypt)
+
+
 def test_gpg_rejects_nothing_we_accept_wrong_password():
     with pytest.raises(PgpError):
         decrypt_symmetric(
