@@ -201,6 +201,53 @@ def test_chunked_on_chunk_failure_fires_cache_resync(tmp_path):
         db.close()
 
 
+def test_chunked_receive_through_worker_with_cache():
+    """Chunked receive with the cache engaged on EVERY chunk
+    (backend="tpu" → threshold 0): chunk N+1's stored winners come from
+    the HBM scatter of chunk N, not a SQLite re-read — end state must
+    equal a cpu-backend whole-batch client, including cross-chunk cell
+    overlap where a later chunk carries an OLDER timestamp for a cell
+    an earlier chunk already won."""
+    from evolu_tpu.core.merkle import merkle_tree_to_string
+    from evolu_tpu.runtime.client import create_evolu
+    from evolu_tpu.storage.clock import read_clock
+    from evolu_tpu.utils.config import Config
+
+    schema = {"todo": ("title", "isCompleted")}
+    chunked = create_evolu(
+        schema, config=Config(backend="tpu", receive_chunk_size=50)
+    )
+    whole = create_evolu(
+        schema, config=Config(backend="cpu", receive_chunk_size=None),
+        mnemonic=chunked.owner.mnemonic,
+    )
+    # 180 messages over 30 cells: chunks overlap cells, and message
+    # order is descending within some cells so later chunks lose.
+    messages = tuple(
+        _mk((37 * i) % 180, node=f"{(i % 7) + 1:016x}", row=f"r{i % 30}")
+        for i in range(180)
+    )
+    try:
+        cache = chunked.worker._planner.cache
+        assert cache is not None
+        for c in (chunked, whole):
+            c.receive(messages, "{}", None)
+            c.worker.flush()
+        assert cache._slots  # engaged across chunks
+        assert (
+            chunked.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+            == whole.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+        )
+        assert (
+            chunked.db.exec('SELECT * FROM "todo" ORDER BY "id"')
+            == whole.db.exec('SELECT * FROM "todo" ORDER BY "id"')
+        )
+        assert merkle_tree_to_string(read_clock(chunked.db).merkle_tree) == \
+            merkle_tree_to_string(read_clock(whole.db).merkle_tree)
+    finally:
+        chunked.dispose(), whole.dispose()
+
+
 def test_transaction_failure_resets_cache():
     """If the transaction rolls back after planning, the cache (already
     scattered forward) must resync — the same message applied again
