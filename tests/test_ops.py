@@ -10,6 +10,7 @@ import numpy as np
 import pytest
 
 jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402 — guarded by the importorskip above
 
 from evolu_tpu.core.merkle import (
     create_initial_merkle_tree,
@@ -100,6 +101,60 @@ class TestDeviceEncode:
         assert sorted(range(len(ts)), key=lambda i: keys[i]) == sorted(
             range(len(ts)), key=lambda i: strings[i]
         )
+
+
+class TestBlockedSegmentedScan:
+    """The blocked two-level scan must be bit-identical to the
+    associative_scan reference for every tiling shape, flag density,
+    direction, and heavy key ties."""
+
+    @pytest.mark.parametrize("n", [64, 128, 256, 1024, 1 << 14])
+    @pytest.mark.parametrize("density", [0.0, 0.03, 0.5, 1.0])
+    def test_matches_reference(self, n, density):
+        import numpy as np
+
+        from evolu_tpu.ops.merge import (
+            _segmented_max_scan,
+            _segmented_max_scan_reference,
+        )
+
+        rng = np.random.default_rng(n * 7 + int(density * 100))
+        with jax.enable_x64(True):
+            flags = rng.random(n) < density
+            flags[0] = True
+            k1 = rng.integers(0, 1 << 60, n).astype(np.uint64)
+            k2 = rng.integers(0, 1 << 60, n).astype(np.uint64)
+            k1[rng.random(n) < 0.3] = k1[0]  # tie stress
+            for reverse in (False, True):
+                f = flags if not reverse else np.append(flags[1:], True)
+                ref = _segmented_max_scan_reference(
+                    jnp.asarray(f), jnp.asarray(k1), jnp.asarray(k2), reverse
+                )
+                new = _segmented_max_scan(
+                    jnp.asarray(f), jnp.asarray(k1), jnp.asarray(k2), reverse
+                )
+                assert np.array_equal(np.asarray(ref[0]), np.asarray(new[0]))
+                assert np.array_equal(np.asarray(ref[1]), np.asarray(new[1]))
+
+    def test_non_tiling_length_falls_back(self):
+        import numpy as np
+
+        from evolu_tpu.ops.merge import (
+            _segmented_max_scan,
+            _segmented_max_scan_reference,
+        )
+
+        with jax.enable_x64(True):
+            n = 300  # not a multiple of the block
+            rng = np.random.default_rng(4)
+            flags = rng.random(n) < 0.1
+            flags[0] = True
+            k1 = rng.integers(0, 1 << 60, n).astype(np.uint64)
+            k2 = rng.integers(0, 1 << 60, n).astype(np.uint64)
+            ref = _segmented_max_scan_reference(jnp.asarray(flags), jnp.asarray(k1), jnp.asarray(k2))
+            new = _segmented_max_scan(jnp.asarray(flags), jnp.asarray(k1), jnp.asarray(k2))
+            assert np.array_equal(np.asarray(ref[0]), np.asarray(new[0]))
+            assert np.array_equal(np.asarray(ref[1]), np.asarray(new[1]))
 
 
 def _random_messages(rng, n, n_cells=10, nodes=None, millis_range=(0, 10**7)):
