@@ -35,7 +35,12 @@ from evolu_tpu.core.types import CrdtClock, CrdtMessage, Owner, SyncError
 from evolu_tpu.runtime import messages as msg
 from evolu_tpu.runtime.jsonpatch import create_patch
 from evolu_tpu.runtime.synclock import SyncLock, get_sync_lock
-from evolu_tpu.storage.apply import apply_messages, apply_messages_chunked, plan_batch
+from evolu_tpu.storage.apply import (
+    _notify_plan_failure,
+    apply_messages,
+    apply_messages_chunked,
+    plan_batch,
+)
 from evolu_tpu.storage.clock import read_clock, update_clock
 from evolu_tpu.storage.schema import delete_all_tables, init_db_model, update_db_schema
 from evolu_tpu.storage.sqlite import PySqliteDatabase
@@ -274,9 +279,40 @@ class DbWorker:
                 else:
                     raise ValueError(f"unknown command: {command!r}")
         except Exception as e:  # noqa: BLE001 - the Either-left channel
-            self.on_output(msg.OnError(e))
+            if isinstance(command, (msg.Send, msg.Receive, msg.ResetOwner, msg.RestoreOwner)):
+                # A planner-touching command's transaction rolled back,
+                # but a stateful planner (the HBM winner cache) may have
+                # advanced at plan time INSIDE it — e.g. apply_messages
+                # succeeds, then the livelock SyncError aborts the whole
+                # receive. Without this resync the cache keeps phantom
+                # winners SQLite never committed: redelivered messages
+                # get xor=False (their hash never enters the Merkle
+                # tree — permanent digest divergence) and beats=False
+                # (app rows never upserted). Found by
+                # tests/test_model_check.py. Idempotent; the inner
+                # apply-level hook may already have fired. Gated to
+                # these commands so e.g. a failed Query cannot wipe a
+                # warm cache.
+                _notify_plan_failure(self._planner)
+            if self._manages_own_transactions(command):
+                # Chunked receive: earlier chunks COMMITTED before the
+                # failure — their staged effects (OnReceive, so query
+                # subscribers re-render the committed rows) must still
+                # fire; dropping them would hide committed state until
+                # some later command happens to emit.
+                self.queries_rows_cache.update(self._staged_cache)
+                self._flush_staged_effects()
+            try:
+                self.on_output(msg.OnError(e))
+            except Exception:  # noqa: BLE001,S110 - a raising error
+                # listener must not kill the worker thread (every later
+                # flush would hang on a dead loop)
+                pass
             return
         self.queries_rows_cache.update(self._staged_cache)
+        self._flush_staged_effects()
+
+    def _flush_staged_effects(self) -> None:
         for effect in self._staged_effects:
             try:
                 effect()

@@ -248,6 +248,69 @@ def test_chunked_receive_through_worker_with_cache():
         chunked.dispose(), whole.dispose()
 
 
+def test_command_level_rollback_resyncs_cache():
+    """The livelock SyncError is raised AFTER apply_messages returns,
+    inside the worker's one-transaction-per-command scope: the command
+    rolls back but the cache already scattered forward. Without the
+    command-boundary resync hook, redelivery sees phantom winners —
+    xor=False forever (hashes never enter the tree) and beats=False
+    (app rows never upserted). Found by tests/test_model_check.py."""
+    from evolu_tpu.core.merkle import (
+        create_initial_merkle_tree,
+        diff_merkle_trees,
+        insert_into_merkle_tree,
+        merkle_tree_to_string,
+    )
+    from evolu_tpu.core.types import SyncError
+    from evolu_tpu.runtime.client import create_evolu
+    from evolu_tpu.storage.clock import read_clock
+    from evolu_tpu.utils.config import Config
+
+    schema = {"todo": ("title",)}
+    hot = create_evolu(schema, config=Config(backend="tpu"))
+    cpu = create_evolu(schema, config=Config(backend="cpu"), mnemonic=hot.owner.mnemonic)
+    msgs = tuple(_mk(i, node="9" * 16, row=f"rl{i}") for i in range(8))
+    try:
+        # Server tree = post-apply local tree + one phantom hash the
+        # client never receives: diff(server, local_after) == phantom's
+        # minute. Passing that minute as previous_diff makes _receive
+        # apply the batch and THEN raise the livelock SyncError.
+        expect_local = create_initial_merkle_tree()
+        for m in msgs:
+            from evolu_tpu.core.timestamp import timestamp_from_string
+
+            expect_local = insert_into_merkle_tree(
+                timestamp_from_string(m.timestamp), expect_local
+            )
+        phantom = Timestamp(BASE + 10**9, 0, "8" * 16)
+        server_tree = insert_into_merkle_tree(phantom, expect_local)
+        prev = diff_merkle_trees(server_tree, expect_local)
+        assert prev is not None
+
+        errors = []
+        hot.subscribe_error(lambda e: errors.append(e))
+        for client in (hot, cpu):
+            client.receive(msgs, merkle_tree_to_string(server_tree), prev)
+            client.worker.flush()
+        assert errors and isinstance(errors[-1], SyncError)
+        assert hot.db.exec('SELECT COUNT(*) FROM "__message"') == [(0,)]  # rolled back
+
+        # Redelivery must fully apply on BOTH backends identically.
+        for client in (hot, cpu):
+            client.receive(msgs, "{}", None)
+            client.worker.flush()
+        assert (
+            hot.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+            == cpu.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+        )
+        assert hot.db.exec('SELECT COUNT(*) FROM "todo"') == [(8,)]
+        th = merkle_tree_to_string(read_clock(hot.db).merkle_tree)
+        tc = merkle_tree_to_string(read_clock(cpu.db).merkle_tree)
+        assert th == tc == merkle_tree_to_string(expect_local)
+    finally:
+        hot.dispose(), cpu.dispose()
+
+
 def test_transaction_failure_resets_cache():
     """If the transaction rolls back after planning, the cache (already
     scattered forward) must resync — the same message applied again
