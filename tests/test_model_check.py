@@ -9,6 +9,8 @@ property: total byte-level convergence from arbitrary schedules.
 import random
 import time
 
+import pytest
+
 from evolu_tpu.core.merkle import merkle_tree_to_string
 from evolu_tpu.runtime.client import create_evolu
 from evolu_tpu.server.relay import RelayServer, ShardedRelayStore
@@ -42,8 +44,9 @@ def _converge(replicas, deadline_s=40.0):
     raise AssertionError("replicas did not converge in time")
 
 
-def test_randomized_mixed_backend_schedules_converge():
-    rng = random.Random(1234)
+@pytest.mark.parametrize("seed", [1234, 99, 7])
+def test_randomized_mixed_backend_schedules_converge(seed):
+    rng = random.Random(seed)
     server = RelayServer(ShardedRelayStore(shards=4)).start()
     cfg = lambda **kw: Config(sync_url=server.url, **kw)  # noqa: E731
     a = create_evolu(SCHEMA, config=cfg(backend="tpu"))  # HBM winner cache
