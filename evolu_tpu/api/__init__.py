@@ -9,9 +9,26 @@ package's query builder and model validators.
 """
 
 from evolu_tpu.api import model
-from evolu_tpu.api.query import Fn, QueryBuilder, fn, table
+from evolu_tpu.api.query import (
+    Cond,
+    Fn,
+    QueryBuilder,
+    and_,
+    c,
+    exists,
+    fn,
+    not_,
+    not_exists,
+    or_,
+    ref,
+    table,
+)
 
-__all__ = ["model", "QueryBuilder", "table", "fn", "Fn", "Hooks", "QueryView", "create_hooks"]
+__all__ = [
+    "model", "QueryBuilder", "table", "fn", "Fn",
+    "Cond", "c", "and_", "or_", "not_", "exists", "not_exists", "ref",
+    "Hooks", "QueryView", "create_hooks",
+]
 
 
 def __getattr__(name):

@@ -70,6 +70,10 @@ class fn:
             raise ValueError(f"unsupported function: {name}")
         if ref is None and name != "count":
             raise ValueError(f"{name} requires a column")
+        if ref is None and distinct:
+            # count(distinct *) is invalid SQLite; failing here beats
+            # failing later when the subscribed query first executes.
+            raise ValueError("count(distinct) requires a column")
         return Fn(name, ref, None, distinct)
 
     @staticmethod
@@ -106,6 +110,157 @@ class fn:
 SelectItem = Union[str, Tuple[str, str], Fn]
 
 
+# -- predicate expression trees --
+#
+# The reference exposes the full Kysely read-only expression surface to
+# apps (types.ts:188-280; kysely.ts:12-27): `eb.or([...])`,
+# `eb.and([...])`, `eb.not(...)`, `eb.exists(selectFrom(...))`, and
+# `in`-subqueries. These nodes are the native analog: an immutable tree
+# that `compile()` walks left-to-right so bound-parameter order always
+# matches placeholder order.
+
+
+class Cond:
+    """A predicate node. Combine with `&`, `|`, `~` or the `and_` /
+    `or_` / `not_` helpers."""
+
+    def sql(self, parameters: List[object]) -> str:
+        raise NotImplementedError
+
+    def __and__(self, other: "Cond") -> "Cond":
+        return and_(self, other)
+
+    def __or__(self, other: "Cond") -> "Cond":
+        return or_(self, other)
+
+    def __invert__(self) -> "Cond":
+        return not_(self)
+
+
+@dataclass(frozen=True)
+class Ref:
+    """A column reference used as a comparison RHS — compiles to the
+    quoted identifier, never a bound parameter. The Kysely `whereRef`
+    analog; what makes `exists` subqueries correlated."""
+
+    name: str
+
+
+def ref(name: str) -> Ref:
+    return Ref(name)
+
+
+@dataclass(frozen=True)
+class Comparison(Cond):
+    """Leaf: `target op value`. For `in`, value may be a sequence of
+    bindables or a QueryBuilder (compiled as a subquery); for any op,
+    a `ref(...)` value compares against another column."""
+
+    target: Union[str, Fn]
+    op: str
+    value: object
+
+    def sql(self, parameters: List[object]) -> str:
+        if isinstance(self.target, Fn):
+            # Reusing a selected-and-aliased Fn in having() is the
+            # natural flow; the alias belongs to the select list only.
+            lhs = replace(self.target, alias=None).sql()
+        else:
+            lhs = _quote_ref(self.target)
+        if isinstance(self.value, Ref):
+            return f"{lhs} {self.op} {_quote_ref(self.value.name)}"
+        if self.op == "in":
+            if isinstance(self.value, QueryBuilder):
+                sub_sql, sub_params = self.value.compile()
+                parameters.extend(sub_params)
+                return f"{lhs} in ({sub_sql})"
+            values = list(self.value)  # type: ignore[arg-type]
+            marks = ", ".join("?" for _ in values)
+            parameters.extend(values)
+            return f"{lhs} in ({marks})"
+        if self.op in ("is", "is not") and self.value is None:
+            return f"{lhs} {self.op} null"
+        parameters.append(self.value)
+        return f"{lhs} {self.op} ?"
+
+
+@dataclass(frozen=True)
+class Group(Cond):
+    """`(a AND b AND ...)` / `(a OR b OR ...)` — always parenthesized,
+    so nesting needs no precedence bookkeeping."""
+
+    kind: str  # "and" | "or"
+    terms: Tuple[Cond, ...]
+
+    def sql(self, parameters: List[object]) -> str:
+        inner = f" {self.kind} ".join(t.sql(parameters) for t in self.terms)
+        return f"({inner})"
+
+
+@dataclass(frozen=True)
+class Not(Cond):
+    term: Cond
+
+    def sql(self, parameters: List[object]) -> str:
+        return f"not ({self.term.sql(parameters)})"
+
+
+@dataclass(frozen=True)
+class Exists(Cond):
+    """`exists (SELECT ...)`. The subquery may reference outer-table
+    columns (correlated); refs compile identically either way."""
+
+    query: "QueryBuilder"
+    negate: bool = False
+
+    def sql(self, parameters: List[object]) -> str:
+        sub_sql, sub_params = self.query.compile()
+        parameters.extend(sub_params)
+        keyword = "not exists" if self.negate else "exists"
+        return f"{keyword} ({sub_sql})"
+
+
+def c(target: Union[str, Fn], op: str, value: object = None) -> Comparison:
+    """Leaf constructor: `c("todo.title", "like", "a%")`."""
+    if op.lower() not in _OPS:
+        raise ValueError(f"unsupported operator: {op}")
+    return Comparison(target, op.lower(), value)
+
+
+def _as_cond(term: object) -> Cond:
+    if isinstance(term, Cond):
+        return term
+    if isinstance(term, tuple) and len(term) == 3:
+        return c(*term)
+    raise ValueError(f"not a condition: {term!r}")
+
+
+def and_(*terms: object) -> Cond:
+    """`and_(c(...), or_(...), ("col", "=", v))` — tuples are accepted
+    as comparison shorthand."""
+    if not terms:
+        raise ValueError("and_ requires at least one term")
+    return Group("and", tuple(_as_cond(t) for t in terms))
+
+
+def or_(*terms: object) -> Cond:
+    if not terms:
+        raise ValueError("or_ requires at least one term")
+    return Group("or", tuple(_as_cond(t) for t in terms))
+
+
+def not_(term: object) -> Cond:
+    return Not(_as_cond(term))
+
+
+def exists(query: "QueryBuilder") -> Cond:
+    return Exists(query)
+
+
+def not_exists(query: "QueryBuilder") -> Cond:
+    return Exists(query, negate=True)
+
+
 def _select_sql(item: SelectItem) -> str:
     if isinstance(item, Fn):
         return item.sql()
@@ -122,9 +277,9 @@ class QueryBuilder:
     _table: str
     _columns: Tuple[SelectItem, ...] = ()
     _joins: Tuple[Tuple[str, str, str, str], ...] = ()  # (kind, table, left, right)
-    _wheres: Tuple[Tuple[str, str, object], ...] = ()
+    _wheres: Tuple[Cond, ...] = ()
     _group_by: Tuple[str, ...] = ()
-    _havings: Tuple[Tuple[Union[str, Fn], str, object], ...] = ()
+    _havings: Tuple[Cond, ...] = ()
     _order_by: Tuple[Tuple[str, str], ...] = ()
     _limit: Optional[int] = None
     _offset: Optional[int] = None
@@ -147,10 +302,16 @@ class QueryBuilder:
             self, _joins=self._joins + (("left", other, left_ref, right_ref),)
         )
 
-    def where(self, column: str, op: str, value: object) -> "QueryBuilder":
-        if op.lower() not in _OPS:
-            raise ValueError(f"unsupported operator: {op}")
-        return replace(self, _wheres=self._wheres + ((column, op.lower(), value),))
+    def where(self, column, op: Optional[str] = None, value: object = None) -> "QueryBuilder":
+        """Either the 3-arg comparison form `where("title", "=", x)` or
+        a single expression tree `where(or_(c(...), and_(c(...), ...)))`
+        — the Kysely `where(eb => eb.or([...]))` analog. Multiple
+        `where()` calls AND together, like Kysely."""
+        if op is None:
+            term = _as_cond(column)
+        else:
+            term = c(column, op, value)
+        return replace(self, _wheres=self._wheres + (term,))
 
     def where_is_deleted(self, deleted: bool = False) -> "QueryBuilder":
         """The common soft-delete filter (examples/nextjs/pages/index.tsx
@@ -161,10 +322,12 @@ class QueryBuilder:
     def group_by(self, *refs: str) -> "QueryBuilder":
         return replace(self, _group_by=self._group_by + refs)
 
-    def having(self, target: Union[str, Fn], op: str, value: object) -> "QueryBuilder":
-        if op.lower() not in _OPS:
-            raise ValueError(f"unsupported operator: {op}")
-        return replace(self, _havings=self._havings + ((target, op.lower(), value),))
+    def having(self, target, op: Optional[str] = None, value: object = None) -> "QueryBuilder":
+        if op is None:
+            term = _as_cond(target)
+        else:
+            term = c(target, op, value)
+        return replace(self, _havings=self._havings + (term,))
 
     def order_by(self, column: str, direction: str = "asc") -> "QueryBuilder":
         if direction.lower() not in ("asc", "desc"):
@@ -177,24 +340,6 @@ class QueryBuilder:
     def offset(self, n: int) -> "QueryBuilder":
         return replace(self, _offset=int(n))
 
-    @staticmethod
-    def _condition(target: Union[str, Fn], op: str, value: object, parameters: List[object]) -> str:
-        if isinstance(target, Fn):
-            # Reusing a selected-and-aliased Fn in having() is the
-            # natural flow; the alias belongs to the select list only.
-            lhs = replace(target, alias=None).sql()
-        else:
-            lhs = _quote_ref(target)
-        if op == "in":
-            values = list(value)  # type: ignore[arg-type]
-            marks = ", ".join("?" for _ in values)
-            parameters.extend(values)
-            return f"{lhs} in ({marks})"
-        if op in ("is", "is not") and value is None:
-            return f"{lhs} {op} null"
-        parameters.append(value)
-        return f"{lhs} {op} ?"
-
     def compile(self) -> Tuple[str, List[object]]:
         """→ (sql, parameters), like Kysely's `.compile()`."""
         cols = ", ".join(_select_sql(c) for c in self._columns) if self._columns else "*"
@@ -206,21 +351,13 @@ class QueryBuilder:
             )
         parameters: List[object] = []
         if self._wheres:
-            terms = [
-                self._condition(column, op, value, parameters)
-                for column, op, value in self._wheres
-            ]
-            sql += " WHERE " + " AND ".join(terms)
+            sql += " WHERE " + " AND ".join(t.sql(parameters) for t in self._wheres)
         if self._group_by:
             sql += " GROUP BY " + ", ".join(_quote_ref(r) for r in self._group_by)
         if self._havings:
             if not self._group_by:
                 raise ValueError("having requires group_by")
-            terms = [
-                self._condition(target, op, value, parameters)
-                for target, op, value in self._havings
-            ]
-            sql += " HAVING " + " AND ".join(terms)
+            sql += " HAVING " + " AND ".join(t.sql(parameters) for t in self._havings)
         if self._order_by:
             sql += " ORDER BY " + ", ".join(
                 f"{_quote_ref(c)} {d}" for c, d in self._order_by

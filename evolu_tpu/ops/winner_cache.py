@@ -26,6 +26,11 @@ Coherence contract:
   ordered by numeric keys (reference semantics are raw-string order);
   such batches fall back to the host oracle planner and every touched
   cell is invalidated, mirroring `merge._host_fallback`.
+- A SECOND connection writing the same database (SyncLock contemplates
+  cross-process workers) would silently strand stale winners; every
+  `plan_batch` therefore probes `PRAGMA data_version` — which moves
+  iff another connection changed the file — and resets the cache when
+  it moved. Same-connection applies never move it.
 
 Memory: 16 bytes/cell (two uint64 keys), power-of-two capacity grown by
 doubling — 1M cells = 16 MiB of HBM. Invalidated cells release their
@@ -116,9 +121,30 @@ class DeviceWinnerCache:
         self._free: List[int] = []  # invalidated slots, reused first
         self._next_slot = 0
         self.capacity = capacity
+        # The cache==MAX(timestamp) invariant assumes this worker's
+        # connection observes every apply. SQLite's data_version moves
+        # if and only if ANOTHER connection changed the database — the
+        # cheap per-batch foreign-write probe. Same-connection writes
+        # never move it, so steady-state batches pay one PRAGMA read.
+        self._data_version = self._read_data_version()
         with jax.enable_x64(True):
             self._w1 = jnp.zeros(capacity, jnp.uint64)
             self._w2 = jnp.zeros(capacity, jnp.uint64)
+
+    def _read_data_version(self):
+        try:
+            rows = self._db.exec_sql_query("PRAGMA data_version", ())
+            return next(iter(rows[0].values())) if rows else None
+        except Exception:  # noqa: BLE001 - a backend without PRAGMA
+            # support degrades to the documented single-writer contract
+            return None
+
+    def _drop_if_foreign_write(self) -> None:
+        version = self._read_data_version()
+        if version != self._data_version:
+            self._data_version = version
+            if self._slots or self._free:
+                self.reset()
 
     # -- slot management --
 
@@ -207,6 +233,7 @@ class DeviceWinnerCache:
         n = len(messages)
         if n == 0:
             return PlannedBatch([], [], {}, np.zeros(0, bool))
+        self._drop_if_foreign_write()
         with span("kernel:merge", "winner_cache.plan_batch", n=n):
             millis, counter, node, case_ok = parse_timestamp_strings(
                 [m.timestamp for m in messages], with_case=True

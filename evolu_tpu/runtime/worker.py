@@ -391,14 +391,26 @@ class DbWorker:
                 # mid-sync failure resumes from the last chunk. The HLC
                 # timestamp is already merged over the WHOLE batch above,
                 # matching the reference's clock-then-apply order.
+                receive_staged = False
+
                 def persist(tree_so_far, _applied):
+                    # Stage OnReceive as soon as the FIRST chunk commits:
+                    # a mid-stream ChunkedApplyError flushes staged
+                    # effects, so subscribers re-render the rows earlier
+                    # chunks committed instead of them staying hidden
+                    # until some later command emits.
+                    nonlocal receive_staged
                     update_clock(self.db, CrdtClock(t, tree_so_far))
+                    if not receive_staged:
+                        receive_staged = True
+                        self._emit(msg.OnReceive())
 
                 tree = apply_messages_chunked(
                     self.db, clock.merkle_tree, messages, chunk_size=chunk,
                     planner=self._planner, on_chunk=persist,
                 )
-                # persist() already wrote the final clock with this tree.
+                # persist() already wrote the final clock with this tree
+                # and staged the OnReceive.
                 clock = CrdtClock(t, tree)
             else:
                 tree = apply_messages(
@@ -406,7 +418,7 @@ class DbWorker:
                 )
                 clock = CrdtClock(t, tree)
                 update_clock(self.db, clock)
-            self._emit(msg.OnReceive())
+                self._emit(msg.OnReceive())
 
         server_tree = merkle_tree_from_string(command.merkle_tree)
         diff = diff_merkle_trees(server_tree, clock.merkle_tree)

@@ -131,6 +131,90 @@ def test_query_builder_aggregates_group_by_having():
         table("t").having(fn.count(), ">", 0).compile()  # having without group_by
     with pytest.raises(ValueError):
         fn.sum(None)
+    with pytest.raises(ValueError):
+        fn.count(distinct=True)  # count(distinct *) is invalid SQLite
+
+
+def test_predicate_expression_trees():
+    """OR/AND combinator groups and NOT — the Kysely `eb.or([...])` /
+    `eb.and([...])` / `eb.not(...)` surface (types.ts:188-280)."""
+    from evolu_tpu.api.query import and_, c, not_, or_
+
+    sql, params = (
+        table("todo")
+        .select("id")
+        .where(or_(
+            and_(("isCompleted", "=", 1), ("isDeleted", "is not", 1)),
+            c("title", "like", "urgent%"),
+        ))
+        .compile()
+    )
+    assert sql == (
+        'SELECT "id" FROM "todo" WHERE '
+        '(("isCompleted" = ? and "isDeleted" is not ?) or "title" like ?)'
+    )
+    assert params == [1, 1, "urgent%"]
+
+    # Operator sugar builds the same tree.
+    expr = (c("a", "=", 1) & c("b", "=", 2)) | ~c("c", "is", None)
+    sql2, params2 = table("t").where(expr).compile()
+    assert sql2 == (
+        'SELECT * FROM "t" WHERE (("a" = ? and "b" = ?) or not ("c" is null))'
+    )
+    assert params2 == [1, 2]
+
+    # Chained where() calls still AND with tree terms.
+    sql3, params3 = (
+        table("t").where("x", "=", 1).where(not_(("y", ">", 2))).compile()
+    )
+    assert sql3 == 'SELECT * FROM "t" WHERE "x" = ? AND not ("y" > ?)'
+    assert params3 == [1, 2]
+
+    with pytest.raises(ValueError):
+        or_()
+    with pytest.raises(ValueError):
+        and_("not-a-condition")
+
+
+def test_subqueries_exists_and_in():
+    """`exists(selectFrom(...))` (correlated via ref()) and
+    `in`-subqueries, with bound-parameter order matching placeholder
+    order across the nesting."""
+    from evolu_tpu.api.query import c, exists, not_exists, ref
+
+    sub = (
+        table("todoCategory")
+        .select("id")
+        .where(c("todoCategory.id", "=", ref("todo.categoryId")))
+    )
+    sql, params = table("todo").select("title").where(exists(sub)).compile()
+    assert sql == (
+        'SELECT "title" FROM "todo" WHERE exists ('
+        'SELECT "id" FROM "todoCategory" '
+        'WHERE "todoCategory"."id" = "todo"."categoryId")'
+    )
+    assert params == []
+
+    sql2, _ = table("todo").where(not_exists(sub)).compile()
+    assert 'not exists (' in sql2
+
+    # in-subquery with its own parameter, sandwiched between outer
+    # parameters: order must be left-to-right.
+    inner = table("todoCategory").select("id").where("name", "=", "work")
+    sql3, params3 = (
+        table("todo")
+        .select("title")
+        .where("isDeleted", "is not", 1)
+        .where(c("categoryId", "in", inner))
+        .where("isCompleted", "=", 0)
+        .compile()
+    )
+    assert sql3 == (
+        'SELECT "title" FROM "todo" WHERE "isDeleted" is not ? '
+        'AND "categoryId" in (SELECT "id" FROM "todoCategory" WHERE "name" = ?) '
+        'AND "isCompleted" = ?'
+    )
+    assert params3 == [1, "work", 0]
 
 
 # --- model casts (model.ts:100-112) ---
@@ -559,6 +643,63 @@ def test_joined_reactive_query_drives_query_view():
         hooks.evolu.dispose()
 
 
+def test_predicate_trees_drive_query_view():
+    """An OR-of-ANDs and a correlated-exists as LIVE subscriptions: the
+    compile-only expression tree slots into the reactive runtime with
+    zero runtime changes (the reference compiles Kysely expression
+    trees the same way, kysely.ts:12-27)."""
+    from evolu_tpu.api.hooks import create_hooks
+    from evolu_tpu.api.query import and_, c, exists, or_, ref
+
+    schema = {
+        "todo": ("title", "isCompleted", "categoryId"),
+        "todoCategory": ("name",),
+    }
+    hooks = create_hooks(schema)
+    try:
+        mutate = hooks.use_mutation()
+        work = mutate("todoCategory", {"name": "work"})
+        mutate("todo", {"title": "urgent: ship", "categoryId": None})
+        done = mutate("todo", {"title": "rest", "isCompleted": True})
+        mutate("todo", {"title": "idle"})
+
+        flagged = hooks.use_query(
+            lambda t: t("todo")
+            .select("title")
+            .where(or_(
+                and_(c("isCompleted", "=", 1), c("isDeleted", "is not", 1)),
+                c("title", "like", "urgent%"),
+            ))
+            .order_by("title")
+        )
+        categorized = hooks.use_query(
+            lambda t: t("todo")
+            .select("title")
+            .where(exists(
+                table("todoCategory")
+                .select("id")
+                .where(c("todoCategory.id", "=", ref("todo.categoryId")))
+            ))
+            .order_by("title")
+        )
+        hooks.evolu.worker.flush()
+        assert [r["title"] for r in flagged.rows] == ["rest", "urgent: ship"]
+        assert categorized.rows == []
+
+        # Mutations re-run both: un-complete one, categorize another.
+        changes = []
+        flagged.subscribe(lambda: changes.append(True))
+        mutate("todo", {"id": done, "isCompleted": False})
+        mutate("todo", {"id": done, "categoryId": work})
+        hooks.evolu.worker.flush()
+        assert changes
+        assert [r["title"] for r in flagged.rows] == ["urgent: ship"]
+        assert [r["title"] for r in categorized.rows] == ["rest"]
+        flagged.dispose(), categorized.dispose()
+    finally:
+        hooks.evolu.dispose()
+
+
 def test_model_email_and_url_brands():
     from evolu_tpu.core.types import ValidationError
 
@@ -656,5 +797,45 @@ def test_huge_receive_mid_failure_keeps_committed_chunks_coherent():
         for (ts,) in stored:
             expect = insert_into_merkle_tree(timestamp_from_string(ts), expect)
         assert merkle_tree_to_string(clock.merkle_tree) == merkle_tree_to_string(expect)
+    finally:
+        evolu.dispose()
+
+
+def test_huge_receive_mid_failure_still_renders_committed_chunks():
+    """OnReceive is staged per committed chunk, so a mid-stream failure
+    still re-renders subscribers with the rows earlier chunks committed
+    — they must not stay hidden until some later command emits."""
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.core.types import CrdtMessage
+    from evolu_tpu.utils.config import Config
+
+    base = 1_700_000_000_000
+    good = [
+        CrdtMessage(
+            timestamp_to_string(Timestamp(base + i, 0, "b" * 16)),
+            "todo", f"r{i}", "title", f"v{i}",
+        )
+        for i in range(100)
+    ]
+    poisoned = good + [
+        CrdtMessage(
+            timestamp_to_string(Timestamp(base + 200, 0, "b" * 16)),
+            "no_such_table", "rx", "title", "x",
+        )
+    ]
+
+    evolu = create_evolu(TODO_SCHEMA, config=Config(receive_chunk_size=40))
+    try:
+        q = table("todo").select("title").order_by("title").serialize()
+        evolu.subscribe_query(q)
+        evolu.worker.flush()
+        errors = []
+        evolu.subscribe_error(errors.append)
+        evolu.receive(tuple(poisoned), "{}", None)
+        evolu.worker.flush()
+        assert errors, "poisoned batch must surface an error"
+        evolu.worker.flush()  # OnReceive posts a follow-up Query command
+        # 80 rows committed by the first two chunks are VISIBLE now.
+        assert len(evolu.get_query_rows(q)) == 80
     finally:
         evolu.dispose()

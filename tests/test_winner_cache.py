@@ -340,3 +340,41 @@ def test_transaction_failure_resets_cache():
     finally:
         db.apply_planned = real_apply
         db.close()
+
+
+def test_foreign_write_resets_cache(tmp_path):
+    """A SECOND connection writing the same database file moves SQLite's
+    data_version; the next plan_batch must drop the cache and re-seed
+    from SQLite instead of serving a stale winner (advisor r2: a foreign
+    apply could otherwise upsert losers over newer committed winners)."""
+    path = str(tmp_path / "shared.db")
+    db = open_database(path, "auto")
+    init_db_model(db, mnemonic=None)
+    db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title" BLOB, "done" BLOB)')
+    cache = DeviceWinnerCache(db)
+    try:
+        tree = apply_messages(db, {}, (_mk(5, row="rF"),), planner=cache.plan_batch)
+        assert ("todo", "rF", "title") in cache._slots
+
+        # A foreign connection commits a NEWER winner for the same cell,
+        # bypassing this worker (and so the cache) entirely.
+        foreign = open_database(path, "auto")
+        newer = CrdtMessage(
+            timestamp_to_string(Timestamp(BASE + 10**9, 0, "f" * 16)),
+            "todo", "rF", "title", "FOREIGN",
+        )
+        apply_messages(foreign, {}, (newer,))
+        foreign.close()
+
+        # An older-than-foreign (but newer-than-local) message must LOSE:
+        # with a stale cache it would have won and clobbered "FOREIGN".
+        loser = CrdtMessage(
+            timestamp_to_string(Timestamp(BASE + 10**6, 0, "c" * 16)),
+            "todo", "rF", "title", "LOSER",
+        )
+        apply_messages(db, tree, (loser,), planner=cache.plan_batch)
+        assert db.exec_sql_query(
+            'SELECT "title" FROM "todo" WHERE "id" = ?', ("rF",)
+        ) == [{"title": "FOREIGN"}]
+    finally:
+        db.close()
