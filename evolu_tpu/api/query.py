@@ -220,10 +220,18 @@ class Exists(Cond):
         return f"{keyword} ({sub_sql})"
 
 
-def c(target: Union[str, Fn], op: str, value: object = None) -> Comparison:
+# Distinguishes "argument omitted" from an explicit None (NULL bind):
+# a forgotten value must fail at build time, not compile to `x = NULL`
+# (never true in SQLite — a silently empty subscribed query).
+_MISSING = object()
+
+
+def c(target: Union[str, Fn], op: str, value: object = _MISSING) -> Comparison:
     """Leaf constructor: `c("todo.title", "like", "a%")`."""
     if op.lower() not in _OPS:
         raise ValueError(f"unsupported operator: {op}")
+    if value is _MISSING:
+        raise ValueError(f"comparison {target!r} {op!r} is missing its value")
     return Comparison(target, op.lower(), value)
 
 
@@ -302,7 +310,7 @@ class QueryBuilder:
             self, _joins=self._joins + (("left", other, left_ref, right_ref),)
         )
 
-    def where(self, column, op: Optional[str] = None, value: object = None) -> "QueryBuilder":
+    def where(self, column, op: Optional[str] = None, value: object = _MISSING) -> "QueryBuilder":
         """Either the 3-arg comparison form `where("title", "=", x)` or
         a single expression tree `where(or_(c(...), and_(c(...), ...)))`
         — the Kysely `where(eb => eb.or([...]))` analog. Multiple
@@ -322,7 +330,7 @@ class QueryBuilder:
     def group_by(self, *refs: str) -> "QueryBuilder":
         return replace(self, _group_by=self._group_by + refs)
 
-    def having(self, target, op: Optional[str] = None, value: object = None) -> "QueryBuilder":
+    def having(self, target, op: Optional[str] = None, value: object = _MISSING) -> "QueryBuilder":
         if op is None:
             term = _as_cond(target)
         else:

@@ -174,6 +174,15 @@ def test_predicate_expression_trees():
         or_()
     with pytest.raises(ValueError):
         and_("not-a-condition")
+    # A forgotten value must fail at build time, not bind NULL (which
+    # would make the subscribed query silently empty).
+    with pytest.raises(ValueError):
+        table("t").where("isCompleted", "=")
+    with pytest.raises(ValueError):
+        c("col", "in")
+    # ...while an EXPLICIT None still compiles to a null comparison.
+    sql_null, _ = table("t").where("x", "is", None).compile()
+    assert sql_null.endswith('"x" is null')
 
 
 def test_subqueries_exists_and_in():
