@@ -59,6 +59,7 @@ class Evolu:
         self._listeners: List[Callable[[], None]] = []
         self._error: Optional[Exception] = None
         self._error_listeners: List[Callable[[Exception], None]] = []
+        self._reconnect_listeners: List[Callable[[], None]] = []
         self._on_completes: Dict[str, Callable[[], None]] = {}  # by id (db.ts:70-82)
         # Batching state is thread-local: a batch open on one thread must
         # not capture (or, if aborted, discard) another thread's mutations.
@@ -327,6 +328,33 @@ class Evolu:
             self.worker.post(msg.Query(queries))
         if self._on_reload is not None:
             self._on_reload()
+
+    # -- reconnect (the `online` event analog, db.ts:390-412) --
+
+    def subscribe_reconnect(self, listener: Callable[[], None]):
+        """Fires when the sync transport transitions offline → online
+        (first successful probe or round after swallowed fetch errors).
+        The transport has already scheduled the immediate pull round;
+        this is the app-facing hook."""
+        with self._lock:
+            self._reconnect_listeners.append(listener)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                if listener in self._reconnect_listeners:
+                    self._reconnect_listeners.remove(listener)
+
+        return unsubscribe
+
+    def _fire_reconnect(self) -> None:
+        with self._lock:
+            listeners = list(self._reconnect_listeners)
+        for fn in listeners:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001,S110 - a raising listener
+                # must not block the reconnect sync
+                pass
 
     # -- errors (error.ts:8-22) --
 

@@ -330,6 +330,15 @@ class DbWorker:
 
         One wall-clock sample per command, like the reference's
         per-command TimeEnv (types.ts:303-309)."""
+        # Refuse wire-unencodable values BEFORE they enter the log (the
+        # whole command rolls back and surfaces as OnError): a committed
+        # value the encoder cannot express (bytes always; float/int64 in
+        # strict mode) would wedge every later resend batch permanently.
+        # Remote messages are exempt — a replica relays what it received.
+        from evolu_tpu.sync.protocol import assert_wire_encodable
+
+        for m in command.messages:
+            assert_wire_encodable(m.value, self.config.wire_extensions)
         clock = read_clock(self.db)
         t = clock.timestamp
         now = self.now()

@@ -32,7 +32,13 @@ from evolu_tpu.utils.log import log
 
 def encrypt_messages(messages, mnemonic: str):
     """sync.worker.ts:50-91 — per-message protobuf-encode + encrypt;
-    the timestamp stays plaintext (the relay orders and diffs by it)."""
+    the timestamp stays plaintext (the relay orders and diffs by it).
+    The transport always encodes with extensions allowed: the wire gate
+    (incl. strict interop, Config.wire_extensions=False) is enforced at
+    MUTATION time (worker._send), so anything in the log is either
+    authored encodable or arrived from a remote peer — and a relay must
+    forward remote messages verbatim, never refuse them (refusing here
+    would wedge anti-entropy resends forever)."""
     out = []
     for m in messages:
         content = protocol.encode_content(m.table, m.row, m.column, m.value)
@@ -68,14 +74,26 @@ class SyncTransport:
         sync_lock: Optional[SyncLock] = None,
         on_error: Optional[Callable[[Exception], None]] = None,
         http_post: Optional[Callable[[str, bytes], bytes]] = None,
+        http_probe: Optional[Callable[[str], None]] = None,
+        on_reconnect: Optional[Callable[[], None]] = None,
     ):
         self.config = config
         self.on_receive = on_receive
         self.sync_lock = sync_lock or SyncLock()
         self.on_error = on_error or (lambda _e: None)
         self._http_post = http_post or _http_post
+        self._http_probe = http_probe or _http_ping
+        self.on_reconnect = on_reconnect or (lambda: None)
         self._queue: "queue.Queue[object]" = queue.Queue()
         self._stop = object()
+        # Reconnect probing state (db.ts:390-412 analog): offline is
+        # entered by a swallowed fetch error, left by the first probe
+        # success or successful round — either fires on_reconnect.
+        self._probe_lock = threading.Lock()
+        self._probe_stop = threading.Event()
+        self._prober: Optional[threading.Thread] = None
+        self._offline = False
+        self._pending_reconnect = False  # transport-thread only
         self._thread = threading.Thread(target=self._loop, daemon=True, name="evolu-sync")
         self._thread.start()
 
@@ -83,8 +101,101 @@ class SyncTransport:
         self._queue.put(request)
 
     def stop(self) -> None:
+        self._probe_stop.set()
+        with self._probe_lock:
+            prober = self._prober
+        if prober is not None and prober is not threading.current_thread():
+            # Bounded: the prober may be mid-GET with a 5s socket
+            # timeout; it is a daemon thread that only touches the
+            # network, so don't stall dispose() on it.
+            prober.join(timeout=0.2)
         self._queue.put(self._stop)
         self._thread.join()
+
+    # -- offline → online transitions --
+
+    def _note_offline(self) -> None:
+        """A fetch error was swallowed: start probing GET /ping until
+        the transport comes back (unless probing is disabled)."""
+        interval = self.config.reconnect_probe_interval
+        with self._probe_lock:
+            self._offline = True
+            if interval is None or self._probe_stop.is_set():
+                return
+            if self._prober is not None and self._prober.is_alive():
+                return
+            self._prober = threading.Thread(
+                target=self._probe_loop, args=(interval,),
+                daemon=True, name="evolu-sync-probe",
+            )
+            self._prober.start()
+
+    def _probe_loop(self, interval: float) -> None:
+        ping_url = _ping_url(self.config.sync_url)
+        delay = interval
+        try:
+            while not self._probe_stop.wait(delay):
+                with self._probe_lock:
+                    if not self._offline:
+                        return  # a successful round beat the probe
+                try:
+                    self._http_probe(ping_url)
+                except urllib.error.HTTPError:
+                    # The server ANSWERED (e.g. /ping 404s behind a
+                    # path-prefixed deployment): the transport is up —
+                    # same classification as _sync_round's.
+                    pass
+                except Exception:  # noqa: BLE001 - still offline; back
+                    # off so an hours-long outage doesn't hammer 1/s
+                    delay = min(delay * 2, max(30.0, interval))
+                    continue
+                self._came_back()
+                # Back off after a reconnect attempt too: if /ping
+                # succeeds but the sync POST keeps failing (POST-only
+                # firewall, MTU blackhole), each probe success fires a
+                # doomed round — without this the cycle storms at
+                # `interval` forever. A true recovery exits at the next
+                # _offline check; the next outage gets a fresh prober
+                # starting at `interval` again.
+                delay = min(delay * 2, max(30.0, interval))
+                # Do NOT return: loop back to the _offline check — a
+                # network flap may already have re-marked us offline,
+                # and exiting here while _note_offline still saw this
+                # thread alive would leave NO prober running.
+        finally:
+            # Closes the remaining flap window: if offline was re-set
+            # between our last check and this exit, restart a fresh
+            # prober (suppressed during stop()).
+            with self._probe_lock:
+                self._prober = None
+                restart = self._offline and not self._probe_stop.is_set()
+            if restart:
+                self._note_offline()
+
+    def _note_online(self) -> None:
+        """A round succeeded (or the server answered an error — either
+        way the transport is up); if we were offline this IS the
+        reconnect. Firing is deferred to the loop, after the sync lock
+        is released (see _loop)."""
+        with self._probe_lock:
+            was_offline = self._offline
+            self._offline = False
+        if was_offline:
+            self._pending_reconnect = True
+
+    def _came_back(self) -> None:
+        with self._probe_lock:
+            if not self._offline:
+                return
+            self._offline = False
+        self._fire_reconnect()
+
+    def _fire_reconnect(self) -> None:
+        log("sync:reconnect")
+        try:
+            self.on_reconnect()
+        except Exception as e:  # noqa: BLE001 - hook must not kill transport
+            self.on_error(UnknownError(e))
 
     def flush(self) -> None:
         done = threading.Event()
@@ -100,9 +211,27 @@ class SyncTransport:
                 item.set()
                 continue
             with self.sync_lock.hold():
-                self._sync_round(item)
+                received = self._sync_round(item)
+            # Everything below runs with the sync lock RELEASED. The
+            # worker's _receive skips its anti-entropy resend while the
+            # lock is pending/held — handing it the response under the
+            # lock would race that gate and silently drop the resend
+            # (observed: an offline-born mutation never pushed after
+            # reconnect). Same for the reconnect hook's pull round.
+            if received is not None:
+                try:
+                    self.on_receive(*received)
+                except Exception as e:  # noqa: BLE001
+                    self.on_error(UnknownError(e))
+            if self._pending_reconnect:
+                self._pending_reconnect = False
+                self._fire_reconnect()
 
-    def _sync_round(self, request: SyncRequestInput) -> None:
+    def _sync_round(self, request: SyncRequestInput):
+        """One encrypt→POST→decrypt round under the sync lock. Returns
+        the decoded (messages, merkle_tree, previous_diff) for the
+        caller to hand to on_receive AFTER releasing the lock, or None
+        when there is nothing to receive."""
         try:
             encrypted = encrypt_messages(request.messages, request.owner.mnemonic)
             node_id = timestamp_from_string(request.clock_timestamp).node
@@ -111,25 +240,32 @@ class SyncTransport:
             )
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))
-            return
+            return None
         log("sync:request", url=self.config.sync_url,
             messages=len(request.messages), bytes=len(body))
         try:
             response_bytes = self._http_post(self.config.sync_url, body)
         except urllib.error.HTTPError as e:
             # The server answered: that's a real error (4xx/5xx), not
-            # offline — surface it so divergence isn't silent.
+            # offline — surface it so divergence isn't silent. The
+            # transport is demonstrably UP, so clear any offline state.
+            self._note_online()
             self.on_error(UnknownError(e))
-            return
+            return None
         except (urllib.error.URLError, OSError):
-            return  # offline is not an error (sync.worker.ts:217-227)
+            # Offline is not an error (sync.worker.ts:217-227) — but it
+            # arms the reconnect probe.
+            self._note_offline()
+            return None
+        self._note_online()
         try:
             response = protocol.decode_sync_response(response_bytes)
             messages = decrypt_messages(response.messages, request.owner.mnemonic)
             log("sync:response", messages=len(messages), bytes=len(response_bytes))
-            self.on_receive(messages, response.merkle_tree, request.previous_diff)
+            return (messages, response.merkle_tree, request.previous_diff)
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))
+            return None
 
 
 def _http_post(url: str, body: bytes) -> bytes:
@@ -138,6 +274,21 @@ def _http_post(url: str, body: bytes) -> bytes:
     )
     with urllib.request.urlopen(req, timeout=30) as resp:
         return resp.read()
+
+
+def _ping_url(sync_url: str) -> str:
+    """The relay's health endpoint (index.ts:250-252) lives at /ping on
+    the same origin as the sync POST endpoint."""
+    from urllib.parse import urlsplit, urlunsplit
+
+    parts = urlsplit(sync_url)
+    return urlunsplit((parts.scheme, parts.netloc, "/ping", "", ""))
+
+
+def _http_ping(url: str) -> None:
+    """One cheap GET — raises while offline, returns once reachable."""
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        resp.read()
 
 
 class PeriodicSyncer:
@@ -171,11 +322,20 @@ def connect(evolu, config: Optional[Config] = None) -> SyncTransport:
     When the config sets `sync_interval`, a periodic pull starts too
     (stopped by `evolu.dispose()`)."""
     cfg = config or evolu.config
+
+    def on_reconnect():
+        # The reference's online listener re-syncs immediately
+        # (db.ts:390-412); app listeners (the `online` event analog)
+        # fire first so they observe the transition itself.
+        evolu._fire_reconnect()
+        evolu.sync(refresh_queries=False)
+
     transport = SyncTransport(
         cfg,
         on_receive=evolu.receive,
         sync_lock=evolu.worker.sync_lock,
         on_error=lambda e: evolu._dispatch_output(OnError(e)),
+        on_reconnect=on_reconnect,
     )
     evolu.attach_transport(transport)
     prev = getattr(evolu, "_auto_syncer", None)

@@ -13,12 +13,18 @@ framework's relay and vice versa):
 This module implements exactly the proto3 subset those messages need
 (varint, length-delimited, 64-bit) with no codegen dependency.
 
-Float values: the reference's value oneof is string|int32; floats only
-survive its lax TS encoder. Here non-integer numbers travel in an
-extension field `doubleValue=6` (wire type I64) — lossless between
-evolu_tpu peers; a reference TS client skips the unknown field and
+Float values: the reference's value oneof is string|int32
+(protobuf.proto:5-13); floats only survive its lax TS encoder. Here
+non-integer numbers travel in an extension field `doubleValue=6` (wire
+type I64) and 64-bit ints in `int64Value=7` — lossless between
+evolu_tpu peers; a reference TS client skips the unknown fields and
 sees null, which is the honest reading of a value its schema cannot
-express.
+express. When an owner is shared with reference TS peers that silent
+drop is itself the hazard, so `encode_content(extensions=False)` —
+`Config.wire_extensions = False` — refuses such values at encode time
+instead (strict interop mode: everything that leaves the client is
+expressible in the reference schema, and reference-range traffic is
+byte-identical either way, pinned by the protoc fixture).
 """
 
 from __future__ import annotations
@@ -130,7 +136,9 @@ def _read_field(data: bytes, pos: int) -> Tuple[int, int, Union[int, bytes], int
 # --- CrdtMessageContent (proto:5-13) ---
 
 
-def encode_content(table: str, row: str, column: str, value: CrdtValue) -> bytes:
+def encode_content(
+    table: str, row: str, column: str, value: CrdtValue, *, extensions: bool = True
+) -> bytes:
     out = _string(1, table) + _string(2, row) + _string(3, column)
     if value is None:
         pass  # oneofKind undefined → no value field (sync.worker.ts:40-48)
@@ -143,12 +151,44 @@ def encode_content(table: str, row: str, column: str, value: CrdtValue) -> bytes
     elif isinstance(value, int):
         if not -(2**63) <= value < 2**63:
             raise TypeError(f"integer exceeds int64: {value!r}")
+        if not extensions:
+            raise TypeError(
+                f"integer exceeds the reference's int32 value schema: {value!r} "
+                "(strict interop mode — a reference peer would silently drop "
+                "field 7; set Config.wire_extensions=True to allow it)"
+            )
         out += _tag(7, 0) + _varint(value)  # int64 extension — exact
     elif isinstance(value, float):
+        if not extensions:
+            raise TypeError(
+                f"float is outside the reference's string|int32 value schema: "
+                f"{value!r} (strict interop mode — a reference peer would "
+                "silently drop field 6; set Config.wire_extensions=True, or "
+                "store it as a string)"
+            )
         out += _tag(6, 1) + struct.pack("<d", value)
     else:
         raise TypeError(f"unencodable CrdtValue: {value!r}")
     return out
+
+
+def assert_wire_encodable(value: CrdtValue, extensions: bool = True) -> None:
+    """Mutation-time wire gate, applied BEFORE a value enters the local
+    log — enforcing at transport-encode time would be too late: the
+    value would already be committed and every later anti-entropy
+    resend batch containing it would fail to encode, wedging sync for
+    the owner permanently. With extensions, anything `encode_content`
+    can express passes (str|int64|double|bool|None — e.g. bytes never
+    can, SQLite accepts them happily); strict mode
+    (Config.wire_extensions=False) narrows to the reference's
+    string|int32 oneof.
+
+    Implemented BY the encoder (a throwaway encode of the value alone)
+    so gate and encoder can never drift apart — drift would recreate
+    the wedge: a value the gate passed but the encoder later rejects."""
+    if isinstance(value, str):
+        return  # skip encoding arbitrarily large strings just to gate
+    encode_content("", "", "", value, extensions=extensions)
 
 
 @_wire_decoder

@@ -40,6 +40,21 @@ class Config:
     # end-to-end on the config-2 shape (benchmarks/winner_cache.py).
     # Ignored for backend "cpu".
     winner_cache: bool = True
+    # Wire-protocol extension fields 6 (double) / 7 (int64) beyond the
+    # reference's string|int32 value oneof (protobuf.proto:5-13).
+    # False = strict interop: AUTHORING such a value raises at mutation
+    # time (before it enters the log) instead of later producing a
+    # field a reference TS peer would silently drop. Remote messages
+    # always relay verbatim, and reference-range traffic is
+    # byte-identical either way.
+    wire_extensions: bool = True
+    # After a swallowed offline sync failure, probe the relay's
+    # GET /ping starting at this cadence in seconds (backing off 2x per
+    # failure up to 30s); the first success fires the reconnect hook
+    # and an immediate pull round — the headless analog of the
+    # reference's online/focus re-sync listeners (db.ts:390-412).
+    # None disables probing.
+    reconnect_probe_interval: "float | None" = 1.0
 
 
 default_config = Config()

@@ -159,3 +159,79 @@ def test_transaction_rollback_on_mid_batch_failure():
         assert db.exec('SELECT COUNT(*) FROM "__message"') == [(0,)]
         assert db.exec('SELECT COUNT(*) FROM "todo"') == [(0,)]
         db.close()
+
+
+def test_reconnect_probe_fires_immediate_sync(tmp_path):
+    """Partition, mutate (push swallowed), heal — WITHOUT any manual or
+    interval sync, the transport's /ping probe must notice the healed
+    network, fire the app reconnect hook, and run an immediate round
+    that lands the pending state on the relay (the reference re-syncs
+    on online/focus/visibilitychange, db.ts:390-412)."""
+    import time
+
+    from evolu_tpu.runtime.messages import OnError
+
+    server = RelayServer(RelayStore(str(tmp_path / "relay.db"))).start()
+    try:
+        cfg = Config(sync_url=server.url + "/", reconnect_probe_interval=0.05)
+        partitioned = threading.Event()
+        real_post, real_ping = sync_client._http_post, sync_client._http_ping
+
+        def post(url, body):
+            if partitioned.is_set():
+                raise OSError("partitioned")
+            return real_post(url, body)
+
+        def probe(url):
+            if partitioned.is_set():
+                raise OSError("partitioned")
+            real_ping(url)
+
+        a = Evolu(db_path=str(tmp_path / "a.db"), config=cfg)
+        a.update_db_schema({"todo": ("title",)})
+        reconnects = []
+        a.subscribe_reconnect(lambda: reconnects.append(True))
+
+        def on_reconnect():
+            a._fire_reconnect()
+            a.sync(refresh_queries=False)
+
+        ta = sync_client.SyncTransport(
+            cfg, on_receive=a.receive, sync_lock=a.worker.sync_lock,
+            http_post=post, http_probe=probe, on_reconnect=on_reconnect,
+        )
+        a.attach_transport(ta)
+
+        partitioned.set()
+        a.create("todo", {"title": "offline-born"})
+        a.worker.flush()
+        ta.flush()
+        assert not reconnects  # swallowed, still offline
+
+        # Heal. The probe (50ms cadence) must do the rest on its own.
+        partitioned.clear()
+        deadline = time.time() + 10
+        while time.time() < deadline and not reconnects:
+            time.sleep(0.02)
+        assert reconnects, "reconnect hook never fired after heal"
+
+        # The immediate round must push the offline-born mutation: a
+        # fresh replica of the same owner pulls it from the relay.
+        b = Evolu(db_path=str(tmp_path / "b.db"), config=cfg, mnemonic=a.owner.mnemonic)
+        b.update_db_schema({"todo": ("title",)})
+        tb = sync_client.SyncTransport(
+            cfg, on_receive=b.receive, sync_lock=b.worker.sync_lock,
+        )
+        b.attach_transport(tb)
+        deadline = time.time() + 10
+        rows = []
+        while time.time() < deadline:
+            b.sync()
+            b.worker.flush(); tb.flush(); b.worker.flush()
+            rows = b.db.exec('SELECT "title" FROM "todo"')
+            if rows:
+                break
+        assert rows == [("offline-born",)]
+        a.dispose(), b.dispose()
+    finally:
+        server.stop()

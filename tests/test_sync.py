@@ -491,3 +491,71 @@ def test_relay_rejects_oversized_body(tmp_path):
         assert server.store.db.exec('SELECT COUNT(*) FROM "message"') == [(0,)]
     finally:
         server.stop()
+
+
+# --- strict interop mode (Config.wire_extensions=False) ---
+
+
+def test_strict_mode_refuses_extension_values():
+    """With extensions off, values outside the reference's string|int32
+    oneof (protobuf.proto:5-13) refuse at MUTATION time — before they
+    enter the log — so sync can never wedge on an unencodable resend.
+    The encoder primitive enforces the same gate."""
+    for v in (3.25, -1e300, 2**31, -(2**31) - 1, 2**62):
+        with pytest.raises(TypeError):
+            protocol.encode_content("t", "r", "c", v, extensions=False)
+        with pytest.raises(TypeError):
+            protocol.assert_wire_encodable(v, extensions=False)
+    # With extensions, float/int64 pass the gate but bytes (which the
+    # wire can NEVER express, though SQLite stores them happily) and
+    # beyond-int64 ints are refused even in the default mode.
+    for v in (3.25, 2**62, -(2**31) - 1):
+        protocol.assert_wire_encodable(v, extensions=True)
+    for v in (b"blob", 2**64, object()):
+        with pytest.raises(TypeError):
+            protocol.assert_wire_encodable(v, extensions=True)
+
+    evolu = create_evolu(
+        {"todo": ("title", "n")},
+        config=Config(wire_extensions=False, reconnect_probe_interval=None),
+    )
+    try:
+        errors = []
+        evolu.subscribe_error(errors.append)
+        sends = []
+        evolu.worker.post_sync = lambda r: sends.append(r)
+        evolu.create("todo", {"title": "ok", "n": 3.25})
+        evolu.worker.flush()
+        assert errors and "string|int32" in str(errors[0])
+        # The WHOLE command rolled back: no poison in the log, nothing
+        # pushed, and the owner keeps syncing afterwards.
+        assert evolu.db.exec('SELECT count(*) FROM "__message"') == [(0,)]
+        assert not sends
+        evolu.create("todo", {"title": "fine", "n": 7})
+        evolu.worker.flush()
+        assert sends and len(evolu.db.exec('SELECT * FROM "todo"')) == 1
+    finally:
+        evolu.dispose()
+
+
+def test_strict_mode_relays_remote_extension_values_verbatim():
+    """A strict replica that RECEIVED a float from a lax peer must still
+    be able to push it onward (relay semantics): the transport encodes
+    with extensions allowed; strictness gates only local authoring."""
+    from evolu_tpu.core.types import CrdtMessage
+
+    msgs = (CrdtMessage(TS, "todo", "r", "n", 3.25),)
+    encrypted = encrypt_messages(msgs, "any mnemonic")
+    got = decrypt_messages(encrypted, "any mnemonic")
+    assert got[0].value == 3.25
+
+
+def test_strict_mode_reference_range_bytes_identical():
+    """Reference-range traffic must be byte-identical with the flag on
+    or off — strict mode only REJECTS, it never re-encodes. The protoc
+    golden fixture (test_sync_request_golden_fixture) pins this same
+    canonical form."""
+    for v in ("hello", "", "ünïcode ✓", 0, 1, -1, 2**31 - 1, -(2**31), None, True, False):
+        strict = protocol.encode_content("todo", "r1", "c1", v, extensions=False)
+        lax = protocol.encode_content("todo", "r1", "c1", v, extensions=True)
+        assert strict == lax
