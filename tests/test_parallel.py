@@ -396,3 +396,13 @@ def test_multihost_helpers_single_process():
     sizes = {f"o{i}": (i * 37) % 101 + 1 for i in range(10)}
     shards = assign_owners_to_shards(sizes, mesh.devices.size)
     assert sorted(multihost.local_owners(mesh, shards)) == sorted(sizes)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_dryrun_multichip_any_mesh_size(n):
+    """The driver artifact must not be shape-specialized to n=8: the
+    full sharded reconcile step compiles, runs, and digest-matches the
+    host oracle at several mesh sizes (VERDICT r2 weak #7)."""
+    import __graft_entry__ as graft
+
+    graft.dryrun_multichip(n)
