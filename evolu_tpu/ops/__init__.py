@@ -86,6 +86,15 @@ def to_host_many(*xs):
     costs ~7-9× RTT; this brings it down to ~1×. Per-array conversion
     still goes through `to_host` (sharded-aware). Returns a tuple in
     input order; numpy inputs pass through."""
+    return tuple(to_host(x) for x in start_host_transfer(*xs))
+
+
+def start_host_transfer(*xs):
+    """The async-start half of `to_host_many`, for pipelining: begin
+    every device→host copy NOW and return the arrays untouched; a later
+    `to_host_many` on them materializes mostly-finished copies. (Under
+    a tunneled device copy_to_host_async can be a no-op; callers that
+    need REAL overlap there park the blocking pull on a thread.)"""
     for x in xs:
         shards = getattr(x, "addressable_shards", None)
         if shards:
@@ -99,7 +108,7 @@ def to_host_many(*xs):
                 x.copy_to_host_async()
             except AttributeError:
                 pass
-    return tuple(to_host(x) for x in xs)
+    return xs
 
 
 def bucket_size(n: int, multiple: int = 64) -> int:
