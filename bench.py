@@ -98,7 +98,17 @@ def shard_layout(cols, n_dev):
     return out, total
 
 
-INNER_ITERS = 16  # pipeline iterations fused per timed dispatch (amortizes the ~80ms tunnel RTT)
+# Two fused-iteration counts per dispatch. The dispatch round-trip
+# under the axon tunnel is ~107 ms of pure fixed overhead (measured: a
+# fori_loop of trivial body costs the same wall time regardless of
+# iteration count) — dividing one wall time by its iteration count
+# buries that RTT in the per-iteration figure (r2 did exactly this and
+# under-reported the chip by ~2.3×). The SLOPE between two iteration
+# counts cancels the fixed term exactly: per_iter = (t_hi - t_lo) /
+# (ITERS_HI - ITERS_LO). Inputs are perturbed per iteration so XLA
+# cannot CSE, and the checksum carry keeps every iteration live.
+ITERS_LO = 8
+ITERS_HI = 72
 
 
 def main():
@@ -114,39 +124,32 @@ def main():
     shd = sharding(mesh)
     names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
 
-    # Sustained throughput: run INNER_ITERS back-to-back pipeline
-    # iterations inside ONE dispatch (a fori_loop chaining on a checksum,
-    # inputs perturbed per iteration so XLA cannot CSE them away), then
-    # divide. This measures steady-state device throughput the way a
-    # streaming reconcile service sees it, not the per-dispatch host
-    # round-trip (which under the axon tunnel is ~80ms of pure RTT).
     spec = P("owners")
     pad_cell = jnp.int32(0x7FFFFFFF)
 
-    def shard_loop(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
-        def body(i, acc):
-            # Perturb per iteration so XLA cannot CSE iterations: the
-            # HLC tie-break key flips low node bits, and the cell ids
-            # are bijectively relabeled (cells < 2^18, so XOR-ing bits
-            # 18+ keeps groups intact but reshuffles the sort order —
-            # each iteration does real, different data movement).
-            # Padding rows keep the planner's sentinel cell.
-            cid = jnp.where(
-                cell_id == pad_cell, cell_id, cell_id ^ (i << 18).astype(jnp.int32)
-            )
-            outs = _shard_kernel(
-                cid, k1, k2 ^ i.astype(jnp.uint64), ex_k1, ex_k2, owner_ix,
-            )
-            # Fold outputs into the carry so every iteration's pipeline
-            # is live; psum makes the carry replicated across shards.
-            masked = jax.lax.psum(outs[0].astype(jnp.int64).sum(), "owners")
-            return acc + masked + outs[-1].astype(jnp.int64)
+    def make_loop(iters):
+        def shard_loop(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
+            def body(i, acc):
+                # Perturb per iteration so XLA cannot CSE iterations:
+                # the HLC tie-break key flips low node bits, and the
+                # cell ids are bijectively relabeled (cells < 2^18, so
+                # XOR-ing bits 18+ keeps groups intact but reshuffles
+                # the sort order — each iteration does real, different
+                # data movement). Padding rows keep the sentinel cell.
+                cid = jnp.where(
+                    cell_id == pad_cell, cell_id, cell_id ^ (i << 18).astype(jnp.int32)
+                )
+                outs = _shard_kernel(
+                    cid, k1, k2 ^ i.astype(jnp.uint64), ex_k1, ex_k2, owner_ix,
+                )
+                # Fold outputs into the carry so every iteration's
+                # pipeline is live; psum replicates across shards.
+                masked = jax.lax.psum(outs[0].astype(jnp.int64).sum(), "owners")
+                return acc + masked + outs[-1].astype(jnp.int64)
 
-        return jax.lax.fori_loop(0, INNER_ITERS, body, jnp.int64(0))
+            return jax.lax.fori_loop(0, iters, body, jnp.int64(0))
 
-    results = {}
-    with jax.enable_x64(True):
-        looped = jax.jit(
+        return jax.jit(
             shard_map(
                 shard_loop,
                 mesh=mesh,
@@ -155,20 +158,30 @@ def main():
                 check_vma=False,
             )
         )
+
+    results = {}
+    with jax.enable_x64(True):
+        loops = {k: make_loop(k) for k in (ITERS_LO, ITERS_HI)}
         for label, stored in (("empty_store", False), ("stored_winners", True)):
             cols, _ = shard_layout(build_columns(stored_winners=stored), n_dev)
             args = [jax.device_put(cols[k], shd) for k in names]
-            np.asarray(looped(*args))  # compile + warm
-            times = []
-            for _ in range(8):
-                t0 = time.perf_counter()
-                np.asarray(looped(*args))
-                times.append(time.perf_counter() - t0)
-            p50 = statistics.median(times)
+            medians = {}
+            for iters, looped in loops.items():
+                np.asarray(looped(*args))  # compile + warm
+                times = []
+                for _ in range(8):
+                    t0 = time.perf_counter()
+                    np.asarray(looped(*args))
+                    times.append(time.perf_counter() - t0)
+                medians[iters] = statistics.median(times)
+            per_iter = (medians[ITERS_HI] - medians[ITERS_LO]) / (ITERS_HI - ITERS_LO)
+            fixed = medians[ITERS_LO] - ITERS_LO * per_iter
             results[label] = {
-                "per_chip": INNER_ITERS * N / p50 / n_dev,
-                "p50_ms": round(p50 * 1e3, 3),
-                "per_iter_ms": round(p50 * 1e3 / INNER_ITERS, 3),
+                "per_chip": N / per_iter / n_dev,
+                "per_iter_ms": round(per_iter * 1e3, 3),
+                "dispatch_overhead_ms": round(fixed * 1e3, 1),
+                "p50_ms_hi": round(medians[ITERS_HI] * 1e3, 3),
+                "wall_per_chip_hi": round(ITERS_HI * N / medians[ITERS_HI] / n_dev),
             }
 
     # Headline = the stored-winners config: every kernel branch live
@@ -186,7 +199,8 @@ def main():
                     "batch": N,
                     "owners": OWNERS,
                     "devices": n_dev,
-                    "inner_iters": INNER_ITERS,
+                    "iters": [ITERS_LO, ITERS_HI],
+                    "method": "two-point slope (fixed dispatch overhead cancelled)",
                     "stored_winners": True,
                     "rotating_cells": True,
                     "configs": {

@@ -1,0 +1,189 @@
+"""Pallas TPU kernel: single-pass segmented lexicographic max scan.
+
+The LWW planner's wall after the sort is its two segmented scans
+(`merge._segmented_max_scan`). The XLA blocked formulation does
+log2(256) = 8 shifted elementwise passes over the full arrays — every
+pass a round-trip of ~17 bytes/row through HBM. This kernel runs the
+scan in ONE pass over HBM: a sequential grid walks the array in
+blocks; inside a block everything stays in VMEM (7 lane-shift combines
++ a small cross-row scan), and the running carry crosses grid steps in
+SMEM scratch (the TPU grid executes sequentially on a core, so scratch
+persists between steps — the canonical Pallas carry pattern).
+
+TPU Pallas has no 64-bit vectors, so the (k1, k2) uint64 HLC keys ride
+as four uint32 limb planes with a 4-limb lexicographic compare — the
+split/recombine happens in XLA outside the kernel (bit-exact, fused
+into the neighbors).
+
+Same monoid and semantics as `merge._segmented_max_scan_reference`:
+inclusive segmented lex-max; `flags[i]` marks a segment start (segment
+END when reverse=True — the wrapper flips, scans forward, flips back,
+exactly like the XLA paths). Bit-identity is property-pinned in
+tests/test_pallas.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from evolu_tpu.core.types import UnknownError
+
+try:  # pallas is part of jax, but guard exotic builds
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover
+    PALLAS_AVAILABLE = False
+
+_LANES = 128
+_BLOCK_ROWS = 256  # rows per grid step: 256*128 = 32768 elements
+
+
+def _lex_ge(a1h, a1l, a2h, a2l, b1h, b1l, b2h, b2l):
+    """(a1, a2) >= (b1, b2) lexicographically, on u32 limbs."""
+    return (a1h > b1h) | (
+        (a1h == b1h)
+        & (
+            (a1l > b1l)
+            | (
+                (a1l == b1l)
+                & ((a2h > b2h) | ((a2h == b2h) & (a2l >= b2l)))
+            )
+        )
+    )
+
+
+def _comb(left, right):
+    """The segmented lex-max monoid on (flag, 4 key limbs): the operand
+    nearest the scan head (right) wins outright when flagged."""
+    lf, l1h, l1l, l2h, l2l = left
+    rf, r1h, r1l, r2h, r2l = right
+    a_wins = _lex_ge(l1h, l1l, l2h, l2l, r1h, r1l, r2h, r2l)
+
+    def pick(lv, rv):
+        return jnp.where(rf != 0, rv, jnp.where(a_wins, lv, rv))
+
+    return (lf | rf, pick(l1h, r1h), pick(l1l, r1l), pick(l2h, r2h), pick(l2l, r2l))
+
+
+def _scan_kernel(f_ref, k1h_ref, k1l_ref, k2h_ref, k2l_ref,
+                 of_ref, o1h_ref, o1l_ref, o2h_ref, o2l_ref, carry):
+    """One grid step: inclusive segmented scan of a (R, 128) block in
+    row-major element order, seeded by the carry of all prior blocks."""
+    step = pl.program_id(0)
+
+    @pl.when(step == 0)
+    def _init():
+        carry[0] = jnp.uint32(0)  # flag
+        carry[1] = jnp.uint32(0)
+        carry[2] = jnp.uint32(0)
+        carry[3] = jnp.uint32(0)
+        carry[4] = jnp.uint32(0)
+
+    vals = (f_ref[:], k1h_ref[:], k1l_ref[:], k2h_ref[:], k2l_ref[:])
+
+    # 1) In-row inclusive scan along the 128 lanes: log2(128) = 7
+    #    shifted combines; lanes shifted in from the left are masked to
+    #    the monoid identity (flag 0, keys 0).
+    lane = jax.lax.broadcasted_iota(jnp.int32, vals[0].shape, 1)
+    shift = 1
+    while shift < _LANES:
+        shifted = tuple(pltpu.roll(v, shift, 1) for v in vals)
+        edge = lane < shift
+        shifted = tuple(jnp.where(edge, jnp.uint32(0), v) for v in shifted)
+        vals = _comb(shifted, vals)
+        shift *= 2
+
+    # 2) Cross-row scan over the row totals (lane 127 column, (R, 1)):
+    #    log2(R) shifted combines over a tiny column vector.
+    totals = tuple(v[:, _LANES - 1 :] for v in vals)
+    row = jax.lax.broadcasted_iota(jnp.int32, totals[0].shape, 0)
+    shift = 1
+    while shift < _BLOCK_ROWS:
+        shifted = tuple(pltpu.roll(t, shift, 0) for t in totals)
+        edge = row < shift
+        shifted = tuple(jnp.where(edge, jnp.uint32(0), t) for t in shifted)
+        totals = _comb(shifted, totals)
+        shift *= 2
+
+    # 3) Exclusive row carry: rows shift down by one; row 0 takes the
+    #    block carry from scratch, every other row combines it in as
+    #    the left-most operand.
+    prev = tuple(pltpu.roll(t, 1, 0) for t in totals)
+    prev = tuple(jnp.where(row < 1, jnp.uint32(0), t) for t in prev)
+    carry_in = tuple(
+        jnp.full_like(prev[0], carry[i]) for i in range(5)
+    )
+    row_carry = _comb(carry_in, prev)
+
+    # 4) Final combine: out[r, l] = comb(row_carry[r], in_row_scan[r, l]).
+    out = _comb(row_carry, vals)
+    of_ref[:], o1h_ref[:], o1l_ref[:], o2h_ref[:], o2l_ref[:] = out
+
+    # 5) Save this block's inclusive total (carry for the next step):
+    #    comb(carry_in at last row, last row total) = out[last, last].
+    carry[0] = out[0][_BLOCK_ROWS - 1, _LANES - 1]
+    carry[1] = out[1][_BLOCK_ROWS - 1, _LANES - 1]
+    carry[2] = out[2][_BLOCK_ROWS - 1, _LANES - 1]
+    carry[3] = out[3][_BLOCK_ROWS - 1, _LANES - 1]
+    carry[4] = out[4][_BLOCK_ROWS - 1, _LANES - 1]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _scan_blocks(f, k1h, k1l, k2h, k2l, interpret: bool = False):
+    rows = f.shape[0]  # multiple of _BLOCK_ROWS (caller pads)
+    spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
+                        memory_space=pltpu.VMEM)
+    shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.uint32)
+    return pl.pallas_call(
+        _scan_kernel,
+        out_shape=(shape,) * 5,
+        grid=(rows // _BLOCK_ROWS,),
+        in_specs=[spec] * 5,
+        out_specs=(spec,) * 5,
+        scratch_shapes=[pltpu.SMEM((5,), jnp.uint32)],
+        interpret=interpret,
+    )(f, k1h, k1l, k2h, k2l)
+
+
+def segmented_max_scan_pallas(flags, k1, k2, reverse: bool = False,
+                              interpret: bool = False):
+    """Drop-in for `merge._segmented_max_scan`: (N,) bool flags + uint64
+    keys → inclusive segmented lex-max (m1, m2) uint64. Traceable; the
+    u64⇄u32 limb split and padding run in XLA around the kernel."""
+    if not PALLAS_AVAILABLE:
+        raise UnknownError("pallas is unavailable in this jax build")
+    if reverse:
+        o1, o2 = segmented_max_scan_pallas(
+            flags[::-1], k1[::-1], k2[::-1], interpret=interpret
+        )
+        return o1[::-1], o2[::-1]
+    n = flags.shape[0]
+    tile = _BLOCK_ROWS * _LANES
+    padded = -(-max(n, 1) // tile) * tile
+    pad = padded - n
+
+    f = jnp.pad(flags.astype(jnp.uint32), (0, pad))
+    k1 = jnp.asarray(k1, jnp.uint64)
+    k2 = jnp.asarray(k2, jnp.uint64)
+    k1h = jnp.pad((k1 >> jnp.uint64(32)).astype(jnp.uint32), (0, pad))
+    k1l = jnp.pad(k1.astype(jnp.uint32), (0, pad))
+    k2h = jnp.pad((k2 >> jnp.uint64(32)).astype(jnp.uint32), (0, pad))
+    k2l = jnp.pad(k2.astype(jnp.uint32), (0, pad))
+
+    planes = [a.reshape(padded // _LANES, _LANES) for a in (f, k1h, k1l, k2h, k2l)]
+    # The kernel is pure 32-bit; trace it outside the x64 scope so the
+    # grid index map emits i32 (an i64 index map fails TPU compilation).
+    with jax.enable_x64(False):
+        _, m1h, m1l, m2h, m2l = _scan_blocks(*planes, interpret=interpret)
+
+    def join(hi, lo):
+        return (hi.reshape(-1)[:n].astype(jnp.uint64) << jnp.uint64(32)) | lo.reshape(
+            -1
+        )[:n].astype(jnp.uint64)
+
+    return join(m1h, m1l), join(m2h, m2l)

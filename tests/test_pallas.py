@@ -52,3 +52,37 @@ def test_pallas_edge_dates_and_padding():
     got = np.asarray(timestamp_hashes_pallas(millis, counter, node, interpret=True))
     want = np.asarray(timestamp_hashes(millis, counter, node))
     np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_segmented_scan_matches_reference():
+    """The single-pass Pallas segmented lex-max scan must be
+    bit-identical to merge._segmented_max_scan_reference across random
+    segment shapes, forward and reverse, including cross-block
+    segments (N spans several grid steps) and all-zero/sentinel keys."""
+    import jax
+    from evolu_tpu.ops.merge import _segmented_max_scan_reference
+    from evolu_tpu.ops.pallas_scan import segmented_max_scan_pallas
+
+    rng = np.random.default_rng(5)
+    with jax.enable_x64(True):
+        for n in (1, 127, 128, 4096, 70000):
+            flags = rng.random(n) < 0.03
+            flags[0] = True
+            k1 = rng.integers(0, 2**64, n, dtype=np.uint64)
+            k2 = rng.integers(0, 2**64, n, dtype=np.uint64)
+            # Ties in k1 (forces the k2 limb compare) and zero keys.
+            k1[rng.random(n) < 0.3] = np.uint64(42) << np.uint64(32)
+            k1[rng.random(n) < 0.1] = 0
+            k2[rng.random(n) < 0.1] = 0
+            for reverse in (False, True):
+                f = flags if not reverse else np.roll(flags, -1)  # ends
+                exp1, exp2 = _segmented_max_scan_reference(
+                    jax.numpy.asarray(f), jax.numpy.asarray(k1),
+                    jax.numpy.asarray(k2), reverse=reverse,
+                )
+                got1, got2 = segmented_max_scan_pallas(
+                    jax.numpy.asarray(f), jax.numpy.asarray(k1),
+                    jax.numpy.asarray(k2), reverse=reverse, interpret=True,
+                )
+                assert (np.asarray(exp1) == np.asarray(got1)).all(), (n, reverse)
+                assert (np.asarray(exp2) == np.asarray(got2)).all(), (n, reverse)
