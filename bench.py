@@ -142,9 +142,16 @@ def main():
                 outs = _shard_kernel(
                     cid, k1, k2 ^ i.astype(jnp.uint64), ex_k1, ex_k2, owner_ix,
                 )
-                # Fold outputs into the carry so every iteration's
-                # pipeline is live; psum replicates across shards.
-                masked = jax.lax.psum(outs[0].astype(jnp.int64).sum(), "owners")
+                # Fold EVERY output into the carry so no stage of the
+                # pipeline is dead code — consuming only the masks let
+                # XLA DCE the whole Merkle minute-segment stage in
+                # r2/r3 early runs (the digest doesn't depend on it),
+                # silently flattering the number. psum replicates the
+                # carry across shards.
+                local = outs[0].astype(jnp.int64).sum()
+                for o in outs[1:-1]:
+                    local = local + o.astype(jnp.int64).sum()
+                masked = jax.lax.psum(local, "owners")
                 return acc + masked + outs[-1].astype(jnp.int64)
 
             return jax.lax.fori_loop(0, iters, body, jnp.int64(0))

@@ -10,8 +10,9 @@ nodes instead of O(batch × 16).
 
 Device pass: hash timestamps (fully on device, `encode.timestamp_hashes`)
 → minute key with JS `|0` int32 truncation (merkleTree.ts:39) → sort by
-minute → segmented XOR reduce via the prefix-XOR trick (segment XOR =
-prefix[end] ^ prefix[prev_end]).
+minute → ONE inclusive segmented XOR scan (blocked two-level on CPU,
+single-pass Pallas on TPU); at each segment's last row the scan value
+IS the segment's XOR total, the only positions decoders read.
 
 Hashes are uint32 on device; the host converts to JS signed int32 when
 writing trie nodes.
@@ -34,6 +35,59 @@ from evolu_tpu.ops.encode import timestamp_hashes
 
 _SENTINEL_HI = 0x7FFFFFFF  # int32 max: masked rows sort after every real key
 
+_XOR_BLOCK = 256
+
+
+def _seg_xor_combine(left, right):
+    """Segmented XOR monoid on (flag, value): the operand nearest the
+    scan head wins its prefix outright when flagged."""
+    lf, lv = left
+    rf, rv = right
+    return lf | rf, jnp.where(rf, rv, lv ^ rv)
+
+
+def segmented_xor_scan_reference(flags, values_u32):
+    """Inclusive segmented XOR scan via associative_scan — the
+    semantics reference (and the fallback for non-tiling lengths)."""
+    _, out = jax.lax.associative_scan(_seg_xor_combine, (flags, values_u32))
+    return out
+
+
+def segmented_xor_scan(flags, values_u32):
+    """Inclusive segmented XOR scan, blocked two-level formulation
+    (same shape trick as `merge._segmented_max_scan`; the generic
+    associative_scan lowering materializes log-depth concat/slice
+    passes). On TPU at >=1 pallas tile the single-pass Pallas kernel
+    takes over. Bit-identical to the reference (tests/test_ops.py,
+    tests/test_pallas.py)."""
+    from evolu_tpu.ops.merge import _PALLAS_SCAN_MIN, _use_pallas_scan
+
+    n = flags.shape[0]
+    # Pallas first: it pads internally, so it also covers non-tiling
+    # lengths that would otherwise fall back to the slow generic
+    # associative_scan (merge._segmented_max_scan orders it the same
+    # way for the same reason).
+    if n >= _PALLAS_SCAN_MIN and _use_pallas_scan():
+        from evolu_tpu.ops.pallas_scan import segmented_xor_scan_pallas
+
+        return segmented_xor_scan_pallas(flags, values_u32)
+    L = min(_XOR_BLOCK, n)
+    if n == 0 or n % L:
+        return segmented_xor_scan_reference(flags, values_u32)
+    s_f = flags.reshape(-1, L)
+    s = values_u32.reshape(-1, L)
+    shift = 1
+    while shift < L:
+        pf = jnp.pad(s_f[:, :-shift], ((0, 0), (shift, 0)), constant_values=False)
+        pv = jnp.pad(s[:, :-shift], ((0, 0), (shift, 0)))
+        s = jnp.where(s_f, s, pv ^ s)
+        s_f = s_f | pf
+        shift *= 2
+    _, c = jax.lax.associative_scan(_seg_xor_combine, (s_f[:, -1], s[:, -1]))
+    e = jnp.concatenate([jnp.zeros((1,), s.dtype), c[:-1]])
+    out = jnp.where(s_f, s, e[:, None] ^ s)
+    return out.reshape(n)
+
 
 def segment_xor2_core(hi_i32, lo_i32, hashes_u32, valid=None):
     """Sorted segmented-XOR reduce over an (hi, lo) int32 key pair
@@ -42,29 +96,24 @@ def segment_xor2_core(hi_i32, lo_i32, hashes_u32, valid=None):
     Sort rows lexicographically by (hi, lo) — 32-bit keys, so the TPU
     sort never touches emulated 64-bit compares — carrying the hash as
     the only payload (no post-sort gathers). Per distinct key pair,
-    XOR the hashes of its rows. Masked rows must carry hash 0 and
-    hi = _SENTINEL_HI; validity is recovered from the sorted hi key
-    itself rather than riding the sort as a payload. Returns
-    (hi_sorted, lo_sorted, seg_end, seg_xor, valid_sorted), all (N,);
-    rows where seg_end is True give one (key, xor) per distinct key.
-    """
+    XOR the hashes of its rows via ONE segmented XOR scan (the r3
+    rewrite: the previous prefix-xor + running-max + 1M-row-gather
+    formulation cost ~10 ms/1M — two generic associative_scan
+    lowerings plus a gather TPUs serialize). Masked rows must carry
+    hash 0 and hi = _SENTINEL_HI; validity is recovered from the
+    sorted hi key itself rather than riding the sort as a payload.
+    Returns (hi_sorted, lo_sorted, seg_end, seg_xor, valid_sorted),
+    all (N,); rows where seg_end & valid give one (key, xor) per
+    distinct key — seg_xor is the INCLUSIVE segmented scan, so it
+    equals the segment total exactly at those rows (the only positions
+    decoders read)."""
     del valid  # masked rows are identified by the hi sentinel
-    n = hi_i32.shape[0]
     hi_s, lo_s, h_sorted = jax.lax.sort((hi_i32, lo_i32, hashes_u32), num_keys=2)
     valid_sorted = hi_s != jnp.int32(_SENTINEL_HI)
-
-    prefix = jax.lax.associative_scan(jnp.bitwise_xor, h_sorted)
-    seg_end = jnp.concatenate(
-        [(hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1]), jnp.ones((1,), bool)]
-    )
-    # XOR of a segment = prefix at its end ^ prefix at the previous
-    # segment's end. Propagate "index of previous segment end" forward
-    # with a running max (-1 = no previous segment).
-    idx = jnp.arange(n, dtype=jnp.int32)
-    seg_first = jnp.concatenate([jnp.zeros((1,), bool), seg_end[:-1]])
-    prev_end = jax.lax.associative_scan(jnp.maximum, jnp.where(seg_first, idx - 1, -1))
-    prev_end_prefix = jnp.where(prev_end >= 0, prefix[jnp.maximum(prev_end, 0)], jnp.uint32(0))
-    seg_xor = prefix ^ prev_end_prefix
+    key_change = (hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1])
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), key_change])
+    seg_end = jnp.concatenate([key_change, jnp.ones((1,), bool)])
+    seg_xor = segmented_xor_scan(seg_start, h_sorted)
     return hi_s, lo_s, seg_end, seg_xor, valid_sorted
 
 

@@ -70,84 +70,121 @@ def _comb(left, right):
     return (lf | rf, pick(l1h, r1h), pick(l1l, r1l), pick(l2h, r2h), pick(l2l, r2l))
 
 
-def _scan_kernel(f_ref, k1h_ref, k1l_ref, k2h_ref, k2l_ref,
-                 of_ref, o1h_ref, o1l_ref, o2h_ref, o2l_ref, carry):
-    """One grid step: inclusive segmented scan of a (R, 128) block in
-    row-major element order, seeded by the carry of all prior blocks."""
-    step = pl.program_id(0)
-
-    @pl.when(step == 0)
-    def _init():
-        carry[0] = jnp.uint32(0)  # flag
-        carry[1] = jnp.uint32(0)
-        carry[2] = jnp.uint32(0)
-        carry[3] = jnp.uint32(0)
-        carry[4] = jnp.uint32(0)
-
-    vals = (f_ref[:], k1h_ref[:], k1l_ref[:], k2h_ref[:], k2l_ref[:])
-
-    # 1) In-row inclusive scan along the 128 lanes: log2(128) = 7
-    #    shifted combines; lanes shifted in from the left are masked to
-    #    the monoid identity (flag 0, keys 0).
-    lane = jax.lax.broadcasted_iota(jnp.int32, vals[0].shape, 1)
-    shift = 1
-    while shift < _LANES:
-        shifted = tuple(pltpu.roll(v, shift, 1) for v in vals)
-        edge = lane < shift
-        shifted = tuple(jnp.where(edge, jnp.uint32(0), v) for v in shifted)
-        vals = _comb(shifted, vals)
-        shift *= 2
-
-    # 2) Cross-row scan over the row totals (lane 127 column, (R, 1)):
-    #    log2(R) shifted combines over a tiny column vector.
-    totals = tuple(v[:, _LANES - 1 :] for v in vals)
-    row = jax.lax.broadcasted_iota(jnp.int32, totals[0].shape, 0)
-    shift = 1
-    while shift < _BLOCK_ROWS:
-        shifted = tuple(pltpu.roll(t, shift, 0) for t in totals)
-        edge = row < shift
-        shifted = tuple(jnp.where(edge, jnp.uint32(0), t) for t in shifted)
-        totals = _comb(shifted, totals)
-        shift *= 2
-
-    # 3) Exclusive row carry: rows shift down by one; row 0 takes the
-    #    block carry from scratch, every other row combines it in as
-    #    the left-most operand.
-    prev = tuple(pltpu.roll(t, 1, 0) for t in totals)
-    prev = tuple(jnp.where(row < 1, jnp.uint32(0), t) for t in prev)
-    carry_in = tuple(
-        jnp.full_like(prev[0], carry[i]) for i in range(5)
-    )
-    row_carry = _comb(carry_in, prev)
-
-    # 4) Final combine: out[r, l] = comb(row_carry[r], in_row_scan[r, l]).
-    out = _comb(row_carry, vals)
-    of_ref[:], o1h_ref[:], o1l_ref[:], o2h_ref[:], o2l_ref[:] = out
-
-    # 5) Save this block's inclusive total (carry for the next step):
-    #    comb(carry_in at last row, last row total) = out[last, last].
-    carry[0] = out[0][_BLOCK_ROWS - 1, _LANES - 1]
-    carry[1] = out[1][_BLOCK_ROWS - 1, _LANES - 1]
-    carry[2] = out[2][_BLOCK_ROWS - 1, _LANES - 1]
-    carry[3] = out[3][_BLOCK_ROWS - 1, _LANES - 1]
-    carry[4] = out[4][_BLOCK_ROWS - 1, _LANES - 1]
+def _seg_xor(left, right):
+    """Segmented XOR monoid on (flag, value)."""
+    lf, lv = left
+    rf, rv = right
+    return (lf | rf, jnp.where(rf != 0, rv, lv ^ rv))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _scan_blocks(f, k1h, k1l, k2h, k2l, interpret: bool = False):
-    rows = f.shape[0]  # multiple of _BLOCK_ROWS (caller pads)
+def _make_scan_kernel(n_planes: int, combine):
+    """Kernel factory: inclusive segmented scan over `n_planes` u32
+    planes (plane 0 is the segment flag) under `combine`, one grid
+    step per (R, 128) block in row-major element order, carry across
+    the sequential grid in SMEM."""
+
+    def kernel(*refs):
+        in_refs = refs[:n_planes]
+        out_refs = refs[n_planes : 2 * n_planes]
+        carry = refs[2 * n_planes]
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            for i in range(n_planes):
+                carry[i] = jnp.uint32(0)
+
+        vals = tuple(r[:] for r in in_refs)
+
+        # 1) In-row inclusive scan along the 128 lanes: log2(128) = 7
+        #    shifted combines; lanes shifted in from the left are
+        #    masked to the monoid identity (flag 0, values 0).
+        lane = jax.lax.broadcasted_iota(jnp.int32, vals[0].shape, 1)
+        shift = 1
+        while shift < _LANES:
+            shifted = tuple(pltpu.roll(v, shift, 1) for v in vals)
+            edge = lane < shift
+            shifted = tuple(jnp.where(edge, jnp.uint32(0), v) for v in shifted)
+            vals = combine(shifted, vals)
+            shift *= 2
+
+        # 2) Cross-row scan over the row totals (lane 127 column).
+        totals = tuple(v[:, _LANES - 1 :] for v in vals)
+        row = jax.lax.broadcasted_iota(jnp.int32, totals[0].shape, 0)
+        shift = 1
+        while shift < _BLOCK_ROWS:
+            shifted = tuple(pltpu.roll(t, shift, 0) for t in totals)
+            edge = row < shift
+            shifted = tuple(jnp.where(edge, jnp.uint32(0), t) for t in shifted)
+            totals = combine(shifted, totals)
+            shift *= 2
+
+        # 3) Exclusive row carry: rows shift down by one; row 0 takes
+        #    the block carry from scratch, every other row combines it
+        #    in as the left-most operand.
+        prev = tuple(pltpu.roll(t, 1, 0) for t in totals)
+        prev = tuple(jnp.where(row < 1, jnp.uint32(0), t) for t in prev)
+        carry_in = tuple(jnp.full_like(prev[0], carry[i]) for i in range(n_planes))
+        row_carry = combine(carry_in, prev)
+
+        # 4) out[r, l] = combine(row_carry[r], in_row_scan[r, l]).
+        out = combine(row_carry, vals)
+        for o_ref, o in zip(out_refs, out):
+            o_ref[:] = o
+
+        # 5) Save the block's inclusive total as the next step's carry.
+        for i in range(n_planes):
+            carry[i] = out[i][_BLOCK_ROWS - 1, _LANES - 1]
+
+    return kernel
+
+
+_LEX_KERNEL = _make_scan_kernel(5, _comb)
+_XOR_KERNEL = _make_scan_kernel(2, _seg_xor)
+
+
+def _scan_call(kernel, n_planes, planes, interpret):
+    rows = planes[0].shape[0]  # multiple of _BLOCK_ROWS (caller pads)
     spec = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0),
                         memory_space=pltpu.VMEM)
     shape = jax.ShapeDtypeStruct((rows, _LANES), jnp.uint32)
     return pl.pallas_call(
-        _scan_kernel,
-        out_shape=(shape,) * 5,
+        kernel,
+        out_shape=(shape,) * n_planes,
         grid=(rows // _BLOCK_ROWS,),
-        in_specs=[spec] * 5,
-        out_specs=(spec,) * 5,
-        scratch_shapes=[pltpu.SMEM((5,), jnp.uint32)],
+        in_specs=[spec] * n_planes,
+        out_specs=(spec,) * n_planes,
+        scratch_shapes=[pltpu.SMEM((n_planes,), jnp.uint32)],
         interpret=interpret,
-    )(f, k1h, k1l, k2h, k2l)
+    )(*planes)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _scan_blocks(f, k1h, k1l, k2h, k2l, interpret: bool = False):
+    return _scan_call(_LEX_KERNEL, 5, (f, k1h, k1l, k2h, k2l), interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _xor_scan_blocks(f, v, interpret: bool = False):
+    return _scan_call(_XOR_KERNEL, 2, (f, v), interpret)
+
+
+def segmented_xor_scan_pallas(flags, values_u32, interpret: bool = False):
+    """(N,) bool flags (segment starts) + (N,) uint32 → inclusive
+    segmented XOR scan. At each segment's last row the value is the
+    segment's total XOR — the only positions the Merkle decode reads."""
+    if not PALLAS_AVAILABLE:
+        raise UnknownError("pallas is unavailable in this jax build")
+    n = flags.shape[0]
+    tile = _BLOCK_ROWS * _LANES
+    padded = -(-max(n, 1) // tile) * tile
+    pad = padded - n
+    f = jnp.pad(flags.astype(jnp.uint32), (0, pad))
+    v = jnp.pad(jnp.asarray(values_u32, jnp.uint32), (0, pad))
+    planes = [a.reshape(padded // _LANES, _LANES) for a in (f, v)]
+    with jax.enable_x64(False):
+        _, out = _xor_scan_blocks(*planes, interpret=interpret)
+    return out.reshape(-1)[:n]
 
 
 def segmented_max_scan_pallas(flags, k1, k2, reverse: bool = False,

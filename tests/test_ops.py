@@ -444,3 +444,24 @@ def test_vectorized_parse_rejects_year_zero():
         parse_timestamp_strings(["0000-01-01T00:00:00.000Z-0000-" + "a" * 16])
     # Year 0001 is datetime's MINYEAR and must parse.
     parse_timestamp_strings(["0001-01-01T00:00:00.000Z-0000-" + "a" * 16])
+
+
+def test_segmented_xor_scan_matches_reference():
+    """The blocked segmented XOR scan (merkle_ops r3) must be
+    bit-identical to the associative_scan reference across random
+    segment shapes, including non-tiling lengths (fallback path)."""
+    import jax.numpy as jnp
+
+    from evolu_tpu.ops.merkle_ops import (
+        segmented_xor_scan,
+        segmented_xor_scan_reference,
+    )
+
+    rng = np.random.default_rng(9)
+    for n in (1, 255, 256, 4096, 70000):
+        flags = rng.random(n) < 0.05
+        flags[0] = True
+        v = rng.integers(0, 2**32, n, dtype=np.uint32)
+        exp = segmented_xor_scan_reference(jnp.asarray(flags), jnp.asarray(v))
+        got = segmented_xor_scan(jnp.asarray(flags), jnp.asarray(v))
+        assert (np.asarray(exp) == np.asarray(got)).all(), n

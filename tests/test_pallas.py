@@ -86,3 +86,20 @@ def test_pallas_segmented_scan_matches_reference():
                 )
                 assert (np.asarray(exp1) == np.asarray(got1)).all(), (n, reverse)
                 assert (np.asarray(exp2) == np.asarray(got2)).all(), (n, reverse)
+
+
+def test_pallas_segmented_xor_scan_matches_reference():
+    """The single-pass Pallas segmented XOR scan must be bit-identical
+    to the associative_scan reference, including cross-block segments."""
+    import jax
+    from evolu_tpu.ops.merkle_ops import segmented_xor_scan_reference
+    from evolu_tpu.ops.pallas_scan import segmented_xor_scan_pallas
+
+    rng = np.random.default_rng(10)
+    for n in (1, 4096, 70000):
+        flags = rng.random(n) < 0.02
+        flags[0] = True
+        v = rng.integers(0, 2**32, n, dtype=np.uint32)
+        exp = segmented_xor_scan_reference(jax.numpy.asarray(flags), jax.numpy.asarray(v))
+        got = segmented_xor_scan_pallas(jax.numpy.asarray(flags), jax.numpy.asarray(v), interpret=True)
+        assert (np.asarray(exp) == np.asarray(got)).all(), n
