@@ -303,6 +303,29 @@ def select_messages(messages: Sequence[CrdtMessage], mask: np.ndarray) -> List[C
     return list(operator.itemgetter(*ix)(messages))
 
 
+def winner_key_columns(cells, winners: Dict[Tuple[str, str, str], str]):
+    """Per-unique-cell stored-winner key columns: → (ex1_u, ex2_u,
+    canonical), zeros where a cell has no stored winner. The ONE
+    implementation of winner parse/pack/canonical-check — shared by
+    `messages_to_columns`, the HBM cache's lazy seeding, and its
+    streamed mode, so the canonical-case rule (a golden-parity
+    invariant) can never drift between them."""
+    from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+    ex1_u = np.zeros(len(cells), np.uint64)
+    ex2_u = np.zeros(len(cells), np.uint64)
+    winner_cids = [i for i, cell in enumerate(cells) if cell in winners]
+    canonical = True
+    if winner_cids:
+        w_millis, w_counter, w_node, w_case_ok = parse_timestamp_strings(
+            [winners[cells[i]] for i in winner_cids], with_case=True
+        )
+        canonical = bool(w_case_ok.all())
+        ex1_u[winner_cids] = pack_ts_key_host(w_millis, w_counter)
+        ex2_u[winner_cids] = w_node
+    return ex1_u, ex2_u, canonical
+
+
 def messages_to_columns(
     messages: Sequence[CrdtMessage],
     existing_winners: Dict[Tuple[str, str, str], str],
@@ -332,16 +355,8 @@ def messages_to_columns(
     )
 
     # Stored winners per unique cell (parsed as one vectorized batch).
-    winner_cids = [i for i, cell in enumerate(cells) if cell in existing_winners]
-    ex1_u = np.zeros(len(cells), np.uint64)
-    ex2_u = np.zeros(len(cells), np.uint64)
-    if winner_cids:
-        w_millis, w_counter, w_node, w_case_ok = parse_timestamp_strings(
-            [existing_winners[cells[i]] for i in winner_cids], with_case=True
-        )
-        canonical = canonical and bool(w_case_ok.all())
-        ex1_u[winner_cids] = pack_ts_key_host(w_millis, w_counter)
-        ex2_u[winner_cids] = w_node
+    ex1_u, ex2_u, winners_canonical = winner_key_columns(cells, existing_winners)
+    canonical = canonical and winners_canonical
     ex_k1 = ex1_u[cell_ids]
     ex_k2 = ex2_u[cell_ids]
 

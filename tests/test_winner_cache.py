@@ -67,7 +67,7 @@ def test_cache_seeds_from_prepopulated_store():
     db = _db()
     try:
         tree = apply_messages(db, {}, (_mk(50, row="rX"),))
-        cache = DeviceWinnerCache(db)
+        cache = DeviceWinnerCache(db, adaptive=False)  # pins lazy-seed behavior
         older = CrdtMessage(
             timestamp_to_string(Timestamp(BASE + 1, 0, "b" * 16)), "todo", "rX", "title", "OLD"
         )
@@ -123,6 +123,7 @@ def test_production_routing_through_worker():
     try:
         cache = hot.worker._planner.cache
         assert cache is not None and not hot.worker._planner.fetches_winners
+        cache.adaptive = False  # pin cached mode: this test asserts slot state
         messages = tuple(_mk(i, node=f"{(i % 5) + 1:016x}") for i in range(300))
         for c in (hot, cpu):
             c.receive(messages, "{}", None)
@@ -148,7 +149,7 @@ def test_slot_reuse_never_leaks_stale_keys():
     no-winner — not the previous cell's keys, which would wrongly
     suppress the new cell's first upsert."""
     db = _db()
-    cache = DeviceWinnerCache(db)
+    cache = DeviceWinnerCache(db, adaptive=False)  # slot-state test
     try:
         # Occupy a slot with a large winner for cell rA.
         tree = apply_messages(db, {}, (_mk(10**6, row="rA"),), planner=cache.plan_batch)
@@ -180,7 +181,7 @@ def test_chunked_on_chunk_failure_fires_cache_resync(tmp_path):
     from evolu_tpu.storage.apply import ChunkedApplyError, apply_messages_chunked
 
     db = _db()
-    cache = DeviceWinnerCache(db)
+    cache = DeviceWinnerCache(db, adaptive=False)  # scatter-ahead state must exist
     msgs = tuple(_mk(i, row=f"c{i}") for i in range(6))
     try:
         with pytest.raises(ChunkedApplyError):
@@ -230,6 +231,7 @@ def test_chunked_receive_through_worker_with_cache():
     try:
         cache = chunked.worker._planner.cache
         assert cache is not None
+        cache.adaptive = False  # pin the HBM scatter chain this test exercises
         for c in (chunked, whole):
             c.receive(messages, "{}", None)
             c.worker.flush()
@@ -268,6 +270,10 @@ def test_command_level_rollback_resyncs_cache():
 
     schema = {"todo": ("title",)}
     hot = create_evolu(schema, config=Config(backend="tpu"))
+    # Pin the static cached path: the adaptive gate would stream a
+    # fresh cache's first batches and the scatter-ahead state this
+    # regression test exists to exercise would never form.
+    hot.worker._planner.cache.adaptive = False
     cpu = create_evolu(schema, config=Config(backend="cpu"), mnemonic=hot.owner.mnemonic)
     msgs = tuple(_mk(i, node="9" * 16, row=f"rl{i}") for i in range(8))
     try:
@@ -316,7 +322,7 @@ def test_transaction_failure_resets_cache():
     scattered forward) must resync — the same message applied again
     must still XOR/upsert correctly."""
     db = _db()
-    cache = DeviceWinnerCache(db)
+    cache = DeviceWinnerCache(db, adaptive=False)  # scatter-ahead state must exist
     msg = _mk(7, row="rF")
     try:
         real_apply = db.apply_planned
@@ -351,7 +357,7 @@ def test_foreign_write_resets_cache(tmp_path):
     db = open_database(path, "auto")
     init_db_model(db, mnemonic=None)
     db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title" BLOB, "done" BLOB)')
-    cache = DeviceWinnerCache(db)
+    cache = DeviceWinnerCache(db, adaptive=False)  # slot-state test
     try:
         tree = apply_messages(db, {}, (_mk(5, row="rF"),), planner=cache.plan_batch)
         assert ("todo", "rF", "title") in cache._slots
@@ -376,5 +382,66 @@ def test_foreign_write_resets_cache(tmp_path):
         assert db.exec_sql_query(
             'SELECT "title" FROM "todo" WHERE "id" = ?', ("rF",)
         ) == [{"title": "FOREIGN"}]
+    finally:
+        db.close()
+
+
+def test_adaptive_gating_crosses_modes_with_identical_state():
+    """Hysteresis (VERDICT r2 #3): a churn burst (every batch all-new
+    cells) flips the planner to streaming; a steady phase decays the
+    EWMA and warms the cache back up; a second burst flips it again.
+    End state must equal the static streamed planner throughout."""
+    from evolu_tpu.ops.merge import plan_batch_device_full
+
+    rng = np.random.default_rng(21)
+    db_a, db_b = _db(), _db()
+    cache = DeviceWinnerCache(db_b, capacity=64)
+    tree_a, tree_b = {}, {}
+    modes = []
+    try:
+        def batches():
+            # burst: 3 batches of brand-new cells each
+            for b in range(3):
+                yield [_mk(b * 200 + j, row=f"burst{b}_{j % 40}") for j in range(120)]
+            # steady: 5 batches over one fixed population
+            for b in range(5):
+                order = rng.permutation(120)
+                yield [_mk(1000 + b * 40 + int(i), row=f"s{int(i) % 23}") for i in order]
+            # second burst
+            for b in range(3):
+                yield [_mk(3000 + b * 200 + j, row=f"b2_{b}_{j % 40}") for j in range(120)]
+
+        for batch in batches():
+            batch = tuple(batch)
+            tree_a = apply_messages(db_a, tree_a, batch, planner=plan_batch_device_full)
+            tree_b = apply_messages(db_b, tree_b, batch, planner=cache.plan_batch)
+            modes.append(cache._streaming)
+            assert _dump(db_a) == _dump(db_b)
+            assert merkle_tree_to_string(tree_a) == merkle_tree_to_string(tree_b)
+        # Burst 1 must have triggered streaming; the steady phase must
+        # have returned to cached; burst 2 must stream again.
+        assert any(modes[:3]), modes
+        assert not modes[7], modes  # cached again by the end of steady
+        assert any(modes[8:]), modes
+    finally:
+        db_a.close(), db_b.close()
+
+
+def test_disable_adaptive_while_streaming_reseeds_safely():
+    """Flipping adaptive=False on a cache that is ALREADY streaming
+    must fall back to the cached path with a full reseed — not look up
+    previously-streamed cells in the (empty) slot table (regression:
+    KeyError aborting the apply transaction)."""
+    db = _db()
+    cache = DeviceWinnerCache(db)
+    try:
+        first = tuple(_mk(i, row=f"s{i}") for i in range(6))
+        tree = apply_messages(db, {}, first, planner=cache.plan_batch)
+        assert cache._streaming  # fresh cache streams its first batch
+        cache.adaptive = False
+        again = tuple(_mk(100 + i, row=f"s{i}") for i in range(6))
+        apply_messages(db, tree, again, planner=cache.plan_batch)
+        assert not cache._streaming and cache._slots
+        assert db.exec_sql_query('SELECT COUNT(*) AS n FROM "__message"') == [{"n": 12}]
     finally:
         db.close()
