@@ -50,8 +50,12 @@ def _post(url: str, req: protocol.SyncRequest) -> protocol.SyncResponse:
     return protocol.decode_sync_response(r.read())
 
 
-def run(store) -> dict:
-    server = RelayServer(store).start()
+def run(store=None, server=None) -> dict:
+    """Drive 25 concurrent clients against `server` (or an in-process
+    RelayServer over `store`)."""
+    own_server = server is None
+    if own_server:
+        server = RelayServer(store).start()
     latencies: list = []
     lock = threading.Lock()
     barrier = threading.Barrier(CLIENTS)
@@ -83,7 +87,8 @@ def run(store) -> dict:
             t.join()
         wall = time.perf_counter() - t0
     finally:
-        server.stop()
+        if own_server:
+            server.stop()
     if errors:
         raise errors[0]
     latencies.sort()
@@ -98,10 +103,27 @@ def run(store) -> dict:
 
 
 def main() -> None:
+    import tempfile
+
+    from evolu_tpu.server.relay import MultiprocessRelay
+
     results = {
         "single_store": run(RelayStore()),
         "sharded_store": run(ShardedRelayStore(shards=8)),
     }
+    # Pre-forked multiprocess relay (VERDICT r2 #8): N worker processes
+    # on one SO_REUSEPORT port over a shared file-backed WAL store.
+    # On a 1-core host this validates the deployment shape and its
+    # overheads, not scaling — documented as such.
+    for workers in (1, 2, 4):
+        with tempfile.TemporaryDirectory() as tmp:
+            relay = MultiprocessRelay(
+                f"{tmp}/relay.db", workers=workers, shards=8
+            ).start()
+            try:
+                results[f"multiprocess_{workers}w"] = run(server=relay)
+            finally:
+                relay.stop()
     head = results["sharded_store"]
     print(
         json.dumps(

@@ -1,0 +1,18 @@
+"""Worker-process entry point for `relay.MultiprocessRelay`.
+
+Lives in its own module so `python -m evolu_tpu.server.relay_worker`
+does not re-execute relay.py under runpy (which would shadow the
+already-imported module and warn)."""
+
+import sys
+
+from evolu_tpu.server.relay import _mp_worker_main
+
+
+def main() -> None:
+    host, port, path, shards, backend = sys.argv[1:6]
+    _mp_worker_main(host, int(port), path, int(shards), backend)
+
+
+if __name__ == "__main__":
+    main()

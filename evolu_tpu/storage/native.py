@@ -180,6 +180,7 @@ class CppSqliteDatabase:
         self._lock = threading.RLock()
         self._in_txn = False
         self.path = path
+        self._begin_sql = b"BEGIN"
 
     # -- internals --
 
@@ -358,7 +359,7 @@ class CppSqliteDatabase:
             self._check_open()
             if self._in_txn:
                 raise UnknownError("begin inside an open transaction")
-            if self._lib.eh_exec(self._db, b"BEGIN") != 0:
+            if self._lib.eh_exec(self._db, self._begin_sql) != 0:
                 raise self._err()
             self._in_txn = True
 
@@ -385,7 +386,7 @@ class CppSqliteDatabase:
             if self._in_txn:
                 yield self
                 return
-            if self._lib.eh_exec(self._db, b"BEGIN") != 0:
+            if self._lib.eh_exec(self._db, self._begin_sql) != 0:
                 raise self._err()
             self._in_txn = True
             try:
@@ -398,6 +399,12 @@ class CppSqliteDatabase:
                     raise self._err()
             finally:
                 self._in_txn = False
+
+    def set_begin_immediate(self) -> None:
+        """See PySqliteDatabase.set_begin_immediate: cross-process
+        writers must take the write lock at BEGIN (deferred upgrades
+        bypass busy_timeout)."""
+        self._begin_sql = b"BEGIN IMMEDIATE"
 
     def close(self) -> None:
         with self._lock:

@@ -37,6 +37,7 @@ class PySqliteDatabase:
         self._conn.isolation_level = None  # explicit BEGIN/COMMIT
         self._lock = threading.RLock()
         self.path = path
+        self._begin_sql = "BEGIN"
 
     # -- Database interface (types.ts:162-176) --
 
@@ -99,7 +100,7 @@ class PySqliteDatabase:
             if self._conn.in_transaction:
                 yield self
                 return
-            self._conn.execute("BEGIN")
+            self._conn.execute(self._begin_sql)
             try:
                 yield self
             except BaseException:
@@ -107,6 +108,13 @@ class PySqliteDatabase:
                 raise
             else:
                 self._conn.execute("COMMIT")
+
+    def set_begin_immediate(self) -> None:
+        """Writers sharing the database FILE with other processes must
+        take the write lock at BEGIN: a deferred transaction that
+        upgrades to write after a concurrent commit gets SQLITE_BUSY
+        immediately — busy_timeout does not apply to that upgrade."""
+        self._begin_sql = "BEGIN IMMEDIATE"
 
     def close(self) -> None:
         with self._lock:

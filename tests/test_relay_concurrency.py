@@ -148,3 +148,119 @@ def test_single_owner_contention_duplicates_race():
             oracle.close()
     finally:
         server.stop()
+
+
+def test_multiprocess_relay_concurrent_clients_consistent(tmp_path):
+    """Pre-forked relay (2 worker PROCESSES, one SO_REUSEPORT port,
+    shared file-backed WAL store): 12 concurrent clients × 3 rounds
+    land every message exactly once, and each user's stored tree
+    equals a sequential recompute — regardless of which worker served
+    which request (VERDICT r2 #8)."""
+    import threading
+    import urllib.request
+
+    from evolu_tpu.core.merkle import (
+        apply_prefix_xors, create_initial_merkle_tree, merkle_tree_to_string,
+        minute_deltas_host,
+    )
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.server.relay import MultiprocessRelay, ShardedRelayStore
+    from evolu_tpu.sync import protocol
+
+    base = 1_700_000_000_000
+    relay = MultiprocessRelay(str(tmp_path / "relay.db"), workers=2, shards=4).start()
+    errors = []
+    try:
+        def post(req):
+            body = protocol.encode_sync_request(req)
+            with urllib.request.urlopen(
+                urllib.request.Request(
+                    relay.url, data=body,
+                    headers={"Content-Type": "application/octet-stream"},
+                ), timeout=30,
+            ) as r:
+                return protocol.decode_sync_response(r.read())
+
+        def client(i):
+            try:
+                user, node = f"user{i:02d}", f"{i + 1:016x}"
+                for rnd in range(3):
+                    msgs = tuple(
+                        protocol.EncryptedCrdtMessage(
+                            timestamp_to_string(
+                                Timestamp(base + (i * 1000 + rnd * 100 + j) * 1000, 0, node)
+                            ),
+                            b"ct" * 8,
+                        )
+                        for j in range(40)
+                    )
+                    post(protocol.SyncRequest(msgs, user, node, "{}"))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[0]
+    finally:
+        relay.stop()
+
+    # Inspect the shared store directly: exactly once, trees coherent.
+    store = ShardedRelayStore(str(tmp_path / "relay.db"), shards=4)
+    try:
+        for i in range(12):
+            user, node = f"user{i:02d}", f"{i + 1:016x}"
+            shard = store.shard_of(user)
+            rows = shard.db.exec_sql_query(
+                'SELECT "timestamp" FROM "message" WHERE "userId" = ? ORDER BY "timestamp"',
+                (user,),
+            )
+            assert len(rows) == 120, (user, len(rows))
+            deltas, _ = minute_deltas_host(r["timestamp"] for r in rows)
+            expect = apply_prefix_xors(create_initial_merkle_tree(), deltas)
+            assert merkle_tree_to_string(store.get_merkle_tree(user)) == \
+                merkle_tree_to_string(expect), user
+    finally:
+        store.close()
+
+
+def test_clients_converge_through_multiprocess_relay(tmp_path):
+    """Full client sync loops through a 2-worker pre-forked relay:
+    whichever worker the kernel hands each connection to, both
+    replicas converge byte-identically."""
+    import time
+
+    from evolu_tpu.runtime.client import create_evolu
+    from evolu_tpu.server.relay import MultiprocessRelay
+    from evolu_tpu.sync.client import connect
+    from evolu_tpu.utils.config import Config
+
+    relay = MultiprocessRelay(str(tmp_path / "relay.db"), workers=2, shards=4).start()
+    a = b = None
+    try:
+        cfg = Config(sync_url=relay.url + "/")
+        a = create_evolu({"todo": ("title",)}, config=cfg)
+        b = create_evolu({"todo": ("title",)}, config=cfg, mnemonic=a.owner.mnemonic)
+        connect(a)
+        connect(b)
+        for i in range(20):
+            (a if i % 2 else b).create("todo", {"title": f"t{i}"})
+        deadline = time.time() + 40
+        ok = False
+        while time.time() < deadline and not ok:
+            for c in (a, b):
+                c.sync()
+                c.worker.flush()
+                c._transport.flush()
+                c.worker.flush()
+            ra = a.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+            rb = b.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+            ok = len(ra) == 60 and ra == rb
+        assert ok, "replicas did not converge through the multiprocess relay"
+    finally:
+        for c in (a, b):
+            if c is not None:
+                c.dispose()
+        relay.stop()
