@@ -93,9 +93,10 @@ def segment_xor2_core(hi_i32, lo_i32, hashes_u32, valid=None):
     """Sorted segmented-XOR reduce over an (hi, lo) int32 key pair
     (traceable core).
 
-    Sort rows lexicographically by (hi, lo) — 32-bit keys, so the TPU
-    sort never touches emulated 64-bit compares — carrying the hash as
-    the only payload (no post-sort gathers). Per distinct key pair,
+    Sort rows grouped by (hi, lo) as ONE packed int64 key — REQUIRES
+    the x64 context (every production caller is with_x64-wrapped;
+    under enable_x64(False) the << 32 would silently corrupt keys) —
+    carrying the hash as the only payload (no post-sort gathers). Per distinct key pair,
     XOR the hashes of its rows via ONE segmented XOR scan (the r3
     rewrite: the previous prefix-xor + running-max + 1M-row-gather
     formulation cost ~10 ms/1M — two generic associative_scan
@@ -108,9 +109,19 @@ def segment_xor2_core(hi_i32, lo_i32, hashes_u32, valid=None):
     equals the segment total exactly at those rows (the only positions
     decoders read)."""
     del valid  # masked rows are identified by the hi sentinel
-    hi_s, lo_s, h_sorted = jax.lax.sort((hi_i32, lo_i32, hashes_u32), num_keys=2)
+    # ONE packed int64 key, UNSTABLE: only the GROUPING of equal
+    # (hi, lo) pairs matters (every decoder XOR-merges per key and is
+    # order-independent), so the cheapest total order wins — measured
+    # 1.95 (2×i32 keys, stable default) → 1.29 ms/1M on v5e. The
+    # original keys unpack from the sorted key's halves.
+    key = (hi_i32.astype(jnp.int64) << jnp.int64(32)) | lo_i32.astype(
+        jnp.uint32
+    ).astype(jnp.int64)
+    k_s, h_sorted = jax.lax.sort((key, hashes_u32), num_keys=1, is_stable=False)
+    hi_s = (k_s >> jnp.int64(32)).astype(jnp.int32)
+    lo_s = k_s.astype(jnp.int32)  # low 32 bits, int32 wrap = original lo
     valid_sorted = hi_s != jnp.int32(_SENTINEL_HI)
-    key_change = (hi_s[1:] != hi_s[:-1]) | (lo_s[1:] != lo_s[:-1])
+    key_change = k_s[1:] != k_s[:-1]
     seg_start = jnp.concatenate([jnp.ones((1,), bool), key_change])
     seg_end = jnp.concatenate([key_change, jnp.ones((1,), bool)])
     seg_xor = segmented_xor_scan(seg_start, h_sorted)
@@ -124,10 +135,11 @@ def js_minutes(millis):
 
 
 def owner_minute_segments(owner_ix, millis, hashes_u32, valid):
-    """Segmented XOR over (owner, minute) as an int32 key pair — owner
-    in the hi key (sentinel int32-max for masked rows), JS-wrapped
-    minute in the lo key — keeping the sort fully 32-bit. Shared by the
-    client reconcile kernel and the server Merkle kernel.
+    """Segmented XOR over (owner, minute) — owner in the hi half
+    (sentinel int32-max for masked rows), JS-wrapped minute in the lo
+    half of one packed int64 sort key (x64 context required; measured
+    faster than 2×i32 keys on v5e). Shared by the client reconcile
+    kernel and the server Merkle kernel.
 
     Returns (owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted).
     """
