@@ -507,3 +507,37 @@ def test_compact_segment_overflow_falls_back_to_full_pull():
         assert deltas[o] == exp, o
         expect_digest ^= d
     assert digest == expect_digest
+
+
+def test_reconcile_stream_bad_batch_lands_prior_batch():
+    """A malformed batch k+1 raising in start_batch must not drop the
+    already-dispatched batch k: the stream finishes it (matching
+    sequential reconcile, which would commit k before raising), and
+    the store remains serviceable afterwards."""
+    from evolu_tpu.server.engine import BatchReconciler
+    from evolu_tpu.server.relay import ShardedRelayStore
+    from evolu_tpu.sync import protocol
+
+    def req(owner, msgs):
+        return _sync_req(owner, "f" * 16, tuple(
+            protocol.EncryptedCrdtMessage(m.timestamp, b"c") for m in msgs
+        ))
+
+    good = [req("uA", _mk_messages("a" * 16, 20))]
+    bad = [protocol.SyncRequest(
+        (protocol.EncryptedCrdtMessage("not-46-chars", b"c"),), "uB", "f" * 16, "{}"
+    )]
+    store = ShardedRelayStore(shards=2)
+    engine = BatchReconciler(store, create_mesh())
+    with pytest.raises(ValueError):
+        engine.reconcile_stream([good, bad])
+    stored = sum(
+        s.db.exec('SELECT COUNT(*) FROM "message"')[0][0] for s in store.shards
+    )
+    assert stored == 20, "batch 1 must have committed despite batch 2 raising"
+    # The engine keeps working after the error.
+    engine.reconcile([req("uC", _mk_messages("c" * 16, 5))])
+    stored = sum(
+        s.db.exec('SELECT COUNT(*) FROM "message"')[0][0] for s in store.shards
+    )
+    assert stored == 25
