@@ -44,6 +44,7 @@ from evolu_tpu.storage.apply import (
 from evolu_tpu.storage.clock import read_clock, update_clock
 from evolu_tpu.storage.schema import delete_all_tables, init_db_model, update_db_schema
 from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.sync.protocol import assert_wire_encodable
 from evolu_tpu.utils.config import Config
 from evolu_tpu.utils.log import logger
 
@@ -335,8 +336,6 @@ class DbWorker:
         # value the encoder cannot express (bytes always; float/int64 in
         # strict mode) would wedge every later resend batch permanently.
         # Remote messages are exempt — a replica relays what it received.
-        from evolu_tpu.sync.protocol import assert_wire_encodable
-
         for m in command.messages:
             assert_wire_encodable(m.value, self.config.wire_extensions)
         clock = read_clock(self.db)

@@ -330,6 +330,20 @@ def deltas_finish(state) -> Tuple[Dict[str, Dict[str, int]], int]:
     return deltas, digest ^ int(dev_digest)
 
 
+def _pack_rows(ts_list, contents):
+    """Pack one shard's rows into flat buffers. Per-string width check
+    BEFORE packing: a total-length check alone would accept
+    ["", "<two stamps concatenated>"] and commit rows with shifted
+    timestamp/content pairing (same invariant as
+    parse_timestamp_strings)."""
+    n = len(ts_list)
+    if (np.fromiter(map(len, ts_list), np.int64, count=n) != 46).any():
+        raise ValueError("non-canonical timestamp width in batch")
+    ts_packed = "".join(ts_list).encode("ascii")
+    lens = np.fromiter(map(len, contents), np.int32, count=n)
+    return ts_packed, b"".join(contents), lens
+
+
 class _PackedRows:
     """Lazy timestamp-string accessor over per-shard packed 46-byte
     buffers (used only for the rare non-canonical host fold)."""
@@ -519,18 +533,9 @@ class BatchReconciler:
             # is C-speed (map/join/fromiter) — per-message Python
             # generators here cost ~2.5s/1M (profiled).
             ts_list = [m.timestamp for r in reqs for m in r.messages]
-            # Per-string width check BEFORE packing: a total-length
-            # check alone would accept ["", "<two stamps concatenated>"]
-            # and commit rows with shifted timestamp/content pairing
-            # (same invariant as parse_timestamp_strings).
-            if (np.fromiter(map(len, ts_list), np.int64, count=n) != 46).any():
-                raise ValueError("non-canonical timestamp width in batch")
-            ts_packed = "".join(ts_list).encode("ascii")
             contents = [m.content for r in reqs for m in r.messages]
-            was_new = db.relay_insert_packed(
-                gu, gc, ts_packed, b"".join(contents),
-                np.fromiter(map(len, contents), np.int32, count=n),
-            )
+            ts_packed, content_packed, lens = _pack_rows(ts_list, contents)
+            was_new = db.relay_insert_packed(gu, gc, ts_packed, content_packed, lens)
             cols = parse_packed_timestamps(ts_packed, n, with_case=True)
             return gu, gc, ts_packed, was_new, cols
 
@@ -645,10 +650,7 @@ class BatchReconciler:
             if n == 0:
                 continue
             live.append(si)
-            if (np.fromiter(map(len, ts_list), np.int64, count=n) != 46).any():
-                raise ValueError("non-canonical timestamp width in batch")
-            ts_packed = "".join(ts_list).encode("ascii")
-            lens = np.fromiter(map(len, contents), np.int32, count=n)
+            ts_packed, content_packed, lens = _pack_rows(ts_list, contents)
             cols = parse_packed_timestamps(ts_packed, n, with_case=True)
             pos = 0
             for u, k in zip(gu, gc):
@@ -659,10 +661,11 @@ class BatchReconciler:
             offsets.append(off)
             for part, c in zip(col_parts, cols):
                 part.append(c)
-            shard_data[si] = (gu, gc, ts_packed, b"".join(contents), lens)
+            shard_data[si] = (gu, gc, ts_packed, content_packed, lens)
             off += n
 
         packed = _PackedRows(buffers, offsets)
+        shard_offsets = dict(zip(live, offsets))
         dev_state = None
         if owner_rows:
             merged = {
@@ -685,6 +688,7 @@ class BatchReconciler:
         return {
             "requests": requests, "live": live, "shard_data": shard_data,
             "dev": dev_state, "packed": packed, "n_total": off,
+            "shard_offsets": shard_offsets,
         }
 
     def finish_batch(self, st) -> List[protocol.SyncResponse]:
@@ -744,7 +748,7 @@ class BatchReconciler:
         from evolu_tpu.core.merkle import minute_deltas_host
 
         packed = st["packed"]
-        offsets = dict(zip(st["live"], st["packed"]._offsets))
+        offsets = st["shard_offsets"]
         # Pass 1 (steady state exits here): which owners have ANY
         # duplicate row? One cheap .all() per group, no allocations.
         affected: set = set()

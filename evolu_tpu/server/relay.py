@@ -17,6 +17,7 @@ and hashes on device.
 from __future__ import annotations
 
 import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -41,9 +42,7 @@ from evolu_tpu.sync import protocol
 
 MAX_BODY_BYTES = 20 * 1024 * 1024  # index.ts:222
 
-import os as _os
-
-_REPO_ROOT = _os.path.dirname(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 class RelayStore:
@@ -374,7 +373,6 @@ class MultiprocessRelay:
         # no fork of this process's jax/tunnel state, and no
         # multiprocessing-spawn re-import of __main__ (which breaks
         # under pytest/stdin drivers).
-        import os
         import subprocess
         import sys
         import time
