@@ -114,6 +114,18 @@ def test_randomized_mixed_backend_schedules_converge(seed):
                     s.sync()
                     s.worker.flush()
 
+        # Deterministically engage d's hot-owner route before the
+        # convergence phase: ONE batched mutation (a single Send, a
+        # single relay push) lands >= 18 messages atomically, so d's
+        # next pull receives them as one batch above
+        # hot_owner_min_batch. Unbatched creates push per-Send and a
+        # racing pull can see them in dribbles — found by a 20-seed
+        # sweep.
+        with a.batching():
+            for j in range(6):
+                a.create("todo", {"title": f"hot{j}"})
+        a.worker.flush()
+
         _converge(replicas)
 
         # A brand-new device restores from the mnemonic and must pull
