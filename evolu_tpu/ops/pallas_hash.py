@@ -17,14 +17,14 @@ bit-exact vs the host oracle and the XLA path (tests/test_pallas.py).
 Falls back transparently: `timestamp_hashes_pallas(..., interpret=True)`
 runs the same kernel in interpreter mode on CPU (the test env).
 
-Status (round 2, measured on v5e-1 silicon, non-interpreted, bit-exact
-vs the XLA path at 1M hashes — benchmarks/pallas_hash_tpu.py): XLA
-6.24 ms/1M vs Pallas 6.47 ms/1M — a tie within noise. The hash is
-arithmetic-bound with a trivially fusable producer chain, so XLA's
-autofusion already achieves the kernel's roofline; `encode.
-timestamp_hashes` remains the production path and this kernel is the
-validated-on-silicon alternative (it would win only if a future
-pipeline needs the hash fused with ops XLA refuses to fuse).
+Status (re-measured round 3 with the slope method — the r2 "tie" was
+~6.9 ms/iter of tunnel RTT masking the real difference): XLA
+1.20 ms/1M vs Pallas 1.81 ms/1M on v5e-1 silicon, bit-exact. XLA's
+autofusion beats this hand-blocked kernel by ~50% on the
+arithmetic-bound hash; `encode.timestamp_hashes` remains the
+production path and this kernel stays as the validated-on-silicon
+alternative (it would win only if a future pipeline needs the hash
+fused with ops XLA refuses to fuse).
 """
 
 from __future__ import annotations
