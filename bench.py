@@ -21,8 +21,6 @@ import time
 
 import jax
 
-jax.config.update("jax_enable_x64", True)
-
 import numpy as np
 
 N = 1_000_000
@@ -111,64 +109,74 @@ ITERS_LO = 8
 ITERS_HI = 72
 
 
-def main():
+def make_loop(mesh, iters, kernel=None):
+    """The timed graph: `iters` fused reconcile iterations whose carry
+    folds EVERY kernel output (the DCE fence — see body comments).
+    Module-level so tests/test_bench_liveness.py can assert, output by
+    output, that the checksum really depends on each pipeline stage;
+    `kernel` is injectable for exactly that perturbation test."""
     import jax.numpy as jnp
 
-    from evolu_tpu.parallel.mesh import create_mesh, sharding
-    from evolu_tpu.parallel.reconcile import _shard_kernel
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    if kernel is None:
+        from evolu_tpu.parallel.reconcile import _shard_kernel as kernel
+
+    spec = P("owners")
+    pad_cell = jnp.int32(0x7FFFFFFF)
+
+    def shard_loop(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
+        def body(i, acc):
+            # Perturb per iteration so XLA cannot CSE iterations:
+            # the HLC tie-break key flips low node bits, and the
+            # cell ids are bijectively relabeled (cells < 2^18, so
+            # XOR-ing bits 18+ keeps groups intact but reshuffles
+            # the sort order — each iteration does real, different
+            # data movement). Padding rows keep the sentinel cell.
+            cid = jnp.where(
+                cell_id == pad_cell, cell_id, cell_id ^ (i << 18).astype(jnp.int32)
+            )
+            outs = kernel(
+                cid, k1, k2 ^ i.astype(jnp.uint64), ex_k1, ex_k2, owner_ix,
+            )
+            # Fold EVERY output into the carry so no stage of the
+            # pipeline is dead code — consuming only the masks let
+            # XLA DCE the whole Merkle minute-segment stage in
+            # r2/r3 early runs (the digest doesn't depend on it),
+            # silently flattering the number. psum replicates the
+            # carry across shards. tests/test_bench_liveness.py fails
+            # if any output stops feeding the checksum.
+            local = outs[0].astype(jnp.int64).sum()
+            for o in outs[1:-1]:
+                local = local + o.astype(jnp.int64).sum()
+            masked = jax.lax.psum(local, "owners")
+            return acc + masked + outs[-1].astype(jnp.int64)
+
+        return jax.lax.fori_loop(0, iters, body, jnp.int64(0))
+
+    return jax.jit(
+        shard_map(
+            shard_loop,
+            mesh=mesh,
+            in_specs=(spec,) * 6,
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+def main():
+    from evolu_tpu.parallel.mesh import create_mesh, sharding
 
     mesh = create_mesh()  # all local devices (1 chip under axon)
     n_dev = mesh.devices.size
     shd = sharding(mesh)
     names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
 
-    spec = P("owners")
-    pad_cell = jnp.int32(0x7FFFFFFF)
-
-    def make_loop(iters):
-        def shard_loop(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
-            def body(i, acc):
-                # Perturb per iteration so XLA cannot CSE iterations:
-                # the HLC tie-break key flips low node bits, and the
-                # cell ids are bijectively relabeled (cells < 2^18, so
-                # XOR-ing bits 18+ keeps groups intact but reshuffles
-                # the sort order — each iteration does real, different
-                # data movement). Padding rows keep the sentinel cell.
-                cid = jnp.where(
-                    cell_id == pad_cell, cell_id, cell_id ^ (i << 18).astype(jnp.int32)
-                )
-                outs = _shard_kernel(
-                    cid, k1, k2 ^ i.astype(jnp.uint64), ex_k1, ex_k2, owner_ix,
-                )
-                # Fold EVERY output into the carry so no stage of the
-                # pipeline is dead code — consuming only the masks let
-                # XLA DCE the whole Merkle minute-segment stage in
-                # r2/r3 early runs (the digest doesn't depend on it),
-                # silently flattering the number. psum replicates the
-                # carry across shards.
-                local = outs[0].astype(jnp.int64).sum()
-                for o in outs[1:-1]:
-                    local = local + o.astype(jnp.int64).sum()
-                masked = jax.lax.psum(local, "owners")
-                return acc + masked + outs[-1].astype(jnp.int64)
-
-            return jax.lax.fori_loop(0, iters, body, jnp.int64(0))
-
-        return jax.jit(
-            shard_map(
-                shard_loop,
-                mesh=mesh,
-                in_specs=(spec,) * 6,
-                out_specs=P(),
-                check_vma=False,
-            )
-        )
-
     results = {}
     with jax.enable_x64(True):
-        loops = {k: make_loop(k) for k in (ITERS_LO, ITERS_HI)}
+        loops = {k: make_loop(mesh, k) for k in (ITERS_LO, ITERS_HI)}
         for label, stored in (("empty_store", False), ("stored_winners", True)):
             cols, _ = shard_layout(build_columns(stored_winners=stored), n_dev)
             args = [jax.device_put(cols[k], shd) for k in names]
@@ -222,5 +230,10 @@ def main():
 
 
 if __name__ == "__main__":
+    # Global, not scoped: the whole pipeline is u64-keyed. Set only when
+    # run as a script — tests import this module, and flipping the
+    # process-wide default there would mask missing scoped
+    # `with jax.enable_x64(True)` wraps in runtime code.
+    jax.config.update("jax_enable_x64", True)
     sys.path.insert(0, ".")
     main()

@@ -175,6 +175,11 @@ class Comparison(Cond):
                 parameters.extend(sub_params)
                 return f"{lhs} in ({sub_sql})"
             values = list(self.value)  # type: ignore[arg-type]
+            if not values:
+                # SQLite rejects `x in ()` at parse time; an empty set
+                # matches nothing, so compile the constant instead of
+                # deferring a syntax error to first execution.
+                return "1 = 0"
             marks = ", ".join("?" for _ in values)
             parameters.extend(values)
             return f"{lhs} in ({marks})"

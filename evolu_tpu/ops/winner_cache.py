@@ -142,6 +142,11 @@ class DeviceWinnerCache:
         self._seed_ewma = 0.0
         self._streaming = False
         self._known: set = set()  # membership estimator while streaming
+        # The first batch after a reset re-seeds every cell it touches;
+        # that 1.0 new-cell rate is recovery, not churn, and must not
+        # flip a steady workload into streamed mode (~3 batches of
+        # penalty per unrelated rollback otherwise).
+        self._skip_ewma_once = False
         # The cache==MAX(timestamp) invariant assumes this worker's
         # connection observes every apply. SQLite's data_version moves
         # if and only if ANOTHER connection changed the database — the
@@ -226,6 +231,11 @@ class DeviceWinnerCache:
         self._slots.clear()
         self._free.clear()
         self._next_slot = 0
+        # Streaming mode sources winners from SQLite and measures churn
+        # against the carried-over _known — no 1.0-rate re-seed
+        # artifact is possible there, and skipping a genuine churn
+        # sample would only delay the streaming exit by a batch.
+        self._skip_ewma_once = not self._streaming
         with jax.enable_x64(True):
             self._w1 = jnp.zeros(self.capacity, jnp.uint64)
             self._w2 = jnp.zeros(self.capacity, jnp.uint64)
@@ -270,10 +280,13 @@ class DeviceWinnerCache:
             known = self._known if self._streaming else self._slots
             new_cells = [c for c in cells if c not in known]
             rate = len(new_cells) / len(cells)
-            self._seed_ewma = (
-                (1 - self._EWMA_NEW_WEIGHT) * self._seed_ewma
-                + self._EWMA_NEW_WEIGHT * rate
-            )
+            if self._skip_ewma_once:
+                self._skip_ewma_once = False
+            else:
+                self._seed_ewma = (
+                    (1 - self._EWMA_NEW_WEIGHT) * self._seed_ewma
+                    + self._EWMA_NEW_WEIGHT * rate
+                )
             if not self.adaptive:
                 pass
             elif self._streaming:
@@ -303,7 +316,7 @@ class DeviceWinnerCache:
                 self._streaming = True
                 self._known = set(self._slots)
                 self._known.update(cells)
-                self.reset()
+                self.reset()  # arms no EWMA skip: _streaming is set
                 return self._plan_streamed(
                     messages, cells, cell_ids, millis, counter, node
                 )

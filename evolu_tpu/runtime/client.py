@@ -60,6 +60,7 @@ class Evolu:
         self._error: Optional[Exception] = None
         self._error_listeners: List[Callable[[Exception], None]] = []
         self._reconnect_listeners: List[Callable[[], None]] = []
+        self._disposed = False
         self._on_completes: Dict[str, Callable[[], None]] = {}  # by id (db.ts:70-82)
         # Batching state is thread-local: a batch open on one thread must
         # not capture (or, if aborted, discard) another thread's mutations.
@@ -424,6 +425,14 @@ class Evolu:
             fn()
 
     def dispose(self) -> None:
+        # Transport stop() bounds its prober join, so a straggler probe
+        # can fire on_reconnect after dispose; the connect() wrapper
+        # gates on this flag, and clearing the listeners makes the
+        # residual instruction-level window benign (a post to the
+        # stopped worker's dead queue is a no-op).
+        self._disposed = True
+        with self._lock:
+            self._reconnect_listeners.clear()
         if self._auto_syncer is not None:
             self._auto_syncer.stop()
         self.worker.stop()

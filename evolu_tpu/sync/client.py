@@ -185,7 +185,10 @@ class SyncTransport:
 
     def _came_back(self) -> None:
         with self._probe_lock:
-            if not self._offline:
+            # stop() joins the daemon prober with a short timeout, so a
+            # probe can complete mid-dispose — don't fire the reconnect
+            # hook into an already-disposed Evolu instance.
+            if self._probe_stop.is_set() or not self._offline:
                 return
             self._offline = False
         self._fire_reconnect()
@@ -326,7 +329,12 @@ def connect(evolu, config: Optional[Config] = None) -> SyncTransport:
     def on_reconnect():
         # The reference's online listener re-syncs immediately
         # (db.ts:390-412); app listeners (the `online` event analog)
-        # fire first so they observe the transition itself.
+        # fire first so they observe the transition itself. The
+        # disposed gate closes the straggler-probe race: stop() only
+        # joins the prober for 0.2s, so a probe completing mid-dispose
+        # may still invoke this hook.
+        if getattr(evolu, "_disposed", False):
+            return
         evolu._fire_reconnect()
         evolu.sync(refresh_queries=False)
 

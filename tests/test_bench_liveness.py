@@ -1,0 +1,98 @@
+"""bench.py graph-liveness fence (VERDICT r3 weak #3).
+
+The r2/early-r3 measurement bug: the fori_loop checksum consumed only
+the masks + digest, so XLA dead-code-eliminated the whole Merkle
+minute-segment stage from the timed graph and the bench silently timed
+a smaller pipeline (under-reported 2.3×). bench.py now folds EVERY
+kernel output into the carry; this test pins that property so the bug
+class can never return: for each of the 9 `_shard_kernel` outputs,
+perturbing just that output must change the checksum. If a future edit
+drops an output from the fold, its perturbation becomes invisible and
+the test fails — i.e. "stub any pipeline stage and nothing fails" is
+now false by construction.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bench
+from evolu_tpu.parallel.mesh import create_mesh, sharding
+from evolu_tpu.parallel.reconcile import _shard_kernel
+
+N_OUTPUTS = 9  # xor_s, upsert_s, i_s, owner/minute/seg_end/seg_xor/valid, digest
+
+
+def _perturbing_kernel(j):
+    """The real kernel with output j nudged by one unit/flip — the
+    minimal observable change a live fold must propagate."""
+
+    def kernel(*args):
+        outs = list(_shard_kernel(*args))
+        # Fail loudly on arity drift: a 10th output would silently
+        # escape the fence otherwise.
+        assert len(outs) == N_OUTPUTS, f"_shard_kernel grew to {len(outs)} outputs"
+        o = outs[j]
+        if o.ndim == 0:
+            outs[j] = o + jnp.ones((), o.dtype) if o.dtype != jnp.bool_ else ~o
+        elif o.dtype == jnp.bool_:
+            outs[j] = o.at[0].set(~o[0])
+        else:
+            outs[j] = o.at[0].add(jnp.ones((), o.dtype))
+        return tuple(outs)
+
+    return kernel
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    mesh = create_mesh()
+    n_dev = mesh.devices.size
+    cols, _ = bench.shard_layout(
+        bench.build_columns(n=512, owners=16, stored_winners=True), n_dev
+    )
+    shd = sharding(mesh)
+    names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
+    with jax.enable_x64(True):
+        args = [jax.device_put(cols[k], shd) for k in names]
+    return mesh, args
+
+
+def test_every_kernel_output_is_live_in_the_checksum(tiny_setup):
+    mesh, args = tiny_setup
+    # iters=1: with more fused iterations a bool-flip perturbation's
+    # ±1 checksum delta could cancel across iterations (flipped element
+    # True in one, False in the next) and falsely report a live output
+    # as dead; a single iteration makes every perturbation's delta
+    # nonzero by construction.
+    with jax.enable_x64(True):
+        base = int(bench.make_loop(mesh, 1)(*args))
+        dead = []
+        for j in range(N_OUTPUTS):
+            loop = bench.make_loop(mesh, 1, kernel=_perturbing_kernel(j))
+            if int(loop(*args)) == base:
+                dead.append(j)
+    assert dead == [], (
+        f"outputs {dead} do not feed the bench checksum — XLA is free to "
+        f"DCE their producing stages out of the timed graph"
+    )
+
+
+def test_checksum_depends_on_the_data():
+    """Same loop, different input data → different checksum (guards a
+    degenerate fold that collapses to a constant)."""
+    mesh = create_mesh()
+    n_dev = mesh.devices.size
+    shd = sharding(mesh)
+    names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
+    with jax.enable_x64(True):
+        loop = bench.make_loop(mesh, 2)
+        vals = []
+        for seed in (7, 8):
+            cols, _ = bench.shard_layout(
+                bench.build_columns(n=512, owners=16, seed=seed, stored_winners=True),
+                n_dev,
+            )
+            vals.append(int(loop(*[jax.device_put(cols[k], shd) for k in names])))
+    assert vals[0] != vals[1]

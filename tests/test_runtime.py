@@ -226,6 +226,29 @@ def test_subqueries_exists_and_in():
     assert params3 == [1, "work", 0]
 
 
+def test_in_with_empty_sequence_compiles_to_constant_false():
+    """SQLite rejects `x in ()` at parse time; an empty list must
+    compile to a constant-false predicate at build time instead of a
+    syntax error at first execution (and `~` must still negate it)."""
+    from evolu_tpu.api.query import c, not_
+
+    sql, params = table("todo").select("id").where(c("id", "in", [])).compile()
+    assert sql == 'SELECT "id" FROM "todo" WHERE 1 = 0'
+    assert params == []
+
+    sql2, _ = table("todo").where(not_(c("id", "in", ()))).compile()
+    assert "not (1 = 0)" in sql2
+
+    # And it must actually execute.
+    import sqlite3
+
+    conn = sqlite3.connect(":memory:")
+    conn.execute('CREATE TABLE "todo" ("id" TEXT)')
+    conn.execute('INSERT INTO "todo" VALUES (\'a\')')
+    assert conn.execute(sql).fetchall() == []
+    conn.close()
+
+
 # --- model casts (model.ts:100-112) ---
 
 

@@ -382,6 +382,30 @@ def test_http_error_surfaces_but_offline_does_not():
     assert len(errors) == 1
 
 
+def test_probe_success_after_stop_does_not_fire_reconnect():
+    """stop() joins the daemon prober with only a 0.2s timeout, so a
+    probe can complete mid-dispose; _came_back must then NOT invoke the
+    reconnect hook on the already-disposed instance."""
+    fired = []
+    t = SyncTransport(Config(), on_receive=lambda *a: None,
+                      on_reconnect=lambda: fired.append(1))
+    with t._probe_lock:
+        t._offline = True
+    t.stop()  # sets _probe_stop; a straggler probe may land after this
+    t._came_back()
+    assert fired == []
+    assert t._offline  # untouched: no half-applied transition
+
+    # The pre-stop path still fires.
+    t2 = SyncTransport(Config(), on_receive=lambda *a: None,
+                       on_reconnect=lambda: fired.append(1))
+    with t2._probe_lock:
+        t2._offline = True
+    t2._came_back()
+    assert fired == [1]
+    t2.stop()
+
+
 def test_s2k_salted_and_simple_types():
     """Accept S2K types 0/1 per RFC 4880 (OpenPGP.js may emit them for
     other configs); our own output stays type 3."""

@@ -427,6 +427,43 @@ def test_adaptive_gating_crosses_modes_with_identical_state():
         db_a.close(), db_b.close()
 
 
+def test_reset_reseed_batch_does_not_count_as_churn():
+    """The first batch after reset() re-seeds every cell it touches;
+    that 1.0 new-cell rate is recovery, not churn, and must not flip a
+    steady workload into streamed mode (advisor r3: each unrelated
+    rollback cost ~3 streamed batches before this fix)."""
+    from evolu_tpu.ops.merge import plan_batch_device_full
+
+    rng = np.random.default_rng(33)
+    db_a, db_b = _db(), _db()
+    cache = DeviceWinnerCache(db_b, capacity=64)
+    tree_a, tree_b = {}, {}
+    try:
+        def steady(base):
+            order = rng.permutation(120)
+            return tuple(_mk(base + int(i), row=f"s{int(i) % 23}") for i in order)
+
+        for b in range(4):  # settle into cached mode
+            batch = steady(b * 40)
+            tree_a = apply_messages(db_a, tree_a, batch, planner=plan_batch_device_full)
+            tree_b = apply_messages(db_b, tree_b, batch, planner=cache.plan_batch)
+        assert not cache._streaming
+        ewma_before = cache._seed_ewma
+
+        cache.on_transaction_failed()  # e.g. an unrelated rollback
+
+        for b in range(4, 6):
+            batch = steady(b * 40)
+            tree_a = apply_messages(db_a, tree_a, batch, planner=plan_batch_device_full)
+            tree_b = apply_messages(db_b, tree_b, batch, planner=cache.plan_batch)
+            assert not cache._streaming, "re-seed batch was scored as churn"
+            assert _dump(db_a) == _dump(db_b)
+            assert merkle_tree_to_string(tree_a) == merkle_tree_to_string(tree_b)
+        assert cache._seed_ewma <= ewma_before + 1e-9
+    finally:
+        db_a.close(), db_b.close()
+
+
 def test_disable_adaptive_while_streaming_reseeds_safely():
     """Flipping adaptive=False on a cache that is ALREADY streaming
     must fall back to the cached path with a full reseed — not look up
