@@ -1,0 +1,87 @@
+"""Hot loop #3: per-message OpenPGP encrypt/decrypt (SURVEY.md;
+reference packages/evolu/src/sync.worker.ts:50-91,135-173).
+
+Measures the full client sync leg — CrdtMessage → protobuf content →
+SKESK‖SEIPD ciphertext and back — through the public entry points
+(`encrypt_messages`/`decrypt_messages`), for both the batched C++ path
+(native/evolu_crypto.cpp, production default) and the pure Python
+oracle (sync/crypto.py, forced via monkeypatched unavailability).
+Host-side by design: values never touch the device. Prints one JSON
+line; numbers live in docs/BENCHMARKS.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.sync import native_crypto
+from evolu_tpu.sync.client import decrypt_messages, encrypt_messages
+
+N = int(os.environ.get("CRYPTO_N", 100_000))
+MNEMONIC = "legal winner thank year wave sausage worth useful legal winner thank yellow"
+
+
+def build_messages(n=N):
+    # The config-3 value mix: short strings (titles), ints (flags/ids),
+    # None (deletes) — what a todo-style client actually syncs.
+    vals = [lambda i: f"todo item {i} ✓", lambda i: i % 2, lambda i: None,
+            lambda i: f"note {i}: café", lambda i: i * 977]
+    return tuple(
+        CrdtMessage(
+            f"2024-01-01T00:00:00.{i % 1000:03d}Z-{i % 16:04X}-a1b2c3d4e5f6{i % 256:02x}18",
+            "todo", f"Tf9faXx1ryRXmPF6e_{i:06d}", "title", vals[i % 5](i),
+        )
+        for i in range(n)
+    )
+
+
+def timed(fn, *args):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    return out, time.perf_counter() - t0
+
+
+def main():
+    msgs = build_messages()
+    results = {}
+
+    from evolu_tpu.utils import native_loader
+
+    for label in ("native", "pure"):
+        if label == "pure":
+            # Force the oracle: a None cache entry marks the library
+            # unavailable, routing both legs pure.
+            native_loader._cache["libevolu_crypto.so"] = None
+        elif not native_crypto.native_available():
+            continue
+        enc, t_enc = timed(encrypt_messages, msgs, MNEMONIC)
+        dec, t_dec = timed(decrypt_messages, enc, MNEMONIC)
+        assert dec == msgs, f"{label} roundtrip diverged"
+        results[label] = {
+            "encrypt_msgs_per_sec": round(N / t_enc),
+            "decrypt_msgs_per_sec": round(N / t_dec),
+            "encrypt_us_per_msg": round(t_enc * 1e6 / N, 2),
+            "decrypt_us_per_msg": round(t_dec * 1e6 / N, 2),
+        }
+    native_loader._cache.pop("libevolu_crypto.so", None)  # restore
+
+    head = results.get("native", results.get("pure"))
+    speedup = (
+        round(results["native"]["encrypt_msgs_per_sec"]
+              / results["pure"]["encrypt_msgs_per_sec"], 2)
+        if "native" in results and "pure" in results else None
+    )
+    print(json.dumps({
+        "metric": "crypto_encrypt_msgs_per_sec",
+        "value": head["encrypt_msgs_per_sec"],
+        "unit": "msgs/sec",
+        "detail": {"n": N, "paths": results, "encrypt_speedup": speedup},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -145,8 +145,13 @@ class DeviceWinnerCache:
         # The first batch after a reset re-seeds every cell it touches;
         # that 1.0 new-cell rate is recovery, not churn, and must not
         # flip a steady workload into streamed mode (~3 batches of
-        # penalty per unrelated rollback otherwise).
+        # penalty per unrelated rollback otherwise). At most ONE skip
+        # per run of resets (_ewma_suppressed): under repeated resets
+        # (e.g. a foreign writer touching the DB every batch) the
+        # sustained 1.0 rates ARE the workload signal and must reach
+        # the EWMA, or the gate starves and never streams.
         self._skip_ewma_once = False
+        self._ewma_suppressed = False
         # The cache==MAX(timestamp) invariant assumes this worker's
         # connection observes every apply. SQLite's data_version moves
         # if and only if ANOTHER connection changed the database — the
@@ -234,8 +239,10 @@ class DeviceWinnerCache:
         # Streaming mode sources winners from SQLite and measures churn
         # against the carried-over _known — no 1.0-rate re-seed
         # artifact is possible there, and skipping a genuine churn
-        # sample would only delay the streaming exit by a batch.
-        self._skip_ewma_once = not self._streaming
+        # sample would only delay the streaming exit by a batch. And
+        # never skip twice in a row: consecutive resets mean the resets
+        # themselves are the workload (see __init__).
+        self._skip_ewma_once = not self._streaming and not self._ewma_suppressed
         with jax.enable_x64(True):
             self._w1 = jnp.zeros(self.capacity, jnp.uint64)
             self._w2 = jnp.zeros(self.capacity, jnp.uint64)
@@ -282,11 +289,13 @@ class DeviceWinnerCache:
             rate = len(new_cells) / len(cells)
             if self._skip_ewma_once:
                 self._skip_ewma_once = False
+                self._ewma_suppressed = True
             else:
                 self._seed_ewma = (
                     (1 - self._EWMA_NEW_WEIGHT) * self._seed_ewma
                     + self._EWMA_NEW_WEIGHT * rate
                 )
+                self._ewma_suppressed = False
             if not self.adaptive:
                 pass
             elif self._streaming:

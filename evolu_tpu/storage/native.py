@@ -19,8 +19,6 @@ tests/test_native.py).
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 
 import numpy as np
@@ -28,19 +26,13 @@ from contextlib import contextmanager
 from typing import Iterable, List, Optional, Sequence, Tuple
 
 from evolu_tpu.core.types import UnknownError
-
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "libevolu_host.so")
+from evolu_tpu.utils.native_loader import load_native_library
 
 _SQLITE_ROW = 100
 _SQLITE_DONE = 101
 
 # column types
 _T_INT, _T_FLOAT, _T_TEXT, _T_BLOB, _T_NULL = 1, 2, 3, 4, 5
-
-_lib_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_lib_failed = False
 
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -99,25 +91,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 def load_library() -> Optional[ctypes.CDLL]:
     """The shared library, building it on first use; None if unavailable."""
-    global _lib, _lib_failed
-    with _lib_lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        if not os.path.exists(_LIB_PATH):
-            try:
-                subprocess.run(
-                    ["make", "-s"], cwd=_NATIVE_DIR, check=True,
-                    capture_output=True, timeout=120,
-                )
-            except Exception:
-                _lib_failed = True
-                return None
-        try:
-            _lib = _configure(ctypes.CDLL(_LIB_PATH))
-        except OSError:
-            _lib_failed = True
-            return None
-        return _lib
+    return load_native_library("libevolu_host.so", _configure)
 
 
 def native_available() -> bool:

@@ -38,7 +38,17 @@ def encrypt_messages(messages, mnemonic: str):
     MUTATION time (worker._send), so anything in the log is either
     authored encodable or arrived from a remote peer — and a relay must
     forward remote messages verbatim, never refuse them (refusing here
-    would wedge anti-entropy resends forever)."""
+    would wedge anti-entropy resends forever).
+
+    Hot loop #3 (SURVEY.md): the batched C++ path handles canonical
+    values (~8× the pure loop, docs/BENCHMARKS.md); None means some
+    value needs the pure loop's error surface, so it re-runs here."""
+    if messages:
+        from evolu_tpu.sync import native_crypto
+
+        native = native_crypto.encrypt_batch(messages, mnemonic)
+        if native is not None:
+            return native
     out = []
     for m in messages:
         content = protocol.encode_content(m.table, m.row, m.column, m.value)
@@ -49,14 +59,13 @@ def encrypt_messages(messages, mnemonic: str):
 
 
 def decrypt_messages(messages, mnemonic: str):
-    """sync.worker.ts:135-173."""
-    out = []
-    for m in messages:
-        table, row, column, value = protocol.decode_content(
-            decrypt_symmetric(m.content, mnemonic)
-        )
-        out.append(CrdtMessage(m.timestamp, table, row, column, value))
-    return tuple(out)
+    """sync.worker.ts:135-173. Canonical rows decrypt on the batched
+    C++ path; everything else — including the whole batch when the
+    library is unavailable — re-runs through the Python oracle at its
+    original position (identical errors, first-failure order)."""
+    from evolu_tpu.sync import native_crypto
+
+    return native_crypto.decrypt_batch(messages, mnemonic)
 
 
 class SyncTransport:

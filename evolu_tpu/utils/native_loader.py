@@ -1,0 +1,55 @@
+"""Shared build-on-demand ctypes loader for the native/ libraries.
+
+Both native bindings (`storage/native.py` over libevolu_host.so,
+`sync/native_crypto.py` over libevolu_crypto.so) follow the same
+contract: build the specific make target on first use (g++ and the
+versioned system sonames are baked into the image), load via ctypes,
+run the module's `configure` (argtypes + optional runtime probe), and
+cache the result — including failure, so an unbuildable environment
+costs one attempt, not one per call. Failure always means "caller
+falls back to its pure-Python path", never an exception.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, Optional
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+_lock = threading.Lock()
+_cache: Dict[str, Optional[ctypes.CDLL]] = {}  # so_name → lib (None = failed)
+
+
+def load_native_library(
+    so_name: str,
+    configure: Callable[[ctypes.CDLL], Optional[ctypes.CDLL]],
+) -> Optional[ctypes.CDLL]:
+    """The shared library named `so_name` (also its make target),
+    built on first use; None if unavailable. `configure` sets argtypes
+    and may return None to veto (e.g. a failing runtime probe)."""
+    with _lock:
+        if so_name in _cache:
+            return _cache[so_name]
+        path = os.path.join(NATIVE_DIR, so_name)
+        if not os.path.exists(path):
+            try:
+                subprocess.run(
+                    ["make", "-s", so_name], cwd=NATIVE_DIR,
+                    check=True, capture_output=True, timeout=120,
+                )
+            except Exception:
+                _cache[so_name] = None
+                return None
+        try:
+            lib = configure(ctypes.CDLL(path))
+        except OSError:
+            lib = None
+        _cache[so_name] = lib
+        return lib

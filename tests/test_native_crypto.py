@@ -1,0 +1,261 @@
+"""Batched C++ OpenPGP layer (native/evolu_crypto.cpp) — exact-behavior
+parity with the Python oracle (sync/crypto.py + protocol.py), fallback
+demotion for every non-canonical shape, and live GnuPG interop in both
+directions (reference: packages/evolu/src/sync.worker.ts:50-91,135-173
+encrypts with OpenPGP.js v5; gpg is the independent RFC 4880 peer)."""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.sync import native_crypto, protocol
+from evolu_tpu.sync.client import decrypt_messages, encrypt_messages
+from evolu_tpu.sync.crypto import PgpError, decrypt_symmetric, encrypt_symmetric
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "fixtures"
+MN = (FIXTURES / "gpg_password.txt").read_text().strip()
+
+pytestmark = pytest.mark.skipif(
+    not native_crypto.native_available(), reason="libevolu_crypto unavailable"
+)
+
+# Value matrix: every CrdtValue kind, both int fields (5/int32, 7/int64),
+# unicode, NULs (the char*-ABI trap), empty strings, float edge cases.
+VALUES = [
+    None, "", "x", "héllo ✓ café", "with\x00nul\x00s", "日本語",
+    True, False, 0, 1, -1, 2**31 - 1, -(2**31), 2**31, -(2**31) - 1,
+    2**63 - 1, -(2**63), 3.14159, -0.0, 1e308, float("inf"), float("-inf"),
+]
+
+
+def _msgs(values=VALUES):
+    return tuple(
+        CrdtMessage(f"ts{i}", "todo\x00tbl", f"row-{i}", "col\x00umn", v)
+        for i, v in enumerate(values)
+    )
+
+
+def _canon(m):
+    # bools leave encode_content as varints; both paths decode them as ints
+    v = int(m.value) if isinstance(m.value, bool) else m.value
+    return CrdtMessage(m.timestamp, m.table, m.row, m.column, v)
+
+
+def test_native_encrypt_decrypts_via_pure_oracle():
+    msgs = _msgs()
+    enc = native_crypto.encrypt_batch(msgs, MN)
+    assert enc is not None and len(enc) == len(msgs)
+    for m, e in zip(msgs, enc):
+        assert e.timestamp == m.timestamp
+        content = decrypt_symmetric(e.content, MN)
+        assert protocol.decode_content(content) == (
+            m.table, m.row, m.column,
+            int(m.value) if isinstance(m.value, bool) else m.value,
+        )
+        # and the content bytes are exactly what the Python encoder emits
+        assert content == protocol.encode_content(m.table, m.row, m.column, m.value)
+
+
+def test_pure_encrypt_decrypts_via_native_batch():
+    msgs = _msgs()
+    enc = tuple(
+        protocol.EncryptedCrdtMessage(
+            m.timestamp,
+            encrypt_symmetric(
+                protocol.encode_content(m.table, m.row, m.column, m.value), MN
+            ),
+        )
+        for m in msgs
+    )
+    assert native_crypto.decrypt_batch(enc, MN) == tuple(_canon(m) for m in msgs)
+
+
+def test_pipeline_roundtrip_via_public_entry_points():
+    msgs = _msgs()
+    assert decrypt_messages(encrypt_messages(msgs, MN), MN) == tuple(
+        _canon(m) for m in msgs
+    )
+
+
+def test_unencodable_values_fall_back_to_oracle_errors():
+    # bytes can never travel the wire; int beyond int64 exceeds the codec
+    for bad in (b"raw", 2**64):
+        msgs = (CrdtMessage("t", "todo", "r", "c", bad),)
+        assert native_crypto.encrypt_batch(msgs, MN) is None
+        with pytest.raises(TypeError):
+            encrypt_messages(msgs, MN)
+
+
+def test_nondeterministic_and_distinct_salts():
+    msgs = _msgs(["same"] * 3)
+    enc = native_crypto.encrypt_batch(msgs, MN)
+    cts = [e.content for e in enc]
+    assert len(set(cts)) == 3  # fresh salt + prefix per message
+    salts = {ct[6:14] for ct in cts}  # SKESK v4 salt offset
+    assert len(salts) == 3
+
+
+def test_wrong_password_raises_identically():
+    enc = native_crypto.encrypt_batch(_msgs(["v"]), MN)
+    with pytest.raises(PgpError, match="wrong password"):
+        native_crypto.decrypt_batch(enc, "not the password")
+    with pytest.raises(PgpError, match="wrong password"):
+        decrypt_messages(enc, "not the password")
+
+
+def test_mdc_tamper_detected_through_batch():
+    enc = native_crypto.encrypt_batch(_msgs(["v"]), MN)
+    ct = bytearray(enc[0].content)
+    ct[-1] ^= 0x01  # inside the MDC trailer
+    bad = (protocol.EncryptedCrdtMessage("t", bytes(ct)),)
+    with pytest.raises(PgpError):
+        native_crypto.decrypt_batch(bad, MN)
+
+
+def test_malformed_first_failure_order_matches_pure():
+    """Mixed batch: [good, malformed, good] must raise the malformed
+    message's error (not return partial results), like the pure loop."""
+    good = native_crypto.encrypt_batch(_msgs(["a", "b"]), MN)
+    batch = (good[0], protocol.EncryptedCrdtMessage("t", b"\x00garbage"), good[1])
+    with pytest.raises(PgpError):
+        native_crypto.decrypt_batch(batch, MN)
+
+
+def test_gpg_golden_ciphertexts_via_batch():
+    """The frozen gpg fixtures: 'none' decodes on the canonical fast
+    path; zip/zlib are Compressed Data → demoted to the oracle, same
+    result either way."""
+    plaintext = (FIXTURES / "gpg_plaintext.bin").read_bytes()
+    expected = protocol.decode_content(plaintext)
+    for name in (
+        "gpg_aes256_s2k1024_none.pgp",
+        "gpg_aes256_s2k1024_zip.pgp",
+        "gpg_aes256_s2k1024_zlib.pgp",
+    ):
+        enc = (protocol.EncryptedCrdtMessage("t", (FIXTURES / name).read_bytes()),)
+        (out,) = native_crypto.decrypt_batch(enc, MN)
+        assert (out.table, out.row, out.column, out.value) == expected, name
+
+
+@pytest.mark.skipif(shutil.which("gpg") is None, reason="gpg not on PATH")
+def test_gpg_decrypts_native_ciphertext(tmp_path):
+    """Live interop: a ciphertext the C++ path produced must decrypt
+    with GnuPG to the exact content bytes."""
+    msgs = (CrdtMessage("t", "todo", "r-1", "title", "Buy milk ✓ café"),)
+    enc = native_crypto.encrypt_batch(msgs, MN)
+    ct_file = tmp_path / "msg.pgp"
+    ct_file.write_bytes(enc[0].content)
+    res = subprocess.run(
+        [
+            "gpg", "--homedir", str(tmp_path), "--batch",
+            "--pinentry-mode", "loopback", "--passphrase", MN,
+            "--decrypt", str(ct_file),
+        ],
+        capture_output=True,
+        check=True,
+    )
+    assert res.stdout == protocol.encode_content("todo", "r-1", "title", "Buy milk ✓ café")
+
+
+@pytest.mark.skipif(shutil.which("gpg") is None, reason="gpg not on PATH")
+def test_native_decrypts_fresh_gpg_ciphertext(tmp_path):
+    """Live interop the other way: encrypt with gpg NOW (fresh salt,
+    its own packet writer) and decrypt through the batch."""
+    content = protocol.encode_content("todo", "r-2", "done", 1)
+    src = tmp_path / "plain.bin"
+    src.write_bytes(content)
+    out = tmp_path / "out.pgp"
+    subprocess.run(
+        [
+            "gpg", "--homedir", str(tmp_path), "--batch", "--yes",
+            "--pinentry-mode", "loopback", "--passphrase", MN,
+            "--symmetric", "--cipher-algo", "AES256",
+            "--s2k-mode", "3", "--s2k-digest-algo", "SHA256",
+            "--s2k-count", "1024", "--compress-algo", "none",
+            "--output", str(out), str(src),
+        ],
+        capture_output=True,
+        check=True,
+    )
+    enc = (protocol.EncryptedCrdtMessage("t", out.read_bytes()),)
+    (msg,) = native_crypto.decrypt_batch(enc, MN)
+    assert (msg.table, msg.row, msg.column, msg.value) == ("todo", "r-2", "done", 1)
+
+
+def _oracle_vs_native(content: bytes):
+    """Encrypt crafted content bytes with the pure path, then compare
+    the native batch outcome against the oracle outcome-for-outcome."""
+    ct = encrypt_symmetric(content, MN)
+    enc = (protocol.EncryptedCrdtMessage("t", ct),)
+    try:
+        oracle = protocol.decode_content(decrypt_symmetric(ct, MN))
+    except (PgpError, ValueError) as e:
+        oracle = type(e)
+    try:
+        (m,) = native_crypto.decrypt_batch(enc, MN)
+        got = (m.table, m.row, m.column, m.value)
+    except (PgpError, ValueError) as e:
+        got = type(e)
+    assert got == oracle, f"{content!r}: oracle {oracle!r} vs native {got!r}"
+
+
+def test_ten_byte_varint_overflow_matches_oracle():
+    """The Python varint reader keeps UNBOUNDED precision on the 10th
+    byte; a mod-2^64 wrap in C++ would remap overflowed field keys to
+    real fields, decode overflowed lengths 'successfully', and bend
+    field-7 ints (r4 review finding). All such shapes must demote to
+    the oracle."""
+    base = protocol.encode_content("todo", "r", "c", None)
+    ten = lambda last: bytes([0x80] * 9 + [last])  # 9 continuations + final
+    crafted = [
+        # field 7 varint whose 10th byte carries bits >= 2^64: the
+        # oracle decodes a huge positive Python int
+        base + bytes([7 << 3]) + ten(0x05),
+        # overflowed FIELD KEY (2^64 + tag(1, wt2) = 0x8A 0x80×8 0x02):
+        # a huge unknown field to the oracle (payload skipped), would
+        # wrap to field 1 = table in C++
+        base + bytes([0x8A] + [0x80] * 8 + [0x02]) + bytes([3]) + b"zzz",
+        # overflowed wt2 LENGTH (2^64 + 3): oracle raises truncated
+        bytes([(1 << 3) | 2]) + ten(0x03) + b"abc" + base,
+        # the maximal legitimate 10-byte varint (bit 63 set, 10th byte
+        # 0x01): both paths must decode int64 min
+        base + bytes([7 << 3]) + bytes([0x80] * 9 + [0x01]),
+        # 10th byte with continuation set: oracle raises varint too long
+        base + bytes([7 << 3]) + bytes([0x80] * 10 + [0x00]),
+    ]
+    for content in crafted:
+        _oracle_vs_native(content)
+
+
+def test_fuzz_decrypt_batch_never_diverges_from_oracle():
+    """Random mutations of valid ciphertexts: the batch path must
+    either produce the oracle's value or raise the oracle's error —
+    never a third outcome."""
+    import random
+
+    rng = random.Random(7)
+    base = native_crypto.encrypt_batch(_msgs(["fuzz-me", 42, None]), MN)
+    for trial in range(120):
+        ct = bytearray(rng.choice(base).content)
+        for _ in range(rng.randint(1, 4)):
+            op = rng.random()
+            if op < 0.5 and ct:
+                ct[rng.randrange(len(ct))] ^= 1 << rng.randrange(8)
+            elif op < 0.75 and len(ct) > 2:
+                del ct[rng.randrange(len(ct))]
+            else:
+                ct.insert(rng.randrange(len(ct) + 1), rng.randrange(256))
+        enc = (protocol.EncryptedCrdtMessage("t", bytes(ct)),)
+        try:
+            oracle = protocol.decode_content(decrypt_symmetric(bytes(ct), MN))
+        except (PgpError, ValueError) as e:
+            oracle = type(e)
+        try:
+            (m,) = native_crypto.decrypt_batch(enc, MN)
+            got = (m.table, m.row, m.column, m.value)
+        except (PgpError, ValueError) as e:
+            got = type(e)
+        assert got == oracle, f"trial {trial}: oracle {oracle!r} vs got {got!r}"

@@ -464,6 +464,35 @@ def test_reset_reseed_batch_does_not_count_as_churn():
         db_a.close(), db_b.close()
 
 
+def test_per_batch_foreign_writes_still_reach_streaming(tmp_path):
+    """A foreign writer touching the DB between EVERY batch resets the
+    cache each time; the skip-once rule must not swallow every 1.0
+    re-seed rate or the gate starves and re-seeds the whole cache from
+    SQLite forever (r4 review finding) — sustained resets ARE the
+    churn signal, so streaming must engage."""
+    db = open_database(str(tmp_path / "wc.db"), "auto")
+    init_db_model(db, mnemonic=None)
+    db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title" BLOB, "done" BLOB)')
+    foreign = open_database(str(tmp_path / "wc.db"), "auto")
+    cache = DeviceWinnerCache(db, capacity=64)
+    rng = np.random.default_rng(5)
+    tree = {}
+    try:
+        streamed = []
+        for b in range(6):
+            foreign.exec("CREATE TABLE IF NOT EXISTS _poke (x)")
+            foreign.exec("INSERT INTO _poke VALUES (1)")  # moves data_version
+            order = rng.permutation(120)
+            batch = tuple(_mk(b * 40 + int(i), row=f"s{int(i) % 23}") for i in order)
+            tree = apply_messages(db, tree, batch, planner=cache.plan_batch)
+            streamed.append(cache._streaming)
+        assert any(streamed), (
+            f"gate starved: every re-seed rate was suppressed {streamed}"
+        )
+    finally:
+        db.close(), foreign.close()
+
+
 def test_disable_adaptive_while_streaming_reseeds_safely():
     """Flipping adaptive=False on a cache that is ALREADY streaming
     must fall back to the cached path with a full reseed — not look up
