@@ -1,0 +1,202 @@
+"""Slope-measured sort-stage experiments on the real chip (VERDICT r3
+next #1). Each candidate is timed with bench.py's two-point fused-loop
+slope method (RTT cancelled, checksum consumes every output so XLA
+cannot DCE a stage). Prints one JSON line per experiment; conclusions
+live in docs/BENCHMARKS.md.
+
+Candidates:
+  merge_stable   — r3 production: 1×i32 key, stable, 5 payloads
+  merge_packed   — r4 production: (cell<<24|idx) 1×i64 key, UNSTABLE,
+                   4 payloads (idx recovered from the key's low bits)
+  minute_i64     — the r3 minute sort alone (packed i64 key, 1 payload)
+  minute_scan    — the full r3 minute stage (global sort + XOR scan),
+                   inlined for comparison
+  minute_rowsort — r4 production: tile-local grouping via a row-wise
+                   sort of a (N/8192, 8192) view (segment_xor2_core)
+
+Measured r4 negative result (kernel deleted; git history has it): a
+Pallas block-local bitonic group-by (91-stage XOR-partner network via
+pltpu.roll) ran 1.75 ms vs minute_rowsort's 0.33 — VPU-compute-bound;
+see docs/BENCHMARKS.md.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N = int(os.environ.get("SORT_N", 1 << 20))
+ITERS_LO, ITERS_HI = 4, 36
+REPS = 8
+
+
+def build(seed=7):
+    rng = np.random.default_rng(seed)
+    cells = max(N // 4, 1)
+    cell_id = rng.integers(0, cells, N).astype(np.int32)
+    k1 = ((1_700_000_000_000 + rng.integers(0, 86_400_000, N).astype(np.int64))
+          .astype(np.uint64) << np.uint64(16)) | rng.integers(0, 256, N).astype(np.uint64)
+    k2 = rng.integers(1, 2**63, N).astype(np.uint64)
+    ex1 = rng.integers(0, 2**63, N).astype(np.uint64)
+    ex2 = rng.integers(0, 2**63, N).astype(np.uint64)
+    owner = rng.integers(0, 1000, N).astype(np.int32)
+    minute = ((1_700_000_000_000 + rng.integers(0, 86_400_000, N)) // 60000).astype(np.int32)
+    hashes = rng.integers(0, 2**32, N).astype(np.uint32)
+    return dict(cell_id=cell_id, k1=k1, k2=k2, ex1=ex1, ex2=ex2,
+                owner=owner, minute=minute, hashes=hashes)
+
+
+def slope_time(make_loop, args):
+    """Wall at two fused iteration counts → per-iteration slope."""
+    medians = {}
+    for iters in (ITERS_LO, ITERS_HI):
+        fn = jax.jit(make_loop(iters))
+        np.asarray(fn(*args))  # compile + warm
+        times = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            np.asarray(fn(*args))
+            times.append(time.perf_counter() - t0)
+        medians[iters] = statistics.median(times)
+    return (medians[ITERS_HI] - medians[ITERS_LO]) / (ITERS_HI - ITERS_LO)
+
+
+def _fold(acc, outs):
+    local = outs[0].astype(jnp.int64).sum()
+    for o in outs[1:]:
+        local = local + o.astype(jnp.int64).sum()
+    return acc + local
+
+
+def merge_stable(cols):
+    cell, k1, k2, e1, e2 = (jnp.asarray(cols[k]) for k in ("cell_id", "k1", "k2", "ex1", "ex2"))
+
+    def make(iters):
+        def loop(cell, k1, k2, e1, e2):
+            def body(i, acc):
+                c = cell ^ (i << 20).astype(jnp.int32)
+                idx = jnp.arange(N, dtype=jnp.int32)
+                outs = jax.lax.sort((c, idx, k1 ^ i.astype(jnp.uint64), k2, e1, e2),
+                                    num_keys=1, is_stable=True)
+                return _fold(acc, outs)
+            return jax.lax.fori_loop(0, iters, body, jnp.int64(0))
+        return loop
+
+    return make, (cell, k1, k2, e1, e2)
+
+
+def merge_packed(cols):
+    cell, k1, k2, e1, e2 = (jnp.asarray(cols[k]) for k in ("cell_id", "k1", "k2", "ex1", "ex2"))
+
+    def make(iters):
+        def loop(cell, k1, k2, e1, e2):
+            def body(i, acc):
+                c = cell ^ (i << 20).astype(jnp.int32)
+                idx = jnp.arange(N, dtype=jnp.int32)
+                key = (c.astype(jnp.int64) << jnp.int64(24)) | idx.astype(jnp.int64)
+                outs = jax.lax.sort((key, k1 ^ i.astype(jnp.uint64), k2, e1, e2),
+                                    num_keys=1, is_stable=False)
+                i_s = (outs[0] & jnp.int64((1 << 24) - 1)).astype(jnp.int32)
+                return _fold(acc, outs[1:] + (i_s,))
+            return jax.lax.fori_loop(0, iters, body, jnp.int64(0))
+        return loop
+
+    return make, (cell, k1, k2, e1, e2)
+
+
+def minute_i64(cols):
+    owner, minute, hashes = (jnp.asarray(cols[k]) for k in ("owner", "minute", "hashes"))
+
+    def make(iters):
+        def loop(owner, minute, hashes):
+            def body(i, acc):
+                key = (owner.astype(jnp.int64) << jnp.int64(32)) | (
+                    (minute ^ i).astype(jnp.uint32).astype(jnp.int64))
+                outs = jax.lax.sort((key, hashes), num_keys=1, is_stable=False)
+                return _fold(acc, outs)
+            return jax.lax.fori_loop(0, iters, body, jnp.int64(0))
+        return loop
+
+    return make, (owner, minute, hashes)
+
+
+def minute_scan(cols):
+    """The r3 GLOBAL formulation (full packed-i64 sort + scan),
+    inlined so it stays comparable after segment_xor2_core moved to
+    tile-local sorting."""
+    from evolu_tpu.ops.merkle_ops import _SENTINEL_HI, segmented_xor_scan
+
+    owner, minute, hashes = (jnp.asarray(cols[k]) for k in ("owner", "minute", "hashes"))
+
+    def make(iters):
+        def loop(owner, minute, hashes):
+            def body(i, acc):
+                key = (owner.astype(jnp.int64) << jnp.int64(32)) | (
+                    (minute ^ i).astype(jnp.uint32).astype(jnp.int64))
+                k_s, h_s = jax.lax.sort(
+                    (key, hashes ^ i.astype(jnp.uint32)), num_keys=1, is_stable=False)
+                hi_s = (k_s >> jnp.int64(32)).astype(jnp.int32)
+                valid = hi_s != jnp.int32(_SENTINEL_HI)
+                change = k_s[1:] != k_s[:-1]
+                seg_start = jnp.concatenate([jnp.ones((1,), bool), change])
+                seg_end = jnp.concatenate([change, jnp.ones((1,), bool)])
+                seg_xor = segmented_xor_scan(seg_start, h_s)
+                return _fold(acc, (hi_s, k_s.astype(jnp.int32), seg_end, seg_xor, valid))
+            return jax.lax.fori_loop(0, iters, body, jnp.int64(0))
+        return loop
+
+    return make, (owner, minute, hashes)
+
+
+def minute_rowsort(cols):
+    """Tile-local grouping: sort a (N/8192, 8192) view row-wise — the
+    r4 production formulation inside segment_xor2_core."""
+    owner, minute, hashes = (jnp.asarray(cols[k]) for k in ("owner", "minute", "hashes"))
+
+    def make(iters):
+        def loop(owner, minute, hashes):
+            def body(i, acc):
+                from evolu_tpu.ops.merkle_ops import segment_xor2_core
+
+                outs = segment_xor2_core(owner, minute ^ i, hashes ^ i.astype(jnp.uint32))
+                return _fold(acc, outs)
+            return jax.lax.fori_loop(0, iters, body, jnp.int64(0))
+        return loop
+
+    return make, (owner, minute, hashes)
+
+
+EXPERIMENTS = {
+    "merge_stable": merge_stable,
+    "merge_packed": merge_packed,
+    "minute_i64": minute_i64,
+    "minute_scan": minute_scan,
+    "minute_rowsort": minute_rowsort,
+}
+
+
+def main():
+    names = sys.argv[1:] or list(EXPERIMENTS)
+    cols = build()
+    out = {}
+    with jax.enable_x64(True):
+        for name in names:
+            try:
+                make, args = EXPERIMENTS[name](cols)
+                per_iter = slope_time(make, args)
+                out[name] = round(per_iter * 1e3, 3)
+            except Exception as e:  # noqa: BLE001 - record and continue
+                out[name] = f"error: {e}"[:200]
+    print(json.dumps({"metric": "sort_experiments_ms_per_iter", "n": N,
+                      "platform": jax.devices()[0].platform, "results": out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -193,16 +193,32 @@ def plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras=(), return_winn
     n = cell_id.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
 
-    # ONE i32 key + stable: within equal cells, stability preserves
-    # batch order — bit-identical to the (cell, idx) 2-key sort (idx is
-    # unique), and measured 28% faster on v5e (1.42 vs 1.96 ms/1M; the
-    # second key costs more than the stable tie-break).
-    sorted_ops = jax.lax.sort(
-        (cell_id, idx, k1, k2, ex_k1, ex_k2) + tuple(extras),
-        num_keys=1, is_stable=True,
-    )
-    c, i_s, s1, s2, e1, e2 = sorted_ops[:6]
-    extras_sorted = sorted_ops[6:]
+    if n <= 1 << 24:
+        # ONE packed i64 key (cell << 24 | idx), UNSTABLE: the key
+        # total-orders (cell, idx) exactly — idx is unique, so this is
+        # bit-identical to the stable-by-cell sort — and drops both
+        # the stability requirement and the idx payload (recovered
+        # from the key's low bits). Measured r4 on v5e: 0.54 ms/1M
+        # faster than the r3 stable-i32 formulation (itself 28% faster
+        # than the 2-key sort). Cell ids are non-negative (interned,
+        # pad = int32 max), so the packed key sorts pads last.
+        key = (cell_id.astype(jnp.int64) << jnp.int64(24)) | idx.astype(jnp.int64)
+        sorted_ops = jax.lax.sort(
+            (key, k1, k2, ex_k1, ex_k2) + tuple(extras),
+            num_keys=1, is_stable=False,
+        )
+        key_s = sorted_ops[0]
+        c = (key_s >> jnp.int64(24)).astype(jnp.int32)
+        i_s = (key_s & jnp.int64((1 << 24) - 1)).astype(jnp.int32)
+        s1, s2, e1, e2 = sorted_ops[1:5]
+        extras_sorted = sorted_ops[5:]
+    else:  # > 16M rows: idx no longer fits the key's low bits
+        sorted_ops = jax.lax.sort(
+            (cell_id, idx, k1, k2, ex_k1, ex_k2) + tuple(extras),
+            num_keys=1, is_stable=True,
+        )
+        c, i_s, s1, s2, e1, e2 = sorted_ops[:6]
+        extras_sorted = sorted_ops[6:]
 
     seg_start = jnp.concatenate([jnp.ones((1,), bool), c[1:] != c[:-1]])
 

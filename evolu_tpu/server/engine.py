@@ -88,8 +88,12 @@ def _merkle_shard_kernel_compact(k1, node, owner_ix, cap):
     valid = owner_ix >= 0
     millis, counter = unpack_ts_keys(k1)
     hashes = jnp.where(valid, timestamp_hashes(millis, counter, node), jnp.uint32(0))
+    # tile_local=False: the compaction cap is budgeted against DISTINCT
+    # (owner, minute) keys; tile partials would multiply seg_count by
+    # up to shard_size/8192 and flip realistic workloads into the
+    # full-pull fallback (r4 review finding).
     owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted = owner_minute_segments(
-        owner_ix, millis, hashes, valid
+        owner_ix, millis, hashes, valid, tile_local=False
     )
     is_seg = seg_end & valid_sorted
     packed = (owner_sorted.astype(jnp.uint64) << jnp.uint64(32)) | minute_sorted.astype(

@@ -247,6 +247,68 @@ class TestDeviceMerkle:
         deltas = minute_deltas_to_dict(*merkle_minute_deltas(millis, counter, node, mask))
         assert deltas == {}
 
+    def test_tile_local_grouping_matches_sequential_inserts(self):
+        """r4: at tiling lengths (N % 8192 == 0, N >= 16384) the
+        grouping sort runs row-wise over (N/8192, 8192) tiles; a
+        minute spanning tiles emits one partial delta per tile and the
+        decoders XOR-merge them. End tree must equal sequential
+        reference inserts — including minutes engineered to straddle
+        tile junctions and equal keys meeting at a junction (which
+        fuse back into one flat segment)."""
+        from evolu_tpu.ops.merkle_ops import _GROUP_TILE
+
+        rng = random.Random(77)
+        n = 2 * _GROUP_TILE
+        # Few distinct minutes ⇒ every minute spans both tiles; some
+        # rows masked; a handful of distinct nodes.
+        ts = []
+        for i in range(n):
+            millis = 60000 * rng.randrange(5) + rng.randrange(60000)
+            ts.append(Timestamp(millis, rng.randrange(10), f"{rng.randrange(1, 50):016x}"))
+        millis = np.array([t.millis for t in ts], np.int64)
+        counter = np.array([t.counter for t in ts], np.int32)
+        node = np.array([node_hex_to_u64(t.node) for t in ts], np.uint64)
+        mask = np.array([rng.random() < 0.8 for _ in ts], bool)
+
+        outs = merkle_minute_deltas(millis, counter, node, mask)
+        # The tile path must actually have run: more raw seg-end rows
+        # than distinct minutes proves block-local partials exist.
+        ends = int((np.asarray(outs[1]) & np.asarray(outs[3])).sum())
+        distinct = len({t.millis // 60000 for t, m in zip(ts, mask) if m})
+        assert ends > distinct, "expected tile-local partial segments"
+
+        got = apply_prefix_xors(create_initial_merkle_tree(), minute_deltas_to_dict(*outs))
+        want = create_initial_merkle_tree()
+        for i, t in enumerate(ts):
+            if bool(mask[i]):
+                want = insert_into_merkle_tree(t, want)
+        assert merkle_tree_to_string(got) == merkle_tree_to_string(want)
+
+    def test_tile_junction_fusion_all_valid(self):
+        """All rows valid, ONE minute: each tile sorts to a single run
+        of the same key, and the junction between tiles has equal keys
+        on both sides — the flat boundary test must FUSE them (one
+        segment, one seg_end) and the scan must carry across the
+        reshape seam. A per-tile scan reset or a forced tile-start
+        boundary would both fail here."""
+        from evolu_tpu.core.murmur import to_int32
+        from evolu_tpu.ops.merkle_ops import _GROUP_TILE
+
+        n = 2 * _GROUP_TILE
+        millis = np.full(n, 120000, np.int64)  # one minute, every row
+        counter = np.arange(n, dtype=np.int32) % 16
+        node = (np.arange(n, dtype=np.uint64) % 7) + 1
+        mask = np.ones(n, bool)
+        lo_s, seg_end, seg_xor, valid = merkle_minute_deltas(millis, counter, node, mask)
+        ends = np.asarray(seg_end) & np.asarray(valid)
+        assert int(ends.sum()) == 1, "equal keys at the junction must fuse"
+        deltas = minute_deltas_to_dict(lo_s, seg_end, seg_xor, valid)
+        want = 0
+        for i in range(n):
+            t = Timestamp(120000, int(counter[i]), f"{int(node[i]):016x}")
+            want ^= timestamp_to_hash(t)
+        assert list(deltas.values()) == [to_int32(want)]
+
 
 class TestDevicePlannerEndState:
     def test_sqlite_end_state_matches_sequential_oracle(self):
