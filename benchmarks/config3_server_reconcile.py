@@ -17,6 +17,7 @@ whole server path a pod would run. Prints one JSON line.
 import json
 import os
 import random
+import statistics
 import sys
 import time
 
@@ -37,6 +38,10 @@ OWNERS = int(os.environ.get("CONFIG3_OWNERS", 1000))
 SHARDS = int(os.environ.get("CONFIG3_SHARDS", 8))
 COLD = int(os.environ.get("CONFIG3_COLD", 25))
 BATCHES = int(os.environ.get("CONFIG3_BATCHES", 8))
+# Robust protocol for tunnel-noisy end-to-end runs (VERDICT r3 weak
+# #2): repeated same-process trials on fresh stores, MEDIAN as the
+# statistic, full spread reported. TPU runs use >= 5.
+TRIALS = int(os.environ.get("CONFIG3_TRIALS", 1))
 
 
 def _ciphertext_pool(size=8192):
@@ -91,11 +96,17 @@ def main():
     warm = BatchReconciler(ShardedRelayStore(shards=SHARDS))
     warm.reconcile(build_requests(pool=pool))
 
-    store = ShardedRelayStore(shards=SHARDS)
-    engine = BatchReconciler(store, warm.mesh)
-    t0 = time.perf_counter()
-    responses = engine.reconcile(requests)
-    elapsed = time.perf_counter() - t0
+    one_shot_rates = []
+    store = engine = responses = None
+    for _ in range(TRIALS):
+        if store is not None:
+            engine.close()
+            store.close()
+        store = ShardedRelayStore(shards=SHARDS)
+        engine = BatchReconciler(store, warm.mesh)
+        t0 = time.perf_counter()
+        responses = engine.reconcile(requests)
+        one_shot_rates.append(n_msgs / (time.perf_counter() - t0))
     assert all(r.messages == () for r in responses), "steady state must answer empty"
 
     # Spot-check: per-request sync on a fresh store gives the same tree.
@@ -129,11 +140,17 @@ def main():
     batches = [requests[i : i + per] for i in range(0, len(requests), per)]
     warm2 = BatchReconciler(ShardedRelayStore(shards=SHARDS), warm.mesh)
     warm2.reconcile_stream(batches)  # jit-warm the per-batch bucket shapes
-    pipe_store = ShardedRelayStore(shards=SHARDS)
-    pipe_engine = BatchReconciler(pipe_store, warm.mesh)
-    t2 = time.perf_counter()
-    pipe_engine.reconcile_stream(batches)
-    pipe_elapsed = time.perf_counter() - t2
+    pipe_rates = []
+    pipe_store = pipe_engine = None
+    for _ in range(TRIALS):
+        if pipe_store is not None:
+            pipe_engine.close()
+            pipe_store.close()
+        pipe_store = ShardedRelayStore(shards=SHARDS)
+        pipe_engine = BatchReconciler(pipe_store, warm.mesh)
+        t2 = time.perf_counter()
+        pipe_engine.reconcile_stream(batches)
+        pipe_rates.append(n_msgs / (time.perf_counter() - t2))
 
     def dump(s):
         out = []
@@ -144,16 +161,25 @@ def main():
 
     assert dump(pipe_store) == dump(store), "pipelined end state diverged"
 
+    def stats(rates):
+        return {
+            "median": round(statistics.median(rates)),
+            "min": round(min(rates)), "max": round(max(rates)),
+            "trials": [round(r) for r in rates],
+        }
+
     print(json.dumps({
         "metric": "config3_server_reconcile_msgs_per_sec",
-        "value": round(n_msgs / min(elapsed, pipe_elapsed)),
+        # Headline = the better MODE by median-of-trials; the spread
+        # rides in detail (never "best observed" — VERDICT r3 weak #2).
+        "value": round(max(statistics.median(one_shot_rates),
+                           statistics.median(pipe_rates))),
         "unit": "msgs/sec",
         "detail": {
             "messages": n_msgs, "owners": len(requests), "stored": stored,
-            "elapsed_s": round(elapsed, 3),
-            "one_shot_msgs_per_sec": round(n_msgs / elapsed),
-            "pipelined_msgs_per_sec": round(n_msgs / pipe_elapsed),
-            "pipelined_elapsed_s": round(pipe_elapsed, 3),
+            "protocol": f"median of {TRIALS} same-process trials, fresh stores",
+            "one_shot": stats(one_shot_rates),
+            "pipelined": stats(pipe_rates),
             "pipeline_batches": len(batches),
             "devices": engine.mesh.devices.size,
             "storage_shards": SHARDS,

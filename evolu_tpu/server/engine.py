@@ -868,3 +868,214 @@ class BatchReconciler:
                 ts = tree_strings[r.user_id] = merkle_tree_to_string(tree)
             responses.append(protocol.SyncResponse(messages, ts))
         return responses
+
+
+# -- pod-scale multi-process reconcile (VERDICT r3 #3) --
+#
+# The reference deploys one relay process (apps/server/src/index.ts:
+# 224-248); the BASELINE "one pod pass" north star describes the same
+# server at pod scale. `reconcile_pod` runs the WHOLE server across a
+# jax.distributed cluster: storage is partitioned by a stable owner →
+# process hash (an owner's history always lives on one process's
+# shards), while the device Merkle leg is ONE SPMD dispatch over the
+# GLOBAL mesh — every process participates, feeds only its addressable
+# shards, and the XOR digest all-reduce makes the whole-batch digest
+# visible pod-wide. Owners are only ever laid out on their OWNING
+# process's addressable shards, so each process decodes exactly the
+# deltas its stores need — no cross-process delta traffic (the DCN
+# carries collectives, not rows).
+
+
+def owner_process(user_id: str, nproc: int) -> int:
+    """Stable owner → process assignment (crc32, like
+    ShardedRelayStore.shard_index): storage ownership must survive
+    across batches, so it cannot depend on per-batch load."""
+    import zlib
+
+    return zlib.crc32(user_id.encode("utf-8")) % nproc
+
+
+@with_x64
+def reconcile_pod(
+    mesh: Mesh, store, requests: Sequence[protocol.SyncRequest]
+) -> Tuple[List[Optional[protocol.SyncResponse]], int]:
+    """One pod pass. Call on EVERY process of the cluster with
+    identical `requests` (the ingest fabric broadcasts a batch; each
+    process answers for the owners it stores). Returns (responses,
+    device_digest): `responses` aligns with `requests`, None for
+    requests owned by another process; the digest is the pod-wide XOR
+    over every device-hashed row (pre-dedup — the device hashes
+    optimistically like `reconcile_stream`), replicated to all
+    processes by the all-reduce, so agreement across processes is an
+    end-to-end integrity check of the global dispatch.
+
+    Storage semantics per owner are identical to the single-process
+    `BatchReconciler.reconcile`: in-batch dedup in request order, PK
+    dedup against the store via per-row was-new flags, owners with any
+    duplicate row re-folded host-side from their new rows only, one
+    atomic insert+tree transaction per storage shard. Single-process
+    clusters degenerate to the plain engine semantics exactly (the
+    parity test runs both)."""
+    from evolu_tpu.core.merkle import minute_deltas_host
+
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    n_dev = mesh.devices.size
+
+    # 0) In-batch dedup, request order (deterministic on all processes).
+    seen: set = set()
+    kept: Dict[str, List[protocol.EncryptedCrdtMessage]] = {}
+    for r in requests:
+        for m in r.messages:
+            k = (m.timestamp, r.user_id)
+            if k not in seen:
+                seen.add(k)
+                kept.setdefault(r.user_id, []).append(m)
+    owners = list(kept)  # first-appearance order — identical everywhere
+
+    # 1) One vectorized parse; owners with any non-canonical row take
+    # the host fold on their owning process (device hash re-renders
+    # canonical case — same quarantine rule as deltas_dispatch).
+    flat_ts = [m.timestamp for o in owners for m in kept[o]]
+    spans: Dict[str, slice] = {}
+    pos = 0
+    for o in owners:
+        spans[o] = slice(pos, pos + len(kept[o]))
+        pos += len(kept[o])
+    if flat_ts:
+        all_m, all_c, all_n, case_ok = parse_timestamp_strings(flat_ts, with_case=True)
+    else:
+        all_m = all_c = all_n = case_ok = np.zeros(0, np.int64)
+    good = [o for o in owners if bool(case_ok[spans[o]].all())]
+    good_set = set(good)
+    host_only = [o for o in owners if o not in good_set]
+
+    # 2) Global device layout: each owner lands on a shard of its
+    # OWNING process (per-process LPT over that process's addressable
+    # shard slots) — every process computes the full layout
+    # deterministically, then feeds only its addressable slices.
+    proc_of = {o: owner_process(o, nproc) for o in good}
+    proc_shards: Dict[int, List[int]] = {}
+    for i, d in enumerate(mesh.devices.flat):
+        proc_shards.setdefault(d.process_index, []).append(i)
+    shards_global: List[List[str]] = [[] for _ in range(n_dev)]
+    for p, slots in proc_shards.items():
+        mine = {o: len(kept[o]) for o in good if proc_of[o] == p}
+        for j, owner_list in enumerate(assign_owners_to_shards(mine, len(slots))):
+            shards_global[slots[j]] = owner_list
+    shard_len = max((sum(len(kept[o]) for o in s) for s in shards_global), default=0)
+    shard_size = bucket_size(max(shard_len, 1))
+    total = n_dev * shard_size
+
+    good_ix = {o: i for i, o in enumerate(good)}
+    millis = np.zeros(total, np.int64)
+    counter = np.zeros(total, np.int32)
+    node = np.zeros(total, np.uint64)
+    valid = np.zeros(total, bool)
+    oix = np.zeros(total, np.int64)
+    for si, shard in enumerate(shards_global):
+        p0 = si * shard_size
+        for o in shard:
+            sl_src = spans[o]
+            n = sl_src.stop - sl_src.start
+            sl = slice(p0, p0 + n)
+            millis[sl] = all_m[sl_src]
+            counter[sl] = all_c[sl_src]
+            node[sl] = all_n[sl_src]
+            valid[sl] = True
+            oix[sl] = good_ix[o]
+            p0 += n
+
+    # 3) ONE SPMD dispatch over the global mesh (uniform: `good` is
+    # identical on every process, so either all dispatch or none do).
+    digest = 0
+    by_ix: Dict[int, Dict[str, int]] = {}
+    if good:
+        shd = sharding(mesh)
+        args = [put_sharded(a, shd) for a in (millis, counter, node, valid, oix)]
+        owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, dev_digest = (
+            to_host_many(*_compiled_merkle_kernel(mesh)(*args))
+        )
+        by_ix = decode_owner_minute_deltas(
+            owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted
+        )
+        digest = int(dev_digest)
+
+    # 4) Storage leg — my owners only. Inserts run one worker per
+    # storage shard like `_ingest_packed` (the C calls drop the GIL);
+    # tree math + upserts follow per shard inside the same atomic
+    # transaction window.
+    local = [o for o in good if proc_of[o] == pid]
+    local += [o for o in host_only if owner_process(o, nproc) == pid]
+    eng = BatchReconciler(store, mesh)  # storage/respond helpers only
+    stores, shard_index = eng._shards()
+    per_shard: Dict[int, List[str]] = {}
+    for o in local:
+        per_shard.setdefault(shard_index(o), []).append(o)
+    live = sorted(per_shard)
+    trees: Dict[str, dict] = {}
+    packed_capable = all(hasattr(stores[si].db, "relay_insert_packed") for si in live)
+
+    def insert_shard(si: int):
+        sh_owners = per_shard[si]
+        gu, gc = sh_owners, [len(kept[o]) for o in sh_owners]
+        if packed_capable:
+            ts_list = [m.timestamp for o in sh_owners for m in kept[o]]
+            contents = [m.content for o in sh_owners for m in kept[o]]
+            ts_packed, content_packed, lens = _pack_rows(ts_list, contents)
+            was_new = stores[si].db.relay_insert_packed(
+                gu, gc, ts_packed, content_packed, lens
+            )
+        else:  # stdlib backend: per-row changes==1 flags
+            was_new = np.array([
+                stores[si].db.run(
+                    'INSERT OR IGNORE INTO "message" '
+                    '("timestamp", "userId", "content") VALUES (?, ?, ?)',
+                    (m.timestamp, o, m.content),
+                ) == 1
+                for o in sh_owners
+                for m in kept[o]
+            ], bool)
+        return si, gu, gc, was_new
+
+    with span("kernel:merkle", "reconcile_pod",
+              owners=len(owners), local_owners=len(local),
+              n=len(flat_ts), nproc=nproc):
+        with eng._shard_transactions(stores, live):
+            for si, gu, gc, was_new in eng._map_shards(
+                insert_shard, live, len(stores)
+            ):
+                pos = 0
+                for o, k in zip(gu, gc):
+                    flags = was_new[pos : pos + k]
+                    pos += k
+                    if o in good_ix and bool(flags.all()):
+                        deltas = by_ix.get(good_ix[o], {})
+                    else:
+                        # Duplicates or non-canonical: the exact host
+                        # fold over this owner's NEW rows only.
+                        deltas, _d = minute_deltas_host(
+                            m.timestamp
+                            for m, f in zip(kept[o], flags)
+                            if bool(f)
+                        )
+                    if not deltas:
+                        continue
+                    tree = apply_prefix_xors(stores[si].get_merkle_tree(o), deltas)
+                    trees[o] = tree
+                    stores[si].db.run(
+                        'INSERT OR REPLACE INTO "merkleTree" ("userId", "merkleTree") '
+                        "VALUES (?, ?)",
+                        (o, merkle_tree_to_string(tree)),
+                    )
+    eng.close()
+
+    # 5) Respond for MY requests (message-less cold-sync requests route
+    # by the same stable owner hash).
+    responses: List[Optional[protocol.SyncResponse]] = []
+    for r in requests:
+        if owner_process(r.user_id, nproc) == pid:
+            responses.append(eng._respond([r], trees)[0])
+        else:
+            responses.append(None)
+    return responses, digest

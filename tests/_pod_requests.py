@@ -1,0 +1,50 @@
+"""Deterministic request batches shared by the pod-server worker
+processes AND the in-test single-process reference — the
+broadcast-ingest model requires every process to see the identical
+batch, and the test requires the reference to see it too."""
+
+from evolu_tpu.core.merkle import (
+    apply_prefix_xors,
+    merkle_tree_to_string,
+    minute_deltas_host,
+)
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.sync import protocol
+
+BASE = 1_700_000_000_000
+
+
+def build_batches():
+    """→ (push_batch, cold_batch). The push round: 12 owners push their
+    own new messages with their post-apply trees (steady-state shape,
+    responses empty), incl. one owner with an in-batch duplicate (the
+    was-new recompute path) and one owner split across two requests.
+    The cold round: every owner syncs from a fresh device (empty tree,
+    different node) and must receive its full history."""
+    reqs = []
+    for o in range(12):
+        user = f"owner{o:02d}"
+        msgs = [
+            protocol.EncryptedCrdtMessage(
+                timestamp_to_string(
+                    Timestamp(BASE + (o * 977 + i) * 60_000, i % 4, f"{o + 1:016x}")
+                ),
+                b"ct-%d-%d" % (o, i),
+            )
+            for i in range(6 + o)
+        ]
+        if o == 3:
+            msgs.append(msgs[0])  # in-batch duplicate → was_new=False row
+        deltas, _ = minute_deltas_host(
+            m.timestamp for j, m in enumerate(msgs) if not (o == 3 and j == len(msgs) - 1)
+        )
+        tree = merkle_tree_to_string(apply_prefix_xors({}, deltas))
+        if o == 7:  # one owner split across two requests
+            reqs.append(protocol.SyncRequest(tuple(msgs[:3]), user, "f" * 16, tree))
+            reqs.append(protocol.SyncRequest(tuple(msgs[3:]), user, "f" * 16, tree))
+        else:
+            reqs.append(protocol.SyncRequest(tuple(msgs), user, "f" * 16, tree))
+    cold = tuple(
+        protocol.SyncRequest((), f"owner{o:02d}", "e" * 16, "{}") for o in range(12)
+    )
+    return tuple(reqs), cold

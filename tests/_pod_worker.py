@@ -1,0 +1,45 @@
+"""Worker process for the pod-server test (launched by
+tests/test_multihost_cluster.py, not collected by pytest).
+
+Joins the jax.distributed cluster, builds the SAME request batches as
+every other process (the broadcast-ingest model), runs TWO
+`engine.reconcile_pod` passes over its OWN ShardedRelayStore — a push
+round, then a cold-sync round (empty trees pulling full history) —
+and prints each locally-answered response as base64 protobuf so the
+parent can byte-compare the union against the single-process
+BatchReconciler reference.
+"""
+
+import base64
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+pid, nproc, port, store_dir = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+
+from evolu_tpu.parallel.multihost import initialize_multihost  # noqa: E402
+
+mesh = initialize_multihost(f"127.0.0.1:{port}", nproc, pid)
+
+from evolu_tpu.server import engine  # noqa: E402
+from evolu_tpu.server.relay import ShardedRelayStore  # noqa: E402
+from tests._pod_requests import build_batches  # noqa: E402
+
+push, cold = build_batches()
+store = ShardedRelayStore(f"{store_dir}/proc{pid}", shards=4)
+
+# "replay" re-pushes the identical batch: every row is a store
+# duplicate (was_new all False) → the per-owner host re-fold runs and
+# must leave trees untouched.
+for rnd, batch in (("push", push), ("replay", push), ("cold", cold)):
+    responses, digest = engine.reconcile_pod(mesh, store, batch)
+    for i, resp in enumerate(responses):
+        if resp is not None:
+            from evolu_tpu.sync.protocol import encode_sync_response
+
+            b64 = base64.b64encode(encode_sync_response(resp)).decode()
+            print(f"RESP {rnd} {i} {b64}", flush=True)
+    print(f"DIGEST {rnd} proc={pid} digest=0x{digest & 0xFFFFFFFF:08x}", flush=True)
+
+store.close()
+print(f"proc {pid}: OK", flush=True)

@@ -24,6 +24,84 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def test_pod_server_across_two_processes(tmp_path):
+    """VERDICT r3 #3: the WHOLE server — BatchReconciler semantics +
+    ShardedRelayStore — spanning a 2-process jax.distributed cluster
+    (engine.reconcile_pod): storage partitioned by the stable owner
+    hash, the device Merkle leg one SPMD dispatch over the global
+    8-device mesh, digest all-reduced pod-wide. Every request must be
+    answered by exactly one process, and the union of responses must
+    be BYTE-equal (encoded protobuf) to the single-process
+    BatchReconciler reference for both a push round and a cold-sync
+    round (full-history pull)."""
+    import base64
+
+    from evolu_tpu.server.engine import BatchReconciler
+    from evolu_tpu.server.relay import ShardedRelayStore
+    from evolu_tpu.sync.protocol import encode_sync_response
+    from tests._pod_requests import build_batches
+
+    port = _free_port()
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    worker = Path(__file__).resolve().parent / "_pod_worker.py"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), "2", str(port), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert f"proc {i}: OK" in out, out
+
+    # Single-process reference over the same batches.
+    push, cold = build_batches()
+    ref_store = ShardedRelayStore(str(tmp_path / "ref"), shards=4)
+    eng = BatchReconciler(ref_store)
+    try:
+        ref = {
+            "push": eng.reconcile(push),
+            "replay": eng.reconcile(push),  # store-duplicate round
+            "cold": eng.reconcile(cold),
+        }
+    finally:
+        eng.close(), ref_store.close()
+
+    got: dict = {"push": {}, "replay": {}, "cold": {}}
+    digests: dict = {"push": [], "replay": [], "cold": []}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESP "):
+                _, rnd, i, b64 = line.split()
+                assert int(i) not in got[rnd], f"request {i} answered twice"
+                got[rnd][int(i)] = base64.b64decode(b64)
+            elif line.startswith("DIGEST "):
+                _, rnd, _pid, dg = line.split()
+                digests[rnd].append(dg)
+    for rnd, reqs in (("push", push), ("replay", push), ("cold", cold)):
+        assert sorted(got[rnd]) == list(range(len(reqs))), (
+            f"{rnd}: every request answered exactly once"
+        )
+        for i, resp in enumerate(ref[rnd]):
+            assert got[rnd][i] == encode_sync_response(resp), (
+                f"{rnd} request {i}: pod response != single-process reference"
+            )
+        assert len(set(digests[rnd])) == 1, f"{rnd}: digests diverged {digests[rnd]}"
+
+
 def test_two_process_cluster_reconcile():
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
