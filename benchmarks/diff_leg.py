@@ -1,0 +1,141 @@
+"""The diff/response leg, isolated (VERDICT r3 missing #3 / next #6).
+
+SURVEY §7's build plan floated a device-side Merkle diff
+("argmin over diverging prefixes"); `core.merkle.diff_merkle_trees` is
+a host Python walk (reference packages/evolu/src/merkleTree.ts:63-91).
+This measures whether that walk — and the whole per-request response
+leg at 1k DIVERGENT owners (the cold-ish worst case: every client is
+missing the second half of its history) — is worth device work.
+
+Components timed separately over the same store and requests:
+  tree_read    — merkleTree row fetch + JSON parse per owner
+  diff         — diff_merkle_trees(server, client) per owner
+  fetch        — the `timestamp > since` SQL read + message decode
+  respond_full — the real engine._respond (all of the above + protobuf)
+
+Prints one JSON line; conclusions live in docs/BENCHMARKS.md.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.core.merkle import (
+    apply_prefix_xors,
+    diff_merkle_trees,
+    merkle_tree_from_string,
+    merkle_tree_to_string,
+    minute_deltas_host,
+)
+from evolu_tpu.server.engine import BatchReconciler
+from evolu_tpu.server.relay import ShardedRelayStore
+from evolu_tpu.sync import protocol
+from benchmarks.config3_server_reconcile import build_requests, _ciphertext_pool
+
+N = int(os.environ.get("DIFF_N", 1_000_000))
+OWNERS = int(os.environ.get("DIFF_OWNERS", 1000))
+SHARDS = int(os.environ.get("DIFF_SHARDS", 8))
+TRIALS = int(os.environ.get("DIFF_TRIALS", 3))
+
+
+def timed(fn):
+    rates = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        out = fn()
+        rates.append(time.perf_counter() - t0)
+    return out, statistics.median(rates)
+
+
+def main():
+    pool = _ciphertext_pool()
+    requests = build_requests(n=N, owners=OWNERS, pool=pool)
+    store = ShardedRelayStore(shards=SHARDS)
+    engine = BatchReconciler(store)
+    engine.reconcile(requests)  # populate: 1M rows, 1k owner trees
+
+    # Divergent clients: each knows only the first half of its history.
+    divergent = []
+    for r in requests:
+        half = sorted(m.timestamp for m in r.messages)[: len(r.messages) // 2]
+        deltas, _ = minute_deltas_host(iter(half))
+        client_tree = merkle_tree_to_string(apply_prefix_xors({}, deltas))
+        divergent.append(protocol.SyncRequest((), r.user_id, "e" * 16, client_tree))
+
+    server_trees = {}
+    client_trees = {}
+
+    def tree_read():
+        for r in divergent:
+            server_trees[r.user_id] = store.get_merkle_tree(r.user_id)
+            client_trees[r.user_id] = merkle_tree_from_string(r.merkle_tree)
+        return None
+
+    _, t_tree = timed(tree_read)
+
+    def diff_only():
+        return [
+            diff_merkle_trees(server_trees[r.user_id], client_trees[r.user_id])
+            for r in divergent
+        ]
+
+    diffs, t_diff = timed(diff_only)
+    assert all(d is not None for d in diffs), "every owner must diverge"
+
+    def fetch_only():
+        total = 0
+        for r in divergent:
+            total += len(
+                store.get_messages(
+                    r.user_id, r.node_id,
+                    server_trees[r.user_id], client_trees[r.user_id],
+                )
+            )
+        return total
+
+    n_fetched, t_fetch = timed(fetch_only)
+
+    def respond_full():
+        # Empty trees dict = the cold-sync shape: _respond reads the
+        # STORED tree strings (r4 path — no parse→re-dump round-trip).
+        return engine._respond(divergent, {})
+
+    responses, t_full = timed(respond_full)
+    n_resp = sum(len(r.messages) for r in responses)
+    assert n_resp == n_fetched
+
+    # The server-pass yardstick: one full reconcile of the same 1M-push
+    # batch on a fresh store (the thing the VERDICT's >=5% is against).
+    fresh = ShardedRelayStore(shards=SHARDS)
+    eng2 = BatchReconciler(fresh, engine.mesh)
+    t0 = time.perf_counter()
+    eng2.reconcile(requests)
+    t_pass = time.perf_counter() - t0
+
+    print(json.dumps({
+        "metric": "diff_response_leg_ms_per_1k_divergent_owners",
+        "value": round(t_full * 1e3, 1),
+        "unit": "ms",
+        "detail": {
+            "owners": len(divergent), "rows": N,
+            "messages_served": n_resp,
+            "tree_read_ms": round(t_tree * 1e3, 1),
+            "diff_ms": round(t_diff * 1e3, 1),
+            "fetch_ms": round(t_fetch * 1e3, 1),
+            "respond_full_ms": round(t_full * 1e3, 1),
+            "diff_us_per_owner": round(t_diff * 1e6 / len(divergent), 1),
+            "server_pass_ms": round(t_pass * 1e3, 1),
+            "diff_pct_of_pass": round(100 * t_diff / t_pass, 2),
+            "respond_pct_of_pass": round(100 * t_full / t_pass, 2),
+            "trials": TRIALS,
+        },
+    }))
+    store.close(), fresh.close(), engine.close(), eng2.close()
+
+
+if __name__ == "__main__":
+    main()

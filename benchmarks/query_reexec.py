@@ -1,0 +1,88 @@
+"""Hot loop #4: reactive query re-execution (VERDICT r3 next #7;
+reference packages/evolu/src/query.ts:31-76 re-runs every subscribed
+query after each mutation and diffs rows with rfc6902 createPatch).
+
+Measures a 10k-row subscribed query's per-cycle cost in three shapes:
+  per_cell   — the pre-r4 path (per-cell ctypes column reads + diff)
+  unchanged  — r4 production steady state: packed raw read + byte
+               compare, no dict materialization, no diff
+  changed    — r4 production when the result set changed: packed raw
+               read + unpack + rfc6902 diff
+
+Prints one JSON line; conclusions live in docs/BENCHMARKS.md.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.api.query import table
+from evolu_tpu.runtime.client import create_evolu
+from evolu_tpu.runtime.jsonpatch import create_patch
+import evolu_tpu.runtime.messages as msg_mod
+
+ROWS = int(os.environ.get("QUERY_ROWS", 10_000))
+REPS = int(os.environ.get("QUERY_REPS", 20))
+
+
+def med(fn):
+    ts = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts) * 1e3
+
+
+def main():
+    e = create_evolu({"todo": ("title", "done")}, db_path=":memory:")
+    with e.batching():
+        for i in range(ROWS):
+            e.create("todo", {"title": f"item {i:06d}", "done": 0})
+    e.worker.flush()
+    q = table("todo").select("id", "title", "done").order_by("title").serialize()
+    sql, params = msg_mod.deserialize_query(q)
+    w = e.worker
+    rows = e.query_once(q)
+    raw_capable = hasattr(w.db, "exec_sql_query_packed_raw")
+
+    out = {"rows": ROWS, "raw_capable": raw_capable}
+    if raw_capable:
+        from evolu_tpu.storage.native import unpack_packed_rows
+
+        raw = w.db.exec_sql_query_packed_raw(sql, params)
+        out["raw_read_ms"] = round(med(
+            lambda: w.db.exec_sql_query_packed_raw(sql, params)), 2)
+        out["unchanged_cycle_ms"] = round(med(
+            lambda: w.db.exec_sql_query_packed_raw(sql, params) == raw), 2)
+        fresh = unpack_packed_rows(raw)
+        out["unpack_ms"] = round(med(lambda: unpack_packed_rows(raw)), 2)
+        out["diff_ms"] = round(med(lambda: create_patch(rows, fresh)), 2)
+        out["changed_cycle_ms"] = round(
+            out["raw_read_ms"] + out["unpack_ms"] + out["diff_ms"], 2)
+
+    def per_cell():
+        with w.db._lock:
+            r, c = w.db._execute(sql, params)
+            return [dict(zip(c, row)) for row in r]
+
+    if hasattr(w.db, "_execute"):
+        prev = per_cell()
+        out["per_cell_cycle_ms"] = round(
+            med(per_cell) + med(lambda: create_patch(prev, prev)), 2)
+
+    print(json.dumps({
+        "metric": "query_reexec_unchanged_cycle_ms",
+        "value": out.get("unchanged_cycle_ms"),
+        "unit": "ms",
+        "detail": out,
+    }))
+    e.dispose()
+
+
+if __name__ == "__main__":
+    main()

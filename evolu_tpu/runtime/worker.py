@@ -172,9 +172,16 @@ class DbWorker:
         self.sync_lock = sync_lock or get_sync_lock(db.path)
         self.owner: Optional[Owner] = None
         self.queries_rows_cache: Dict[str, List[dict]] = {}
+        # Raw packed result bytes per query — the change detector for
+        # the reactive loop; lifecycle mirrors queries_rows_cache
+        # exactly (staged per command, committed on success, evicted
+        # and cleared together — a desynced pair would suppress or
+        # duplicate patches).
+        self.queries_raw_cache: Dict[str, bytes] = {}
         self._planner = select_planner(self.config, self.db)
         self._staged_effects: List = []
         self._staged_cache: Dict[str, List[dict]] = {}
+        self._staged_raw: Dict[str, bytes] = {}
         self._queue: "queue.Queue[object]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._stop = object()
@@ -251,6 +258,7 @@ class DbWorker:
         and surface as OnError (db.worker.ts:57-73)."""
         self._staged_effects = []
         self._staged_cache: Dict[str, List[dict]] = {}
+        self._staged_raw: Dict[str, bytes] = {}
         try:
             from contextlib import nullcontext
 
@@ -269,6 +277,7 @@ class DbWorker:
                 elif isinstance(command, msg.EvictQueries):
                     for q in command.queries:
                         self.queries_rows_cache.pop(q, None)
+                        self.queries_raw_cache.pop(q, None)
                 elif isinstance(command, msg.Sync):
                     self._sync(command)
                 elif isinstance(command, msg.UpdateDbSchema):
@@ -302,6 +311,7 @@ class DbWorker:
                 # fire; dropping them would hide committed state until
                 # some later command happens to emit.
                 self.queries_rows_cache.update(self._staged_cache)
+                self.queries_raw_cache.update(self._staged_raw)
                 self._flush_staged_effects()
             try:
                 self.on_output(msg.OnError(e))
@@ -311,6 +321,7 @@ class DbWorker:
                 pass
             return
         self.queries_rows_cache.update(self._staged_cache)
+        self.queries_raw_cache.update(self._staged_raw)
         self._flush_staged_effects()
 
     def _flush_staged_effects(self) -> None:
@@ -458,11 +469,37 @@ class DbWorker:
         )
 
     def _query(self, queries: Sequence[str], on_complete_ids: Sequence[str] = ()) -> None:
-        """query.ts:16-76: run, diff vs cache, post non-empty patches."""
+        """query.ts:16-76: run, diff vs cache, post non-empty patches.
+
+        With the packed reader (C++ backend), the raw result bytes are
+        the change detector: a subscribed query whose bytes match the
+        cached bytes skips dict materialization AND the rfc6902 diff
+        entirely — the dominant cost of the reactive re-execution loop
+        (SURVEY hot loop #4; measured r4: ~65 ms per 10k-row query on
+        the per-cell path vs ~4 ms raw read + compare). Byte equality
+        is EXACT here, not approximate: the only value whose
+        deep-equality differs from bit-equality is REAL NaN, and
+        SQLite converts NaN to NULL at bind time so no queried row can
+        hold one (pinned in test_runtime.py; -0.0→0.0 rewrites emit a
+        patch the deep-equal would skip — a real write happened, so
+        the extra patch is harmless)."""
         patches = []
+        raw_capable = hasattr(self.db, "exec_sql_query_packed_raw")
         for q in queries:
             sql, parameters = msg.deserialize_query(q)
-            rows = self.db.exec_sql_query(sql, parameters)
+            if raw_capable:
+                from evolu_tpu.storage.native import unpack_packed_rows
+
+                raw = self.db.exec_sql_query_packed_raw(sql, parameters)
+                prev_raw = self._staged_raw.get(q, self.queries_raw_cache.get(q))
+                cached = q in self._staged_cache or q in self.queries_rows_cache
+                if cached and prev_raw == raw:
+                    self._staged_raw[q] = raw
+                    continue  # unchanged — no parse, no diff, no patch
+                rows = unpack_packed_rows(raw)
+                self._staged_raw[q] = raw
+            else:
+                rows = self.db.exec_sql_query(sql, parameters)
             prev = self._staged_cache.get(q, self.queries_rows_cache.get(q, []))
             ops = create_patch(prev, rows)
             self._staged_cache[q] = rows
@@ -493,11 +530,15 @@ class DbWorker:
         if cache is not None:
             cache.reset()
 
+    def _clear_query_caches(self) -> None:
+        self.queries_rows_cache.clear()
+        self.queries_raw_cache.clear()
+
     def _reset_owner(self) -> None:
         """resetOwner.ts:7-21."""
         delete_all_tables(self.db)
         self._drop_winner_cache()
-        self._staged_effects.append(self.queries_rows_cache.clear)
+        self._staged_effects.append(self._clear_query_caches)
         self._emit(msg.ReloadAllTabs())
 
     def _restore_owner(self, mnemonic: str) -> None:
@@ -505,6 +546,6 @@ class DbWorker:
         via the first sync against the relay (SURVEY.md §3.5)."""
         delete_all_tables(self.db)
         self._drop_winner_cache()
-        self._staged_effects.append(self.queries_rows_cache.clear)
+        self._staged_effects.append(self._clear_query_caches)
         self.owner = init_db_model(self.db, mnemonic)
         self._emit(msg.ReloadAllTabs())

@@ -414,9 +414,10 @@ class BatchReconciler:
         End state is identical to running `store.sync` per request."""
         from evolu_tpu.server.relay import ShardedRelayStore
 
+        strings: Dict[str, str] = {}
         if isinstance(self.store, ShardedRelayStore):
             if all(hasattr(s.db, "relay_insert_packed") for s in self.store.shards):
-                trees = self._ingest_packed(requests)
+                trees = self._ingest_packed(requests, strings)
             else:
                 # Sharded python-backend: per-request per-shard path.
                 trees = {
@@ -424,10 +425,10 @@ class BatchReconciler:
                     for r in requests
                 }
         elif hasattr(self.store.db, "relay_insert_packed"):
-            trees = self._ingest_packed(requests)
+            trees = self._ingest_packed(requests, strings)
         else:
-            trees = self._ingest_generic(requests)
-        return self._respond(requests, trees)
+            trees = self._ingest_generic(requests, strings)
+        return self._respond(requests, trees, strings)
 
     def _shards(self):
         from evolu_tpu.server.relay import ShardedRelayStore
@@ -505,7 +506,7 @@ class BatchReconciler:
             self._pull_pool.shutdown(wait=True)
             self._pull_pool = None
 
-    def _ingest_packed(self, requests) -> Dict[str, dict]:
+    def _ingest_packed(self, requests, tree_strings=None) -> Dict[str, dict]:
         """The packed columnar ingest. Per storage shard: pack the
         shard's timestamps and ciphertexts into flat buffers and INSERT
         OR IGNORE them in ONE native call (the PK dedups, including
@@ -581,7 +582,10 @@ class BatchReconciler:
                 si = shard_index(o)
                 tree = apply_prefix_xors(stores[si].get_merkle_tree(o), deltas)
                 trees[o] = tree
-                tree_rows[si].append((o, merkle_tree_to_string(tree)))
+                s = merkle_tree_to_string(tree)
+                if tree_strings is not None:
+                    tree_strings[o] = s  # respond reuses the upsert's dump
+                tree_rows[si].append((o, s))
             for si in live:
                 if tree_rows[si]:
                     stores[si].db.run_many(
@@ -702,8 +706,9 @@ class BatchReconciler:
         stores, shard_index = self._shards()
         live, shard_data = st["live"], st["shard_data"]
         trees: Dict[str, dict] = {}
+        strings: Dict[str, str] = {}
         if not live:
-            return self._respond(st["requests"], trees)
+            return self._respond(st["requests"], trees, strings)
 
         def ingest_shard(si: int):
             gu, gc, ts_packed, content_packed, lens = shard_data[si]
@@ -730,7 +735,9 @@ class BatchReconciler:
                     si = shard_index(o)
                     tree = apply_prefix_xors(stores[si].get_merkle_tree(o), deltas)
                     trees[o] = tree
-                    tree_rows[si].append((o, merkle_tree_to_string(tree)))
+                    s = merkle_tree_to_string(tree)
+                    strings[o] = s
+                    tree_rows[si].append((o, s))
                 for si in live:
                     if tree_rows[si]:
                         stores[si].db.run_many(
@@ -738,7 +745,7 @@ class BatchReconciler:
                             "VALUES (?, ?)",
                             tree_rows[si],
                         )
-        return self._respond(st["requests"], trees)
+        return self._respond(st["requests"], trees, strings)
 
     def _recompute_duplicate_owners(self, st, was_new_by_shard, deltas_by_owner) -> None:
         """The device hashed every row; owners where some rows were
@@ -815,7 +822,7 @@ class BatchReconciler:
             out.append(self.finish_batch(prev))
         return out
 
-    def _ingest_generic(self, requests) -> Dict[str, dict]:
+    def _ingest_generic(self, requests, tree_strings=None) -> Dict[str, dict]:
         """Python-backend fallback: temp-table set-diff + bulk SQL."""
         new_by_owner = self._new_messages(requests)
 
@@ -844,23 +851,43 @@ class BatchReconciler:
             for o, deltas in deltas_by_owner.items():
                 tree = apply_prefix_xors(self.store.get_merkle_tree(o), deltas)
                 trees[o] = tree
+                s = merkle_tree_to_string(tree)
+                if tree_strings is not None:
+                    tree_strings[o] = s
                 db.run(
                     'INSERT OR REPLACE INTO "merkleTree" ("userId", "merkleTree") VALUES (?, ?)',
-                    (o, merkle_tree_to_string(tree)),
+                    (o, s),
                 )
         return trees
 
-    def _respond(self, requests, trees: Dict[str, dict]) -> List[protocol.SyncResponse]:
-        """Standard diff per request against the updated trees."""
+    def _respond(
+        self, requests, trees: Dict[str, dict],
+        tree_strings: Optional[Dict[str, str]] = None,
+    ) -> List[protocol.SyncResponse]:
+        """Standard diff per request against the updated trees.
+
+        `tree_strings` carries serializations the ingest already
+        computed for the merkleTree upsert; owners not in `trees`
+        (no new rows this batch — the cold-sync shape) read the STORED
+        string verbatim and parse it once for the diff, never
+        re-dumping. The parse→re-dump round-trip (~1.25 ms per
+        realistic owner tree) was the measured respond wall at 1k
+        divergent owners (docs/BENCHMARKS.md r4)."""
         from evolu_tpu.core.merkle import merkle_tree_from_string
 
         responses = []
-        tree_strings: Dict[str, str] = {}
+        tree_strings = dict(tree_strings or {})
         for r in requests:
             tree = trees.get(r.user_id)
             if tree is None:
-                tree = self.store.get_merkle_tree(r.user_id)
+                if hasattr(self.store, "get_merkle_tree_string"):
+                    raw = self.store.get_merkle_tree_string(r.user_id)
+                    tree = merkle_tree_from_string(raw)
+                else:
+                    tree = self.store.get_merkle_tree(r.user_id)
+                    raw = merkle_tree_to_string(tree)
                 trees[r.user_id] = tree
+                tree_strings.setdefault(r.user_id, raw)
             client_tree = merkle_tree_from_string(r.merkle_tree)
             messages = self.store.get_messages(r.user_id, r.node_id, tree, client_tree)
             ts = tree_strings.get(r.user_id)
@@ -1014,6 +1041,7 @@ def reconcile_pod(
         per_shard.setdefault(shard_index(o), []).append(o)
     live = sorted(per_shard)
     trees: Dict[str, dict] = {}
+    tree_strings: Dict[str, str] = {}
     packed_capable = all(hasattr(stores[si].db, "relay_insert_packed") for si in live)
 
     def insert_shard(si: int):
@@ -1063,10 +1091,12 @@ def reconcile_pod(
                         continue
                     tree = apply_prefix_xors(stores[si].get_merkle_tree(o), deltas)
                     trees[o] = tree
+                    s = merkle_tree_to_string(tree)
+                    tree_strings[o] = s
                     stores[si].db.run(
                         'INSERT OR REPLACE INTO "merkleTree" ("userId", "merkleTree") '
                         "VALUES (?, ?)",
-                        (o, merkle_tree_to_string(tree)),
+                        (o, s),
                     )
     eng.close()
 
@@ -1075,7 +1105,7 @@ def reconcile_pod(
     responses: List[Optional[protocol.SyncResponse]] = []
     for r in requests:
         if owner_process(r.user_id, nproc) == pid:
-            responses.append(eng._respond([r], trees)[0])
+            responses.append(eng._respond([r], trees, tree_strings)[0])
         else:
             responses.append(None)
     return responses, digest

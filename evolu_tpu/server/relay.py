@@ -24,7 +24,6 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from evolu_tpu.core.merkle import (
     apply_prefix_xors,
-    create_initial_merkle_tree,
     diff_merkle_trees,
     merkle_tree_from_string,
     merkle_tree_to_string,
@@ -86,13 +85,10 @@ class RelayStore:
         )
 
     def get_merkle_tree(self, user_id: str) -> dict:
-        """index.ts:121-136 — a user's tree, empty if unseen."""
-        rows = self.db.exec_sql_query(
-            'SELECT "merkleTree" FROM "merkleTree" WHERE "userId" = ?', (user_id,)
-        )
-        if not rows:
-            return create_initial_merkle_tree()
-        return merkle_tree_from_string(rows[0]["merkleTree"])
+        """index.ts:121-136 — a user's tree, empty if unseen.
+        ('{}' parses to create_initial_merkle_tree(); ONE SELECT lives
+        in get_merkle_tree_string — keep them from diverging.)"""
+        return merkle_tree_from_string(self.get_merkle_tree_string(user_id))
 
     def add_messages(
         self, user_id: str, messages: Sequence[protocol.EncryptedCrdtMessage]
@@ -157,6 +153,15 @@ class RelayStore:
             protocol.EncryptedCrdtMessage(r["timestamp"], r["content"]) for r in rows
         )
 
+    def get_merkle_tree_string(self, user_id: str) -> str:
+        """The stored tree TEXT verbatim — response paths reuse it
+        instead of parse→re-dump (a ~25KB JSON round-trip per owner is
+        the measured cold-sync respond wall, docs/BENCHMARKS.md r4)."""
+        rows = self.db.exec_sql_query(
+            'SELECT "merkleTree" FROM "merkleTree" WHERE "userId" = ?', (user_id,)
+        )
+        return rows[0]["merkleTree"] if rows else "{}"
+
     def sync(self, request: protocol.SyncRequest) -> protocol.SyncResponse:
         """The pure pipeline (index.ts:204-216)."""
         tree = self.add_messages(request.user_id, request.messages)
@@ -200,6 +205,9 @@ class ShardedRelayStore:
 
     def get_merkle_tree(self, user_id: str) -> dict:
         return self.shard_of(user_id).get_merkle_tree(user_id)
+
+    def get_merkle_tree_string(self, user_id: str) -> str:
+        return self.shard_of(user_id).get_merkle_tree_string(user_id)
 
     def add_messages(self, user_id, messages) -> dict:
         return self.shard_of(user_id).add_messages(user_id, messages)

@@ -38,18 +38,27 @@ def load_native_library(
         if so_name in _cache:
             return _cache[so_name]
         path = os.path.join(NATIVE_DIR, so_name)
-        if not os.path.exists(path):
-            try:
-                subprocess.run(
-                    ["make", "-s", so_name], cwd=NATIVE_DIR,
-                    check=True, capture_output=True, timeout=120,
-                )
-            except Exception:
+        # Run make UNCONDITIONALLY (an up-to-date target is a ~50 ms
+        # no-op): a stale binary from an older checkout would dlopen
+        # fine but lack newly added symbols, and re-dlopen after a
+        # rebuild returns the already-loaded stale handle — so the
+        # rebuild must happen BEFORE the first load.
+        try:
+            subprocess.run(
+                ["make", "-s", so_name], cwd=NATIVE_DIR,
+                check=True, capture_output=True, timeout=120,
+            )
+        except Exception:
+            if not os.path.exists(path):
                 _cache[so_name] = None
                 return None
+            # make unavailable but a binary exists: try it as-is.
         try:
             lib = configure(ctypes.CDLL(path))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError = a symbol this build of the bindings
+            # needs is missing (stale binary + no toolchain): fall
+            # back to the pure-Python paths instead of crashing.
             lib = None
         _cache[so_name] = lib
         return lib

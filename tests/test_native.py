@@ -298,3 +298,37 @@ def test_null_timestamp_row_does_not_crash_native_backend():
     rows = db.exec('SELECT "title" FROM "todo" WHERE "id" = \'r1\'')
     assert rows == [("real",)]
     db.close()
+
+
+def test_packed_query_reader_full_type_matrix():
+    """`eh_exec_packed` + `unpack_packed_rows` (SURVEY hot loop #4)
+    must reproduce the per-cell path exactly for every SQLite storage
+    class — ints at 64-bit extremes, floats incl. inf/-0.0, unicode
+    and NUL-bearing text, NUL-bearing blobs, nulls — and the raw bytes
+    must be deterministic for an unchanged result set (they are the
+    reactive loop's change detector)."""
+    from evolu_tpu.storage.native import unpack_packed_rows
+
+    cpp = CppSqliteDatabase()
+    py = PySqliteDatabase()
+    rows = [
+        (1, "plain"), (2, None), (3, 2.5), (4, b"\x00\xff\x00"),
+        (2**63 - 1, "max"), (-(2**63), "min"), (6, float("inf")),
+        (7, -0.0), (8, "uni ✓ café"), (9, "nul\x00in\x00text"),
+        (10, b""), (11, ""),
+    ]
+    for db in (cpp, py):
+        db.exec('CREATE TABLE "t" ("a", "b")')
+        db.run_many('INSERT INTO "t" VALUES (?, ?)', rows)
+    sql = 'SELECT "a", "b" FROM "t" ORDER BY "a"'
+    want = py.exec_sql_query(sql)
+    got = cpp.exec_sql_query(sql)  # routes through the packed reader
+    assert got == want
+    raw1 = cpp.exec_sql_query_packed_raw(sql)
+    raw2 = cpp.exec_sql_query_packed_raw(sql)
+    assert raw1 == raw2
+    assert unpack_packed_rows(raw1) == want
+    # Empty result set: header only, parses to [].
+    raw_empty = cpp.exec_sql_query_packed_raw('SELECT "a" FROM "t" WHERE "a" = -42')
+    assert unpack_packed_rows(raw_empty) == []
+    cpp.close(), py.close()

@@ -249,6 +249,88 @@ def test_in_with_empty_sequence_compiles_to_constant_false():
     conn.close()
 
 
+def test_reactive_raw_short_circuit_lifecycle():
+    """Hot loop #4 (r4): the worker detects unchanged subscribed
+    queries by raw packed bytes. The lifecycle must mirror the rows
+    cache exactly: a relevant mutation still patches; an irrelevant
+    one emits nothing; EVICTION drops the raw entry too (else a
+    re-subscribe would be silently skipped and the fresh subscriber
+    would never get its add-patch); owner restore clears it."""
+    from evolu_tpu.storage.native import native_available
+
+    if not native_available():
+        pytest.skip("native backend unavailable (raw path is native-only)")
+    import evolu_tpu.runtime.messages as m
+
+    events = []
+    e = create_evolu(TODO_SCHEMA)
+    assert hasattr(e.worker.db, "exec_sql_query_packed_raw")
+    rid = e.create("todo", {"title": "a"})
+    e.worker.flush()
+    q = table("todo").select("id", "title").order_by("title").serialize()
+    # A LIVE subscription (query_once would evict its query, dropping
+    # the raw cache and defeating the short-circuit under test).
+    e.subscribe_query(q, lambda: events.append(1))
+    e.worker.flush()
+    assert q in e.worker.queries_raw_cache
+    fired_initial = len(events)
+    assert fired_initial >= 1  # the initial add-patch reached the app
+
+    # Unchanged re-run: no patch posted (raw equal short-circuit),
+    # listener silent, rows identity kept.
+    before = dict(e.worker.queries_rows_cache)
+    e.worker.post(m.Query((q,)))
+    e.worker.flush()
+    assert len(events) == fired_initial, "unchanged query must not notify"
+    assert e.worker.queries_rows_cache[q] is before[q], "rows identity kept"
+
+    # Relevant mutation: patch must flow (no false skip).
+    e.update("todo", rid, {"title": "b"})
+    e.worker.flush()
+    e.worker.post(m.Query((q,)))
+    e.worker.flush()
+    assert len(events) > fired_initial, "changed query must notify"
+    assert [r["title"] for r in e.worker.queries_rows_cache[q]] == ["b"]
+
+    # Eviction drops BOTH caches; a later re-query rebuilds from scratch.
+    e.worker.post(m.EvictQueries((q,)))
+    e.worker.flush()
+    assert q not in e.worker.queries_raw_cache
+    assert q not in e.worker.queries_rows_cache
+    e.worker.post(m.Query((q,)))
+    e.worker.flush()
+    assert [r["title"] for r in e.worker.queries_rows_cache[q]] == ["b"]
+
+    # Owner restore wipes the raw cache with the rows cache.
+    e.restore_owner(e.owner.mnemonic)
+    e.worker.flush()
+    assert e.worker.queries_raw_cache == {}
+    e.dispose()
+
+
+def test_byte_equality_is_exact_because_nan_cannot_be_stored():
+    """Why raw-byte change detection is EXACT, not approximate: the
+    one value where byte-equality and deep-equality could diverge is
+    REAL NaN (NaN != NaN would make the reference's deep-equal churn,
+    query.ts:43-57) — but SQLite converts NaN to NULL at bind time on
+    every backend, so no queried row can ever hold one. This pins that
+    premise; if a backend ever starts storing NaN, the byte detector
+    needs a second look."""
+    from evolu_tpu.storage.native import native_available
+    from evolu_tpu.storage.sqlite import PySqliteDatabase
+
+    backends = [PySqliteDatabase()]
+    if native_available():
+        from evolu_tpu.storage.native import CppSqliteDatabase
+
+        backends.append(CppSqliteDatabase())
+    for db in backends:
+        db.exec('CREATE TABLE "t" ("x")')
+        db.run('INSERT INTO "t" VALUES (?)', (float("nan"),))
+        assert db.exec_sql_query('SELECT "x" FROM "t"') == [{"x": None}]
+        db.close()
+
+
 # --- model casts (model.ts:100-112) ---
 
 

@@ -498,6 +498,33 @@ def test_get_messages_identical_across_backends():
     assert [m.timestamp for m in outs[0]] == [other]
 
 
+def test_merkle_tree_string_verbatim_and_respond_reuse():
+    """`get_merkle_tree_string` must return the STORED text verbatim
+    (the respond path serves it without a parse→re-dump round trip —
+    r4), equal to re-serializing the parsed tree; empty owner → '{}'.
+    And the engine's cold-sync response tree must be byte-identical
+    whether or not the owner was touched this batch."""
+    from evolu_tpu.core.merkle import merkle_tree_to_string
+    from evolu_tpu.server.engine import BatchReconciler
+    from evolu_tpu.sync import protocol as proto
+
+    store = RelayStore()
+    try:
+        store.add_messages("u1", [_enc(TS, b"x")])
+        raw = store.get_merkle_tree_string("u1")
+        assert raw == merkle_tree_to_string(store.get_merkle_tree("u1"))
+        assert store.get_merkle_tree_string("nobody") == "{}"
+
+        eng = BatchReconciler(store)
+        cold = proto.SyncRequest((), "u1", "e" * 16, "{}")
+        (resp,) = eng._respond([cold], {})  # untouched owner → raw path
+        assert resp.merkle_tree == raw
+        assert [m.timestamp for m in resp.messages] == [TS]
+        eng.close()
+    finally:
+        store.close()
+
+
 def test_relay_rejects_oversized_body(tmp_path):
     """20 MB body limit parity (index.ts:222): 413, no state change."""
     import urllib.error
