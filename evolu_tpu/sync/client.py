@@ -245,11 +245,21 @@ class SyncTransport:
         caller to hand to on_receive AFTER releasing the lock, or None
         when there is nothing to receive."""
         try:
-            encrypted = encrypt_messages(request.messages, request.owner.mnemonic)
+            from evolu_tpu.sync import native_crypto
+
             node_id = timestamp_from_string(request.clock_timestamp).node
-            body = protocol.encode_sync_request(
-                protocol.SyncRequest(encrypted, request.owner.id, node_id, request.merkle_tree)
+            # Fused wire path: encrypt + SyncRequest assembly in one C
+            # call (byte-compatible with the pure encoder, pinned in
+            # tests); None → the pure per-message path.
+            body = native_crypto.encode_push_request(
+                request.messages, request.owner.mnemonic,
+                request.owner.id, node_id, request.merkle_tree,
             )
+            if body is None:
+                encrypted = encrypt_messages(request.messages, request.owner.mnemonic)
+                body = protocol.encode_sync_request(
+                    protocol.SyncRequest(encrypted, request.owner.id, node_id, request.merkle_tree)
+                )
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))
             return None
@@ -271,10 +281,21 @@ class SyncTransport:
             return None
         self._note_online()
         try:
-            response = protocol.decode_sync_response(response_bytes)
-            messages = decrypt_messages(response.messages, request.owner.mnemonic)
+            from evolu_tpu.sync import native_crypto
+
+            # Fused receive path: protobuf parse + decrypt in one C
+            # call; None → the pure decoder (identical error surface).
+            fused = native_crypto.decrypt_response(
+                response_bytes, request.owner.mnemonic
+            )
+            if fused is not None:
+                messages, merkle_tree = fused
+            else:
+                response = protocol.decode_sync_response(response_bytes)
+                messages = decrypt_messages(response.messages, request.owner.mnemonic)
+                merkle_tree = response.merkle_tree
             log("sync:response", messages=len(messages), bytes=len(response_bytes))
-            return (messages, response.merkle_tree, request.previous_diff)
+            return (messages, merkle_tree, request.previous_diff)
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))
             return None

@@ -46,10 +46,22 @@ def _configure(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
         c.POINTER(c.c_int64), c.POINTER(c.c_double), c.c_char_p, c.c_int32,
         c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
     ]
+    lib.ehc_encrypt_wire_batch.restype = c.c_int
+    lib.ehc_encrypt_wire_batch.argtypes = [
+        c.c_int64, c.c_char_p, c.POINTER(c.c_int32), c.c_char_p,
+        c.POINTER(c.c_int32), c.POINTER(c.c_int8), c.POINTER(c.c_int64),
+        c.POINTER(c.c_double), c.c_char_p, c.c_int32,
+        c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
+    ]
     lib.ehc_decrypt_batch.restype = c.c_int
     lib.ehc_decrypt_batch.argtypes = [
         c.c_int64, c.c_char_p, c.POINTER(c.c_int32), c.c_char_p, c.c_int32,
         u8p, c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
+    ]
+    lib.ehc_decrypt_response.restype = c.c_int
+    lib.ehc_decrypt_response.argtypes = [
+        c.c_char_p, c.c_int64, c.c_char_p, c.c_int32,
+        c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
     ]
     lib.ehc_free.argtypes = [c.c_void_p]
     if not lib.ehc_available():
@@ -65,16 +77,9 @@ def native_available() -> bool:
     return load_library() is not None
 
 
-def encrypt_batch(messages: Sequence, password: str):
-    """→ tuple[EncryptedCrdtMessage] or None (Python path required).
-
-    Mirrors `encrypt_symmetric(encode_content(...))` per message
-    (crypto.py:70-83) with batch-level S2K/AES/MDC in C++. Returns
-    None — never raises — when any value needs the oracle's error
-    surface."""
-    lib = load_library()
-    if lib is None:
-        return None
+def _pack_values(messages: Sequence):
+    """Columnar packing shared by both encrypt entry points; None when
+    any value needs the Python oracle's error surface."""
     n = len(messages)
     parts: List[bytes] = []
     lens = (ctypes.c_int32 * (4 * n))()
@@ -106,12 +111,28 @@ def encrypt_batch(messages: Sequence, password: str):
             vkinds[j], dvals[j] = 3, v
         else:
             return None  # unencodable → oracle raises
-    blob = b"".join(parts)
+    return b"".join(parts), lens, vkinds, ivals, dvals
+
+
+def encrypt_batch(messages: Sequence, password: str):
+    """→ tuple[EncryptedCrdtMessage] or None (Python path required).
+
+    Mirrors `encrypt_symmetric(encode_content(...))` per message
+    (crypto.py:70-83) with batch-level S2K/AES/MDC in C++. Returns
+    None — never raises — when any value needs the oracle's error
+    surface."""
+    lib = load_library()
+    if lib is None:
+        return None
+    packed = _pack_values(messages)
+    if packed is None:
+        return None
+    blob, lens, vkinds, ivals, dvals = packed
     pw = password.encode("utf-8")
     out_p = ctypes.c_void_p()
     out_len = ctypes.c_int64()
     rc = lib.ehc_encrypt_batch(
-        n, blob, lens, vkinds, ivals, dvals, pw, len(pw),
+        len(messages), blob, lens, vkinds, ivals, dvals, pw, len(pw),
         ctypes.byref(out_p), ctypes.byref(out_len),
     )
     if rc != 0:
@@ -132,7 +153,76 @@ def encrypt_batch(messages: Sequence, password: str):
     return tuple(out)
 
 
+def encode_push_request(
+    messages: Sequence, password: str, user_id: str, node_id: str,
+    merkle_tree: str,
+) -> Optional[bytes]:
+    """The whole SyncRequest body with ZERO per-message Python:
+    `ehc_encrypt_wire_batch` emits the encrypted `messages` field-1
+    stream byte-compatibly with `protocol.encode_sync_request`, and
+    the three scalar fields append here. None → pure path."""
+    lib = load_library()
+    if lib is None:
+        return None
+    packed = _pack_values(messages)
+    if packed is None:
+        return None
+    blob, lens, vkinds, ivals, dvals = packed
+    n = len(messages)
+    ts_parts = []
+    ts_lens = (ctypes.c_int32 * n)()
+    for j, m in enumerate(messages):
+        ts = m.timestamp.encode("utf-8")
+        ts_parts.append(ts)
+        ts_lens[j] = len(ts)
+    pw = password.encode("utf-8")
+    out_p = ctypes.c_void_p()
+    out_len = ctypes.c_int64()
+    rc = lib.ehc_encrypt_wire_batch(
+        n, b"".join(ts_parts), ts_lens, blob, lens, vkinds, ivals, dvals,
+        pw, len(pw), ctypes.byref(out_p), ctypes.byref(out_len),
+    )
+    if rc != 0:
+        return None
+    try:
+        stream = ctypes.string_at(out_p.value, out_len.value)
+    finally:
+        lib.ehc_free(out_p)
+    return (
+        stream
+        + protocol._string(2, user_id)
+        + protocol._string(3, node_id)
+        + protocol._string(4, merkle_tree)
+    )
+
+
 _REC_HEAD = struct.Struct("<iiiib q d")
+
+
+def _parse_record(raw: bytes, pos: int):
+    """ONE parser for the C decoded-content record layout
+    (append_content_record) — both decrypt entry points use it, so the
+    format can never drift between them. → (table, row, column, value,
+    next_pos); raises UnicodeDecodeError on invalid UTF-8 (callers
+    demote to the pure oracle)."""
+    tl, rl, cl, vl, vkind, ival, dval = _REC_HEAD.unpack_from(raw, pos)
+    pos += _REC_HEAD.size
+    table = raw[pos : pos + tl].decode("utf-8")
+    pos += tl
+    row = raw[pos : pos + rl].decode("utf-8")
+    pos += rl
+    column = raw[pos : pos + cl].decode("utf-8")
+    pos += cl
+    if vkind == 0:
+        value = None
+    elif vkind == 1:
+        value = raw[pos : pos + vl].decode("utf-8")
+        pos += vl
+    elif vkind == 2:
+        value = ival
+    else:
+        value = dval
+    return table, row, column, value, pos
 
 
 def decrypt_batch(messages: Sequence, password: str) -> Tuple[CrdtMessage, ...]:
@@ -168,32 +258,83 @@ def decrypt_batch(messages: Sequence, password: str) -> Tuple[CrdtMessage, ...]:
         if statuses[j] != 0:
             out.append(_pure_one(m, password))
             continue
-        tl, rl, cl, vl, vkind, ival, dval = _REC_HEAD.unpack_from(raw, pos)
-        pos += _REC_HEAD.size
         try:
-            table = raw[pos : pos + tl].decode("utf-8")
-            pos += tl
-            row = raw[pos : pos + rl].decode("utf-8")
-            pos += rl
-            column = raw[pos : pos + cl].decode("utf-8")
-            pos += cl
-            if vkind == 0:
-                value = None
-            elif vkind == 1:
-                value = raw[pos : pos + vl].decode("utf-8")
-                pos += vl
-            elif vkind == 2:
-                value = ival
-            else:
-                value = dval
+            table, row, column, value, pos = _parse_record(raw, pos)
         except UnicodeDecodeError:
-            # Invalid UTF-8 in a string field: skip this record's
-            # remaining bytes are already consumed above up to the
-            # failing field — demote to the oracle for the canonical
-            # ValueError. (pos may sit mid-record; recompute.)
+            # Invalid UTF-8 in a string field: demote the whole batch
+            # to the oracle for the canonical ValueError.
             return _pure(messages, password)
         out.append(CrdtMessage(m.timestamp, table, row, column, value))
     return tuple(out)
+
+
+def decrypt_response(response_bytes: bytes, password: str):
+    """Fused `decode_sync_response` + `decrypt_messages`: → (messages
+    tuple, merkle_tree str), or None when the WIRE shape needs the
+    pure decoder (whole-batch fallback preserves its exact ValueError
+    surface; per-message crypto fallbacks re-run the oracle at their
+    position). Raises what the pure path raises."""
+    lib = load_library()
+    if lib is None:
+        return None
+    pw = password.encode("utf-8")
+    out_p = ctypes.c_void_p()
+    out_len = ctypes.c_int64()
+    rc = lib.ehc_decrypt_response(
+        response_bytes, len(response_bytes), pw, len(pw),
+        ctypes.byref(out_p), ctypes.byref(out_len),
+    )
+    if rc != 0:
+        return None  # rc 2: non-canonical wire → pure decoder wholesale
+    try:
+        raw = ctypes.string_at(out_p.value, out_len.value)
+    finally:
+        lib.ehc_free(out_p)
+    # Pass 1 — decode EVERY wire-derived string (timestamps, decoded
+    # records, the tree) before any fallback decrypt runs: the pure
+    # path fully parses the response, THEN decrypts in order, so a
+    # bad-UTF-8 tree must surface before a bad ciphertext (fuzz-found
+    # ordering divergence). Any UnicodeDecodeError → None, the pure
+    # decoder owns that exact error.
+    try:
+        (n,) = struct.unpack_from("<q", raw, 0)
+        (tree_len,) = struct.unpack_from("<I", raw, 8)
+        pos = 12
+        items: List[tuple] = []  # (timestamp, decoded CrdtMessage | ct span)
+        for _ in range(n):
+            status = raw[pos]
+            pos += 1
+            (ts_len,) = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            timestamp = raw[pos : pos + ts_len].decode("utf-8")
+            pos += ts_len
+            if status != 0:
+                (ct_off,) = struct.unpack_from("<q", raw, pos)
+                pos += 8
+                (ct_len,) = struct.unpack_from("<I", raw, pos)
+                pos += 4
+                items.append((timestamp, (ct_off, ct_len)))
+                continue
+            table, row, column, value, pos = _parse_record(raw, pos)
+            items.append((timestamp, CrdtMessage(timestamp, table, row, column, value)))
+        tree = raw[pos : pos + tree_len].decode("utf-8")
+    except UnicodeDecodeError:
+        return None
+
+    # Pass 2 — oracle re-runs for demoted rows, in wire order (their
+    # PgpError/ValueError fires exactly where the pure loop's would).
+    out: List[CrdtMessage] = []
+    for timestamp, item in items:
+        if isinstance(item, CrdtMessage):
+            out.append(item)
+            continue
+        ct_off, ct_len = item
+        ct = response_bytes[ct_off : ct_off + ct_len]
+        table, row, column, value = protocol.decode_content(
+            decrypt_symmetric(ct, password)
+        )
+        out.append(CrdtMessage(timestamp, table, row, column, value))
+    return tuple(out), tree
 
 
 def _pure_one(m, password: str) -> CrdtMessage:

@@ -27,6 +27,7 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <string>
 #include <vector>
 
 // ---- OpenSSL 3 ABI (self-declared; no headers in the image) ----
@@ -204,6 +205,63 @@ uint8_t *put_content(uint8_t *p, const uint8_t *strs, const int32_t lens[4],
   return p;
 }
 
+// Exact SKESK‖SEIPD size for a content of `c` bytes.
+size_t message_size(size_t c) {
+  size_t lit_body = 6 + c;
+  size_t lit_pkt = 1 + pkt_len_size(lit_body) + lit_body;
+  size_t plain = 18 + lit_pkt + 22;
+  size_t seipd_body = 1 + plain;
+  return 15 + 1 + pkt_len_size(seipd_body) + seipd_body;
+}
+
+// Encrypt ONE CrdtMessageContent into dst (must hold message_size(c)).
+// rnd24 = 8 salt + 16 prefix bytes. Returns false on OpenSSL failure.
+bool emit_message(Ctxs &cx, const uint8_t *password, size_t pw_len,
+                  const uint8_t *rnd24, const uint8_t *strs,
+                  const int32_t L[4], int8_t vkind, int64_t ival, double dval,
+                  size_t c, std::vector<uint8_t> &plainbuf, uint8_t *dst) {
+  static const uint8_t zero_iv[16] = {0};
+  const uint8_t *salt = rnd24, *prefix = rnd24 + 8;
+  uint8_t key[32];
+  if (!s2k_iterated(cx, password, pw_len, salt, 0, key)) return false;
+
+  uint8_t *q = dst;
+  // SKESK (tag 3): v4, AES-256, iterated+salted SHA-256, count 0.
+  *q++ = 0xC3; *q++ = 13; *q++ = 4; *q++ = 9; *q++ = 3; *q++ = 8;
+  memcpy(q, salt, 8); q += 8;
+  *q++ = 0;
+
+  // Plaintext body: prefix ‖ repeat ‖ literal ‖ d3 14 ‖ SHA1(MDC).
+  size_t lit_body = 6 + c;
+  size_t plain = 18 + (1 + pkt_len_size(lit_body) + lit_body) + 22;
+  plainbuf.resize(plain);
+  uint8_t *b = plainbuf.data();
+  memcpy(b, prefix, 16); b += 16;
+  b[0] = prefix[14]; b[1] = prefix[15]; b += 2;
+  b = put_pkt_hdr(b, 11, lit_body);
+  *b++ = 'b'; *b++ = 0; memset(b, 0, 4); b += 4;
+  b = put_content(b, strs, L, vkind, ival, dval);
+  *b++ = 0xD3; *b++ = 0x14;
+  uint8_t mdc[20];
+  if (!sha1_oneshot(cx, plainbuf.data(), size_t(b - plainbuf.data()), mdc))
+    return false;
+  memcpy(b, mdc, 20);
+
+  // SEIPD (tag 18): 0x01 ‖ AES-256-CFB(zero IV) of the body.
+  size_t seipd_body = 1 + plain;
+  q = put_pkt_hdr(q, 18, seipd_body);
+  *q++ = 0x01;
+  int enc_len = 0;
+  if (!EVP_EncryptInit_ex(cx.cipher, cx.aes, nullptr, key, zero_iv) ||
+      !EVP_EncryptUpdate(cx.cipher, q, &enc_len, plainbuf.data(), int(plain)) ||
+      size_t(enc_len) != plain)
+    return false;
+  // Size accounting must be EXACT: the caller sized this slot with
+  // message_size(c); any drift between the two is heap corruption,
+  // not a recoverable condition — fail the batch cleanly instead.
+  return size_t(q + plain - dst) == message_size(c);
+}
+
 }  // namespace
 
 // ---- public ABI ----
@@ -239,14 +297,9 @@ int ehc_encrypt_batch(int64_t n, const uint8_t *str_blob, const int32_t *lens4,
     const int32_t *L = lens4 + 4 * i;
     if (L[0] < 0 || L[1] < 0 || L[2] < 0 || (vkinds[i] == 1 && L[3] < 0)) return 1;
     size_t c = content_size(L, vkinds[i], ivals[i]);
-    size_t lit_body = 6 + c;
-    size_t lit_pkt = 1 + pkt_len_size(lit_body) + lit_body;
-    size_t plain = 18 + lit_pkt + 22;
-    size_t seipd_body = 1 + plain;
-    size_t msg = 15 + 1 + pkt_len_size(seipd_body) + seipd_body;
     clen[size_t(i)] = c;
-    total[size_t(i)] = msg;
-    out_total += 4 + msg;
+    total[size_t(i)] = message_size(c);
+    out_total += 4 + total[size_t(i)];
   }
 
   uint8_t *out = static_cast<uint8_t *>(malloc(out_total ? out_total : 1));
@@ -258,56 +311,88 @@ int ehc_encrypt_batch(int64_t n, const uint8_t *str_blob, const int32_t *lens4,
   std::vector<uint8_t> plainbuf;
   const uint8_t *strs = str_blob;
   uint8_t *p = out;
-  static const uint8_t zero_iv[16] = {0};
   for (int64_t i = 0; i < n; i++) {
     const int32_t *L = lens4 + 4 * i;
-    const uint8_t *salt = rnd.data() + 24 * i, *prefix = salt + 8;
-    uint8_t key[32];
-    if (!s2k_iterated(cx, password, size_t(pw_len), salt, 0, key)) { free(out); return 1; }
-
     size_t msg = total[size_t(i)];
     *p++ = uint8_t(msg); *p++ = uint8_t(msg >> 8);
     *p++ = uint8_t(msg >> 16); *p++ = uint8_t(msg >> 24);
-
-    // SKESK (tag 3): v4, AES-256, iterated+salted SHA-256, count 0.
-    uint8_t *q = p;
-    *q++ = 0xC3; *q++ = 13; *q++ = 4; *q++ = 9; *q++ = 3; *q++ = 8;
-    memcpy(q, salt, 8); q += 8;
-    *q++ = 0;
-
-    // Plaintext body: prefix ‖ repeat ‖ literal ‖ d3 14 ‖ SHA1(MDC).
-    size_t c = clen[size_t(i)];
-    size_t lit_body = 6 + c;
-    size_t plain = 18 + (1 + pkt_len_size(lit_body) + lit_body) + 22;
-    plainbuf.resize(plain);
-    uint8_t *b = plainbuf.data();
-    memcpy(b, prefix, 16); b += 16;
-    b[0] = prefix[14]; b[1] = prefix[15]; b += 2;
-    b = put_pkt_hdr(b, 11, lit_body);
-    *b++ = 'b'; *b++ = 0; memset(b, 0, 4); b += 4;
-    b = put_content(b, strs, L, vkinds[i], ivals[i], dvals[i]);
-    *b++ = 0xD3; *b++ = 0x14;
-    uint8_t mdc[20];
-    if (!sha1_oneshot(cx, plainbuf.data(), size_t(b - plainbuf.data()), mdc)) {
-      free(out); return 1;
+    if (!emit_message(cx, password, size_t(pw_len), rnd.data() + 24 * i, strs,
+                      L, vkinds[i], ivals[i], dvals[i], clen[size_t(i)], plainbuf,
+                      p)) {
+      free(out);
+      return 1;
     }
-    memcpy(b, mdc, 20); b += 20;
-
-    // SEIPD (tag 18): 0x01 ‖ AES-256-CFB(zero IV) of the body.
-    size_t seipd_body = 1 + plain;
-    q = put_pkt_hdr(q, 18, seipd_body);
-    *q++ = 0x01;
-    int enc_len = 0;
-    if (!EVP_EncryptInit_ex(cx.cipher, cx.aes, nullptr, key, zero_iv) ||
-        !EVP_EncryptUpdate(cx.cipher, q, &enc_len, plainbuf.data(), int(plain)) ||
-        size_t(enc_len) != plain) {
-      free(out); return 1;
-    }
-    q += plain;
     p += msg;
     strs += L[0] + L[1] + L[2] + (vkinds[i] == 1 ? L[3] : 0);
-    if (q != p) { free(out); return 1; }  // size accounting must be exact
   }
+  *out_blob = out;
+  *out_len = int64_t(out_total);
+  return 0;
+}
+
+// Encrypt a batch STRAIGHT INTO SyncRequest wire form: the output is
+// the concatenated `messages` field-1 stream of protobuf.proto's
+// SyncRequest — per message `0x0A varint(inner)` wrapping
+// `EncryptedCrdtMessage{ timestamp=1, content=2 }` — byte-identical
+// to protocol.encode_sync_request's messages section. The caller
+// appends the userId/nodeId/merkleTree fields (2/3/4) and has the
+// whole request body with ZERO per-message Python (sync hot path;
+// ts_blob/ts_lens carry the plaintext timestamps).
+int ehc_encrypt_wire_batch(int64_t n, const uint8_t *ts_blob,
+                           const int32_t *ts_lens, const uint8_t *str_blob,
+                           const int32_t *lens4, const int8_t *vkinds,
+                           const int64_t *ivals, const double *dvals,
+                           const uint8_t *password, int32_t pw_len,
+                           uint8_t **out_blob, int64_t *out_len) {
+  Ctxs cx;
+  if (!cx.ok() || n < 0 || pw_len < 0) return 1;
+  std::vector<size_t> clen(static_cast<size_t>(n)), ctsz(static_cast<size_t>(n)),
+      inner(static_cast<size_t>(n));
+  size_t out_total = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const int32_t *L = lens4 + 4 * i;
+    if (L[0] < 0 || L[1] < 0 || L[2] < 0 || ts_lens[i] < 0 ||
+        (vkinds[i] == 1 && L[3] < 0))
+      return 1;
+    size_t c = content_size(L, vkinds[i], ivals[i]);
+    size_t ct = message_size(c);
+    size_t in = 1 + varint_size(uint64_t(ts_lens[i])) + size_t(ts_lens[i]) +
+                1 + varint_size(ct) + ct;
+    clen[size_t(i)] = c;
+    ctsz[size_t(i)] = ct;
+    inner[size_t(i)] = in;
+    out_total += 1 + varint_size(in) + in;
+  }
+  uint8_t *out = static_cast<uint8_t *>(malloc(out_total ? out_total : 1));
+  if (!out) return 1;
+  std::vector<uint8_t> rnd(size_t(n) * 24);
+  if (n && !RAND_bytes(rnd.data(), int(rnd.size()))) { free(out); return 1; }
+
+  std::vector<uint8_t> plainbuf;
+  const uint8_t *strs = str_blob;
+  const uint8_t *ts = ts_blob;
+  uint8_t *p = out;
+  for (int64_t i = 0; i < n; i++) {
+    const int32_t *L = lens4 + 4 * i;
+    *p++ = 0x0A;  // SyncRequest.messages, field 1, wt 2
+    p = put_varint(p, uint64_t(inner[size_t(i)]));
+    *p++ = 0x0A;  // EncryptedCrdtMessage.timestamp
+    p = put_varint(p, uint64_t(ts_lens[i]));
+    memcpy(p, ts, size_t(ts_lens[i]));
+    p += ts_lens[i];
+    ts += ts_lens[i];
+    *p++ = 0x12;  // EncryptedCrdtMessage.content, field 2, wt 2
+    p = put_varint(p, uint64_t(ctsz[size_t(i)]));
+    if (!emit_message(cx, password, size_t(pw_len), rnd.data() + 24 * i, strs,
+                      L, vkinds[i], ivals[i], dvals[i], clen[size_t(i)], plainbuf,
+                      p)) {
+      free(out);
+      return 1;
+    }
+    p += ctsz[size_t(i)];
+    strs += L[0] + L[1] + L[2] + (vkinds[i] == 1 ? L[3] : 0);
+  }
+  if (size_t(p - out) != out_total) { free(out); return 1; }
   *out_blob = out;
   *out_len = int64_t(out_total);
   return 0;
@@ -341,7 +426,7 @@ bool read_packets(const uint8_t *d, size_t n, std::vector<Pkt> &out) {
     } else {
       return false;  // partial length → Python oracle
     }
-    if (pos + len > n) return false;
+    if (len > n - pos) return false;  // overflow-safe: pos <= n
     out.push_back({tag, d + pos, len});
     pos += len;
   }
@@ -398,7 +483,10 @@ bool decode_content(const uint8_t *d, size_t n, Content &out) {
     } else if (wt == 2) {
       uint64_t len;
       if (!read_varint64(d, n, pos, len)) return false;
-      if (pos + len > n) return false;
+      // Overflow-safe (pos <= n): a 10-byte varint can carry bit 63,
+      // and `pos + len` would wrap past the check (r4 review finding —
+      // heap over-read on untrusted input).
+      if (len > n - pos) return false;
       bytes = d + pos; blen = size_t(len); pos += size_t(len);
     } else if (wt == 5) {
       if (pos + 4 > n) return false;
@@ -430,6 +518,89 @@ bool decode_content(const uint8_t *d, size_t n, Content &out) {
   return true;
 }
 
+// Decrypt ONE canonical SKESK‖SEIPD stream + decode its content.
+// false = demote this message to the Python oracle.
+bool decrypt_one(Ctxs &cx, const uint8_t *msg, size_t clen,
+                 const uint8_t *password, size_t pw_len,
+                 std::vector<uint8_t> &plain, std::vector<Pkt> &pkts,
+                 std::vector<Pkt> &inner, Content &c) {
+  static const uint8_t zero_iv[16] = {0};
+  pkts.clear();
+  if (!read_packets(msg, clen, pkts)) return false;
+  const Pkt *skesk = nullptr, *seipd = nullptr;
+  bool sed = false;
+  for (const Pkt &p : pkts) {
+    if (p.tag == 3 && !skesk) skesk = &p;
+    else if (p.tag == 18 && !seipd) seipd = &p;
+    else if (p.tag == 9) sed = true;
+  }
+  if (!skesk || !seipd || sed) return false;  // legacy SED → oracle
+
+  const uint8_t *sk = skesk->body;
+  if (skesk->len < 4 || sk[0] != 4 || sk[1] != 9) return false;
+  uint8_t key[32];
+  if (sk[2] == 3) {
+    if (skesk->len < 13 || sk[3] != 8) return false;
+    if (!s2k_iterated(cx, password, pw_len, sk + 4, sk[12], key)) return false;
+  } else if (sk[2] == 1) {
+    if (skesk->len < 12 || sk[3] != 8) return false;
+    if (!s2k_salted(cx, password, pw_len, sk + 4, key)) return false;
+  } else if (sk[2] == 0) {
+    if (sk[3] != 8) return false;
+    if (!s2k_salted(cx, password, pw_len, nullptr, key)) return false;
+  } else {
+    return false;
+  }
+
+  if (seipd->len < 1 + 18 + 22 || seipd->body[0] != 1) return false;
+  size_t blen = seipd->len - 1;
+  plain.resize(blen);
+  int dec_len = 0;
+  if (!EVP_DecryptInit_ex(cx.cipher, cx.aes, nullptr, key, zero_iv) ||
+      !EVP_DecryptUpdate(cx.cipher, plain.data(), &dec_len, seipd->body + 1,
+                         int(blen)) ||
+      size_t(dec_len) != blen)
+    return false;
+  const uint8_t *b = plain.data();
+  if (b[16] != b[14] || b[17] != b[15]) return false;  // wrong password → oracle
+  if (b[blen - 22] != 0xD3 || b[blen - 21] != 0x14) return false;
+  uint8_t mdc[20];
+  if (!sha1_oneshot(cx, b, blen - 20, mdc)) return false;
+  if (memcmp(mdc, b + blen - 20, 20) != 0) return false;
+
+  inner.clear();
+  if (!read_packets(b + 18, blen - 18 - 22, inner)) return false;
+  const Pkt *lit = nullptr;
+  for (const Pkt &p : inner) {
+    if (p.tag == 11) { lit = &p; break; }
+    if (p.tag == 8) return false;  // compressed → oracle
+  }
+  if (!lit || lit->len < 2) return false;
+  size_t name_len = lit->body[1];
+  if (2 + name_len + 4 > lit->len) return false;
+  return decode_content(lit->body + 2 + name_len + 4,
+                        lit->len - 2 - name_len - 4, c);
+}
+
+// Append a decoded-content record to `out` (the decrypt_batch record
+// layout — the Python side shares one parser for both entry points).
+void append_content_record(std::string &out, const Content &c) {
+  auto put_i32 = [&out](int64_t v) {
+    for (int k = 0; k < 4; k++) out.push_back(char(uint64_t(v) >> (8 * k)));
+  };
+  put_i32(int64_t(c.tl)); put_i32(int64_t(c.rl)); put_i32(int64_t(c.cl));
+  put_i32(c.vkind == 1 ? int64_t(c.sl) : -1);
+  out.push_back(char(c.vkind));
+  for (int k = 0; k < 8; k++) out.push_back(char(uint64_t(c.ival) >> (8 * k)));
+  uint64_t dbits;
+  memcpy(&dbits, &c.dval, 8);
+  for (int k = 0; k < 8; k++) out.push_back(char(dbits >> (8 * k)));
+  if (c.tl) out.append(reinterpret_cast<const char *>(c.t), c.tl);
+  if (c.rl) out.append(reinterpret_cast<const char *>(c.r), c.rl);
+  if (c.cl) out.append(reinterpret_cast<const char *>(c.c), c.cl);
+  if (c.vkind == 1 && c.sl) out.append(reinterpret_cast<const char *>(c.s), c.sl);
+}
+
 }  // namespace
 
 // Decrypt a batch of OpenPGP streams (packed [len]+bytes via ct_lens)
@@ -445,108 +616,126 @@ int ehc_decrypt_batch(int64_t n, const uint8_t *ct_blob, const int32_t *ct_lens,
                       uint8_t *statuses, uint8_t **out_blob, int64_t *out_len) {
   Ctxs cx;
   if (!cx.ok() || n < 0 || pw_len < 0) return 1;
-  std::vector<uint8_t> out;
+  std::string out;
   out.reserve(size_t(n) * 128);
   std::vector<uint8_t> plain;
   std::vector<Pkt> pkts, inner;
-  static const uint8_t zero_iv[16] = {0};
   const uint8_t *ct = ct_blob;
 
   for (int64_t i = 0; i < n; i++) {
     size_t clen = size_t(ct_lens[i]);
     const uint8_t *msg = ct;
     ct += clen;
-    statuses[i] = 1;  // pessimistic; flipped to 0 on full success
-
-    pkts.clear();
-    if (!read_packets(msg, clen, pkts)) continue;
-    const Pkt *skesk = nullptr, *seipd = nullptr;
-    bool sed = false;
-    for (const Pkt &p : pkts) {
-      if (p.tag == 3 && !skesk) skesk = &p;
-      else if (p.tag == 18 && !seipd) seipd = &p;
-      else if (p.tag == 9) sed = true;
-    }
-    if (!skesk || !seipd || sed) continue;  // legacy SED → oracle
-
-    // SKESK: v4, AES-256, S2K type 3 (iterated), 1 (salted), 0 (simple).
-    const uint8_t *sk = skesk->body;
-    if (skesk->len < 4 || sk[0] != 4 || sk[1] != 9) continue;
-    uint8_t key[32];
-    if (sk[2] == 3) {
-      if (skesk->len < 13 || sk[3] != 8) continue;
-      if (!s2k_iterated(cx, password, size_t(pw_len), sk + 4, sk[12], key)) continue;
-    } else if (sk[2] == 1) {
-      if (skesk->len < 12 || sk[3] != 8) continue;
-      if (!s2k_salted(cx, password, size_t(pw_len), sk + 4, key)) continue;
-    } else if (sk[2] == 0) {
-      if (sk[3] != 8) continue;
-      if (!s2k_salted(cx, password, size_t(pw_len), nullptr, key)) continue;
-    } else {
-      continue;
-    }
-
-    // SEIPD v1: decrypt, prefix check, MDC check.
-    if (seipd->len < 1 + 18 + 22 || seipd->body[0] != 1) continue;
-    size_t blen = seipd->len - 1;
-    plain.resize(blen);
-    int dec_len = 0;
-    if (!EVP_DecryptInit_ex(cx.cipher, cx.aes, nullptr, key, zero_iv) ||
-        !EVP_DecryptUpdate(cx.cipher, plain.data(), &dec_len, seipd->body + 1,
-                           int(blen)) ||
-        size_t(dec_len) != blen)
-      continue;
-    const uint8_t *b = plain.data();
-    if (b[16] != b[14] || b[17] != b[15]) continue;  // wrong password → oracle raises
-    if (b[blen - 22] != 0xD3 || b[blen - 21] != 0x14) continue;
-    uint8_t mdc[20];
-    if (!sha1_oneshot(cx, b, blen - 20, mdc)) continue;
-    if (memcmp(mdc, b + blen - 20, 20) != 0) continue;
-
-    // Literal data packet inside (first tag 11 wins; tag 8 compression
-    // → oracle).
-    inner.clear();
-    if (!read_packets(b + 18, blen - 18 - 22, inner)) continue;
-    const Pkt *lit = nullptr;
-    bool compressed = false;
-    for (const Pkt &p : inner) {
-      if (p.tag == 11) { lit = &p; break; }
-      if (p.tag == 8) { compressed = true; break; }
-    }
-    if (!lit || compressed) continue;
-    if (lit->len < 2) continue;
-    size_t name_len = lit->body[1];
-    if (2 + name_len + 4 > lit->len) continue;
-    const uint8_t *content = lit->body + 2 + name_len + 4;
-    size_t content_len = lit->len - 2 - name_len - 4;
-
     Content c;
-    if (!decode_content(content, content_len, c)) continue;
-
-    size_t rec = 16 + 1 + 8 + 8 + c.tl + c.rl + c.cl + (c.vkind == 1 ? c.sl : 0);
-    size_t base = out.size();
-    out.resize(base + rec);
-    uint8_t *w = out.data() + base;
-    auto put_i32 = [&](int64_t v) {
-      for (int k = 0; k < 4; k++) *w++ = uint8_t(uint64_t(v) >> (8 * k));
-    };
-    put_i32(int64_t(c.tl)); put_i32(int64_t(c.rl)); put_i32(int64_t(c.cl));
-    put_i32(c.vkind == 1 ? int64_t(c.sl) : -1);
-    *w++ = uint8_t(c.vkind);
-    for (int k = 0; k < 8; k++) *w++ = uint8_t(uint64_t(c.ival) >> (8 * k));
-    uint64_t dbits;
-    memcpy(&dbits, &c.dval, 8);
-    for (int k = 0; k < 8; k++) *w++ = uint8_t(dbits >> (8 * k));
-    if (c.tl) { memcpy(w, c.t, c.tl); w += c.tl; }
-    if (c.rl) { memcpy(w, c.r, c.rl); w += c.rl; }
-    if (c.cl) { memcpy(w, c.c, c.cl); w += c.cl; }
-    if (c.vkind == 1 && c.sl) { memcpy(w, c.s, c.sl); w += c.sl; }
-    statuses[i] = 0;
+    if (decrypt_one(cx, msg, clen, password, size_t(pw_len), plain, pkts,
+                    inner, c)) {
+      append_content_record(out, c);
+      statuses[i] = 0;
+    } else {
+      statuses[i] = 1;  // → Python oracle at this position
+    }
   }
 
   uint8_t *blob = static_cast<uint8_t *>(malloc(out.size() ? out.size() : 1));
   if (!blob) return 1;
   if (!out.empty()) memcpy(blob, out.data(), out.size());
+  *out_blob = blob;
+  *out_len = int64_t(out.size());
+  return 0;
+}
+
+// Parse a whole SyncResponse protobuf AND decrypt its messages in one
+// call (the client receive leg: decode_sync_response +
+// decrypt_messages fused — per-message Python eliminated for
+// canonical rows). Output blob:
+//   [i64 n_messages][u32 tree_len]
+//   per message: [u8 status][u32 ts_len][ts bytes] then
+//     status 0: a decoded-content record (decrypt_batch layout)
+//     status 1: [i64 ct_off][u32 ct_len] — the ciphertext span inside
+//       `resp` for the Python oracle to re-do at this position.
+//   then the merkleTree bytes (tree_len of them) at the TAIL.
+// Returns 0 ok; 2 = non-canonical WIRE shape (unknown/unexpected wire
+// types, truncation — the caller falls back to the pure decoder
+// wholesale, preserving its exact ValueError surface); 1 = internal.
+int ehc_decrypt_response(const uint8_t *resp, int64_t resp_len,
+                         const uint8_t *password, int32_t pw_len,
+                         uint8_t **out_blob, int64_t *out_len) {
+  Ctxs cx;
+  if (!cx.ok() || resp_len < 0 || pw_len < 0) return 1;
+  size_t n_ = size_t(resp_len);
+  std::string out(12, '\0');  // n + tree_len placeholders
+  int64_t n_msgs = 0;
+  const uint8_t *tree = nullptr;
+  size_t tree_len = 0;
+  std::vector<uint8_t> plain;
+  std::vector<Pkt> pkts, inner;
+
+  size_t pos = 0;
+  while (pos < n_) {
+    uint64_t key;
+    if (!read_varint64(resp, n_, pos, key)) return 2;
+    uint64_t field = key >> 3;
+    int wt = int(key & 7);
+    if (wt != 2) return 2;  // canonical SyncResponse is all wt-2
+    uint64_t len;
+    if (!read_varint64(resp, n_, pos, len)) return 2;
+    // Overflow-safe (pos <= n_): see decode_content — a 10-byte varint
+    // can carry bit 63 and wrap `pos + len` past a naive check,
+    // spanning reads beyond the response buffer (r4 review finding).
+    if (len > n_ - pos) return 2;
+    const uint8_t *body = resp + pos;
+    size_t blen = size_t(len);
+    pos += blen;
+    if (field == 2) {
+      tree = body;  // last wins, like the Python decoder
+      tree_len = blen;
+      continue;
+    }
+    if (field != 1) continue;  // unknown length-delimited field: skip
+
+    // EncryptedCrdtMessage { timestamp=1, content=2 } — last wins.
+    const uint8_t *ts = nullptr, *ct = nullptr;
+    size_t ts_len = 0, ct_len = 0;
+    size_t mp = 0;
+    while (mp < blen) {
+      uint64_t mkey;
+      if (!read_varint64(body, blen, mp, mkey)) return 2;
+      uint64_t mf = mkey >> 3;
+      int mwt = int(mkey & 7);
+      if (mwt != 2) return 2;  // incl. the varint-content DoS shape
+      uint64_t mlen;
+      if (!read_varint64(body, blen, mp, mlen)) return 2;
+      if (mlen > blen - mp) return 2;  // overflow-safe: mp <= blen
+      if (mf == 1) { ts = body + mp; ts_len = size_t(mlen); }
+      else if (mf == 2) { ct = body + mp; ct_len = size_t(mlen); }
+      mp += size_t(mlen);
+    }
+    n_msgs++;
+    out.push_back('\0');  // status placeholder
+    size_t status_at = out.size() - 1;
+    uint32_t tl32 = uint32_t(ts_len);
+    out.append(reinterpret_cast<const char *>(&tl32), 4);
+    if (ts_len) out.append(reinterpret_cast<const char *>(ts), ts_len);
+    Content c;
+    if (ct && decrypt_one(cx, ct, ct_len, password, size_t(pw_len), plain,
+                          pkts, inner, c)) {
+      append_content_record(out, c);
+    } else {
+      out[status_at] = 1;
+      int64_t off = ct ? int64_t(ct - resp) : 0;
+      uint32_t cl32 = uint32_t(ct_len);
+      out.append(reinterpret_cast<const char *>(&off), 8);
+      out.append(reinterpret_cast<const char *>(&cl32), 4);
+    }
+  }
+  memcpy(&out[0], &n_msgs, 8);
+  uint32_t tl = uint32_t(tree_len);
+  memcpy(&out[8], &tl, 4);
+  if (tree_len) out.append(reinterpret_cast<const char *>(tree), tree_len);
+
+  uint8_t *blob = static_cast<uint8_t *>(malloc(out.size() ? out.size() : 1));
+  if (!blob) return 1;
+  memcpy(blob, out.data(), out.size());
   *out_blob = blob;
   *out_len = int64_t(out.size());
   return 0;

@@ -230,6 +230,132 @@ def test_ten_byte_varint_overflow_matches_oracle():
         _oracle_vs_native(content)
 
 
+def test_fused_push_request_matches_pure_encoder():
+    """`encode_push_request` must be structurally byte-compatible with
+    `protocol.encode_sync_request`: same field order, a decodable
+    messages stream whose ciphertexts the pure oracle decrypts to the
+    exact contents, and identical trailing scalar fields."""
+    msgs = _msgs()
+    body = native_crypto.encode_push_request(msgs, MN, "user-1", "f" * 16, '{"h":1}')
+    assert body is not None
+    req = protocol.decode_sync_request(body)
+    assert (req.user_id, req.node_id, req.merkle_tree) == ("user-1", "f" * 16, '{"h":1}')
+    assert len(req.messages) == len(msgs)
+    for m, e in zip(msgs, req.messages):
+        assert e.timestamp == m.timestamp
+        assert protocol.decode_content(decrypt_symmetric(e.content, MN)) == (
+            m.table, m.row, m.column,
+            int(m.value) if isinstance(m.value, bool) else m.value,
+        )
+    tail = protocol.encode_sync_request(
+        protocol.SyncRequest((), "user-1", "f" * 16, '{"h":1}')
+    )
+    assert body.endswith(tail)
+    # Unencodable values route the WHOLE batch to the pure path.
+    assert native_crypto.encode_push_request(
+        (CrdtMessage("t", "todo", "r", "c", b"raw"),), MN, "u", "n", "{}"
+    ) is None
+
+
+def test_fused_response_decode_parity_and_fallbacks():
+    """`decrypt_response` == decode_sync_response + decrypt_messages
+    for canonical rows, demotes non-canonical ciphertexts per message
+    (a gpg ZIP-compressed fixture decrypts identically through the
+    oracle at its position), falls back wholesale on non-canonical
+    wire, and raises the oracle's errors."""
+    msgs = _msgs()
+    enc = list(native_crypto.encrypt_batch(msgs, MN))
+    # Splice in a compressed gpg ciphertext (canonical-path reject).
+    gpg_ct = (FIXTURES / "gpg_aes256_s2k1024_zip.pgp").read_bytes()
+    enc.insert(3, protocol.EncryptedCrdtMessage("ts-gpg", gpg_ct))
+    resp_bytes = protocol.encode_sync_response(
+        protocol.SyncResponse(tuple(enc), '{"t":2}')
+    )
+    fused = native_crypto.decrypt_response(resp_bytes, MN)
+    assert fused is not None
+    got_msgs, got_tree = fused
+    resp = protocol.decode_sync_response(resp_bytes)
+    from evolu_tpu.sync.client import decrypt_messages
+
+    assert got_msgs == decrypt_messages(resp.messages, MN)
+    assert got_tree == '{"t":2}'
+
+    with pytest.raises(PgpError, match="wrong password"):
+        native_crypto.decrypt_response(resp_bytes, "nope")
+    # Garbage / non-canonical wire: wholesale fallback (None), so the
+    # pure decoder owns the ValueError surface.
+    assert native_crypto.decrypt_response(b"\x07garbage", MN) is None
+    # Truncated by one byte (the tree field's length no longer fits):
+    # also wholesale fallback, mirroring the pure decoder's ValueError.
+    assert native_crypto.decrypt_response(resp_bytes[:-1], MN) is None
+    with pytest.raises(ValueError):
+        protocol.decode_sync_response(resp_bytes[:-1])
+
+
+def test_overflow_length_varints_cannot_escape_bounds():
+    """r4 review finding: a 10-byte length varint carrying bit 63 would
+    wrap a naive `pos + len > n` check and drive heap over-reads on
+    untrusted response bytes (the bit-flip fuzz can't synthesize this
+    shape). All such inputs must demote cleanly — fused → None /
+    oracle error, never a crash — matching the pure decoder's
+    ValueError."""
+    huge = bytes([0xFF] * 9 + [0x01])  # varint = 2^64 - 1
+    crafted = [
+        # SyncResponse: field 1 with a wrapping length, then filler.
+        bytes([0x0A]) + huge + b"\x0a\x03abc" * 4,
+        # field 2 (merkleTree) with a wrapping length.
+        bytes([0x12]) + huge + b"xx",
+        # nested: valid message wrapper whose INNER field length wraps.
+        bytes([0x0A, 0x0C, 0x0A]) + huge + b"\x00",
+    ]
+    for data in crafted:
+        assert native_crypto.decrypt_response(data, MN) is None, data.hex()
+        with pytest.raises(ValueError):
+            protocol.decode_sync_response(data)
+    # The same shape inside a decrypted CONTENT (decode_content's wt2):
+    # oracle raises; the canonical path must demote, not over-read.
+    content = protocol.encode_content("t", "r", "c", None) + bytes([0x22]) + huge
+    _oracle_vs_native(content)
+
+
+def test_fuzz_decrypt_response_never_diverges_from_oracle():
+    """Random mutations of response bytes: whenever the fused C walker
+    accepts the wire (returns non-None), its outcome must equal the
+    pure decode+decrypt outcome exactly — value or error type. (A None
+    means production runs the pure path, equal by definition.)"""
+    import random
+
+    from evolu_tpu.sync.client import decrypt_messages
+
+    rng = random.Random(13)
+    base_msgs = _msgs(["a", 7, None])
+    enc = native_crypto.encrypt_batch(base_msgs, MN)
+    base = protocol.encode_sync_response(protocol.SyncResponse(enc, '{"x":1}'))
+    for trial in range(150):
+        b = bytearray(base)
+        for _ in range(rng.randint(1, 5)):
+            op = rng.random()
+            if op < 0.6 and b:
+                b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+            elif op < 0.8 and len(b) > 2:
+                del b[rng.randrange(len(b))]
+            else:
+                b.insert(rng.randrange(len(b) + 1), rng.randrange(256))
+        data = bytes(b)
+        try:
+            fused = native_crypto.decrypt_response(data, MN)
+        except (PgpError, ValueError) as e:
+            fused = type(e)
+        if fused is None:
+            continue  # production falls back to the pure path
+        try:
+            resp = protocol.decode_sync_response(data)
+            oracle = (decrypt_messages(resp.messages, MN), resp.merkle_tree)
+        except (PgpError, ValueError) as e:
+            oracle = type(e)
+        assert fused == oracle, f"trial {trial}"
+
+
 def test_fuzz_decrypt_batch_never_diverges_from_oracle():
     """Random mutations of valid ciphertexts: the batch path must
     either produce the oracle's value or raise the oracle's error —
