@@ -169,6 +169,29 @@ class RelayStore:
         messages = self.get_messages(request.user_id, request.node_id, tree, client_tree)
         return protocol.SyncResponse(messages, merkle_tree_to_string(tree))
 
+    def sync_wire(self, request: protocol.SyncRequest) -> Optional[bytes]:
+        """`sync` + `encode_sync_response` fused: the response messages
+        stream comes straight from ONE C call (zero per-row objects —
+        the cold-sync response leg was object-bound, BENCHMARKS r4),
+        byte-identical to the pure pipeline's encoding (test-pinned).
+        None → caller takes the object path (python backend)."""
+        if not hasattr(self.db, "fetch_relay_messages_wire"):
+            return None
+        tree = self.add_messages(request.user_id, request.messages)
+        client_tree = merkle_tree_from_string(request.merkle_tree)
+        diff = diff_merkle_trees(tree, client_tree)
+        if diff is None:
+            stream = b""
+        else:
+            since = timestamp_to_string(create_sync_timestamp(diff))
+            stream, _n = self.db.fetch_relay_messages_wire(
+                request.user_id, since, request.node_id
+            )
+        # add_messages just dumped + stored this exact tree: read the
+        # stored text back (one small SELECT) instead of a second
+        # ~25KB JSON dump per request (review finding).
+        return stream + protocol._string(2, self.get_merkle_tree_string(request.user_id))
+
     def user_ids(self) -> List[str]:
         return [r["userId"] for r in self.db.exec_sql_query('SELECT "userId" FROM "merkleTree"')]
 
@@ -218,6 +241,9 @@ class ShardedRelayStore:
     def sync(self, request: protocol.SyncRequest) -> protocol.SyncResponse:
         return self.shard_of(request.user_id).sync(request)
 
+    def sync_wire(self, request: protocol.SyncRequest) -> Optional[bytes]:
+        return self.shard_of(request.user_id).sync_wire(request)
+
     def user_ids(self) -> List[str]:
         return [u for s in self.shards for u in s.user_ids()]
 
@@ -250,8 +276,11 @@ class _Handler(BaseHTTPRequestHandler):
         body = self.rfile.read(length)
         try:
             request = protocol.decode_sync_request(body)
-            response = self.store.sync(request)
-            out = protocol.encode_sync_response(response)
+            out = self.store.sync_wire(request) if hasattr(
+                self.store, "sync_wire"
+            ) else None
+            if out is None:
+                out = protocol.encode_sync_response(self.store.sync(request))
         except Exception as e:  # noqa: BLE001 - index.ts:231-233
             self.send_error(500, str(e))
             return

@@ -83,11 +83,14 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.eh_parse_timestamps.argtypes = [s, i64, i64p, i32p, c.POINTER(c.c_uint64), u8p]
     lib.eh_run_many_tb.argtypes = [p, s, i64, c.c_int32, sp, i32p, i32p]
     lib.eh_get_messages.argtypes = [
-        p, s, s, s,
+        p, s, c.c_int32, s, s, c.c_int32,
         c.POINTER(c.c_char_p), c.POINTER(p), c.POINTER(i32p), c.POINTER(i64),
     ]
     lib.eh_free.argtypes = [p]
     lib.eh_exec_packed.argtypes = [p, c.POINTER(p), i64p, i64p]
+    lib.eh_get_messages_wire.argtypes = [
+        p, s, c.c_int32, s, s, c.c_int32, c.POINTER(p), i64p, i64p,
+    ]
     return lib
 
 
@@ -585,10 +588,13 @@ class CppSqliteDatabase:
         content_buf = ctypes.c_void_p()
         lens_ptr = ctypes.POINTER(ctypes.c_int32)()
         n = ctypes.c_int64(0)
+        u = user_id.encode()
+        nd = node_id.encode()
         with self._lock:
             self._check_open()
+            # Explicit lengths: wire-derived user/node may contain NUL.
             rc = lib.eh_get_messages(
-                self._db, user_id.encode(), since.encode(), node_id.encode(),
+                self._db, u, len(u), since.encode(), nd, len(nd),
                 ctypes.byref(ts_buf), ctypes.byref(content_buf),
                 ctypes.byref(lens_ptr), ctypes.byref(n),
             )
@@ -616,6 +622,39 @@ class CppSqliteDatabase:
             out.append((ts, content_raw[off : off + ln]))
             off += ln
         return out
+
+    def fetch_relay_messages_wire(
+        self, user_id: str, since: str, node_id: str
+    ) -> Tuple[bytes, int]:
+        """The same query emitted DIRECTLY as the SyncResponse
+        `messages` protobuf stream — byte-identical to encoding the
+        `fetch_relay_messages` rows with protocol.encode_sync_response,
+        with zero per-row Python objects (the relay cold-sync response
+        leg was object-construction-bound, docs/BENCHMARKS.md r4).
+        → (stream_bytes, row_count)."""
+        lib = self._lib
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        n = ctypes.c_int64(0)
+        u = user_id.encode()
+        nd = node_id.encode()
+        with self._lock:
+            self._check_open()
+            # Explicit lengths: wire-derived user/node may contain NUL.
+            rc = lib.eh_get_messages_wire(
+                self._db, u, len(u), since.encode(), nd, len(nd),
+                ctypes.byref(out), ctypes.byref(out_len), ctypes.byref(n),
+            )
+        if rc == 1:
+            raise self._err()
+        if rc == 2:
+            raise UnknownError("non-canonical timestamp width in relay store")
+        if rc != 0:
+            raise UnknownError("relay message fetch failed (out of memory?)")
+        try:
+            return ctypes.string_at(out.value, out_len.value), n.value
+        finally:
+            lib.eh_free(out)
 
     def relay_insert_packed(
         self,

@@ -610,8 +610,9 @@ int eh_run_many_tb(sqlite3 *db, const char *sql, int64_t nrows, int32_t ncols,
 // the caller frees with eh_free: fixed-width 46-byte timestamps,
 // concatenated contents, and per-row content lengths. Avoids the
 // per-row ctypes column reads (~10us/row) of the generic path. ---
-int eh_get_messages(sqlite3 *db, const char *user, const char *since,
-                    const char *node, char **out_ts, unsigned char **out_content,
+int eh_get_messages(sqlite3 *db, const char *user, int32_t user_len,
+                    const char *since, const char *node, int32_t node_len,
+                    char **out_ts, unsigned char **out_content,
                     int32_t **out_lens, int64_t *out_n) {
   const char *sql =
       "SELECT \"timestamp\", \"content\" FROM \"message\" "
@@ -619,9 +620,12 @@ int eh_get_messages(sqlite3 *db, const char *user, const char *since,
       "ORDER BY \"timestamp\"";
   sqlite3_stmt *st = nullptr;
   if (sqlite3_prepare_v2(db, sql, -1, &st, nullptr) != SQLITE_OK) return 1;
-  sqlite3_bind_text(st, 1, user, -1, SQLITE_TRANSIENT);
+  // Wire-derived user/node may contain NUL: explicit lengths (r4 —
+  // the char* form truncated and could serve divergent rows vs the
+  // Python backend).
+  sqlite3_bind_text(st, 1, user, user_len, SQLITE_TRANSIENT);
   sqlite3_bind_text(st, 2, since, -1, SQLITE_TRANSIENT);
-  sqlite3_bind_text(st, 3, node, -1, SQLITE_TRANSIENT);
+  sqlite3_bind_text(st, 3, node, node_len, SQLITE_TRANSIENT);
 
   std::string ts_buf;
   std::string content_buf;
@@ -662,6 +666,76 @@ int eh_get_messages(sqlite3 *db, const char *user, const char *since,
   *out_ts = ts_out;
   *out_content = content_out;
   *out_lens = lens_out;
+  return 0;
+}
+
+// --- relay response fast path: the same query as eh_get_messages,
+// emitted DIRECTLY as the SyncResponse `messages` field-1 protobuf
+// stream (per row: 0x0A varint(inner) ‖ 0x0A 0x2E ts46 ‖ 0x12
+// varint(clen) content) — byte-identical to
+// protocol.encode_sync_response's messages section, with zero per-row
+// Python objects. The caller appends the merkleTree field 2. ---
+
+static size_t eh_varint_size(uint64_t v) {
+  size_t n = 1;
+  while (v >= 0x80) { v >>= 7; n++; }
+  return n;
+}
+
+static void eh_put_varint(std::string &buf, uint64_t v) {
+  while (v >= 0x80) { buf.push_back(char(uint8_t(v) | 0x80)); v >>= 7; }
+  buf.push_back(char(uint8_t(v)));
+}
+
+int eh_get_messages_wire(sqlite3 *db, const char *user, int32_t user_len,
+                         const char *since, const char *node,
+                         int32_t node_len, unsigned char **out,
+                         int64_t *out_len, int64_t *out_n) {
+  const char *sql =
+      "SELECT \"timestamp\", \"content\" FROM \"message\" "
+      "WHERE \"userId\" = ? AND \"timestamp\" > ? AND \"timestamp\" NOT LIKE '%' || ? "
+      "ORDER BY \"timestamp\"";
+  sqlite3_stmt *st = nullptr;
+  if (sqlite3_prepare_v2(db, sql, -1, &st, nullptr) != SQLITE_OK) return 1;
+  // user/node come off the WIRE and may contain NUL — explicit lengths
+  // (the char* convention would truncate and serve divergent rows vs
+  // the Python backend; CLAUDE.md NUL invariant). `since` is a
+  // canonical 46-char timestamp, NUL-free by construction.
+  sqlite3_bind_text(st, 1, user, user_len, SQLITE_TRANSIENT);
+  sqlite3_bind_text(st, 2, since, -1, SQLITE_TRANSIENT);
+  sqlite3_bind_text(st, 3, node, node_len, SQLITE_TRANSIENT);
+
+  std::string buf;
+  int64_t rows = 0;
+  int rc;
+  while ((rc = sqlite3_step(st)) == SQLITE_ROW) {
+    const unsigned char *ts = sqlite3_column_text(st, 0);
+    if (sqlite3_column_bytes(st, 0) != 46) {  // fixed-width invariant
+      sqlite3_finalize(st);
+      return 2;
+    }
+    const void *blob = sqlite3_column_blob(st, 1);
+    size_t clen = size_t(sqlite3_column_bytes(st, 1));
+    size_t inner = 2 + 46 + 1 + eh_varint_size(clen) + clen;
+    buf.push_back(char(0x0A));
+    eh_put_varint(buf, inner);
+    buf.push_back(char(0x0A));
+    buf.push_back(char(46));
+    buf.append(reinterpret_cast<const char *>(ts), 46);
+    buf.push_back(char(0x12));
+    eh_put_varint(buf, clen);
+    if (clen) buf.append(static_cast<const char *>(blob), clen);
+    rows++;
+  }
+  sqlite3_finalize(st);
+  if (rc != SQLITE_DONE) return 1;
+  unsigned char *p =
+      static_cast<unsigned char *>(malloc(buf.size() ? buf.size() : 1));
+  if (!p) return 3;
+  memcpy(p, buf.data(), buf.size());
+  *out = p;
+  *out_len = static_cast<int64_t>(buf.size());
+  *out_n = rows;
   return 0;
 }
 

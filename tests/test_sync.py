@@ -498,6 +498,64 @@ def test_get_messages_identical_across_backends():
     assert [m.timestamp for m in outs[0]] == [other]
 
 
+def test_sync_wire_byte_identical_to_object_path():
+    """`RelayStore.sync_wire` (one C call emitting the response
+    messages stream, r4) must be BYTE-identical to
+    encode_sync_response(store.sync(request)) across the three round
+    shapes — cold pull, push, steady state — including NUL/0-length
+    contents. Two stores replicate the same state so both paths see
+    identical inputs."""
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.storage.native import native_available
+
+    if not native_available():
+        pytest.skip("native backend unavailable")
+    msgs = tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(
+                Timestamp(1_700_000_000_000 + i * 60_000, i % 4, "a1b2c3d4e5f60718")
+            ),
+            bytes([i % 256]) * (i % 50) + b"\x00\xfe" if i % 3 else b"",
+        )
+        for i in range(120)
+    )
+    a, b = RelayStore(), RelayStore()
+    try:
+        for s in (a, b):
+            s.add_messages("u1", msgs)
+        cold = protocol.SyncRequest((), "u1", "e" * 16, "{}")
+        pure = protocol.encode_sync_response(a.sync(cold))
+        wire = b.sync_wire(cold)
+        assert wire == pure
+
+        push = protocol.SyncRequest(msgs[:5], "u2", "f" * 16, "{}")
+        assert b.sync_wire(push) == protocol.encode_sync_response(a.sync(push))
+
+        steady = protocol.SyncRequest(
+            (), "u1", "e" * 16, protocol.decode_sync_response(pure).merkle_tree
+        )
+        assert b.sync_wire(steady) == protocol.encode_sync_response(a.sync(steady))
+
+        # NUL-bearing wire ids must bind with explicit lengths (r4: the
+        # char* form truncated 'u\x00evil' to 'u', serving another
+        # owner's rows on the native backend only).
+        nul = protocol.SyncRequest(msgs[:2], "u\x00evil", "n\x00" + "f" * 14, "{}")
+        assert b.sync_wire(nul) == protocol.encode_sync_response(a.sync(nul))
+        # The fused CLIENT decoder consumes the fused SERVER bytes:
+        # these contents aren't real OpenPGP, so every row demotes and
+        # the oracle's PgpError surfaces — which proves the wire LAYER
+        # itself parsed cleanly end to end (a wire rejection would
+        # return None instead of raising).
+        from evolu_tpu.sync import native_crypto
+        from evolu_tpu.sync.crypto import PgpError
+
+        if native_crypto.native_available():
+            with pytest.raises(PgpError):
+                native_crypto.decrypt_response(wire, "x")
+    finally:
+        a.close(), b.close()
+
+
 def test_merkle_tree_string_verbatim_and_respond_reuse():
     """`get_merkle_tree_string` must return the STORED text verbatim
     (the respond path serves it without a parse→re-dump round trip —
