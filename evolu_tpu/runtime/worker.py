@@ -485,11 +485,11 @@ class DbWorker:
         the extra patch is harmless)."""
         patches = []
         raw_capable = hasattr(self.db, "exec_sql_query_packed_raw")
+        if raw_capable:
+            from evolu_tpu.storage.native import unpack_packed_rows
         for q in queries:
             sql, parameters = msg.deserialize_query(q)
             if raw_capable:
-                from evolu_tpu.storage.native import unpack_packed_rows
-
                 raw = self.db.exec_sql_query_packed_raw(sql, parameters)
                 prev_raw = self._staged_raw.get(q, self.queries_raw_cache.get(q))
                 cached = q in self._staged_cache or q in self.queries_rows_cache

@@ -30,6 +30,8 @@
 #include <string>
 #include <vector>
 
+#include "wire.h"
+
 // ---- OpenSSL 3 ABI (self-declared; no headers in the image) ----
 
 extern "C" {
@@ -67,17 +69,9 @@ namespace {
 // ---- small helpers ----
 
 // proto3 varint of a (two's-complement) 64-bit value; negatives emit
-// the 10-byte form — bit-exact with crypto.py's _varint.
-inline size_t varint_size(uint64_t v) {
-  size_t n = 1;
-  while (v >= 0x80) { v >>= 7; n++; }
-  return n;
-}
-inline uint8_t *put_varint(uint8_t *p, uint64_t v) {
-  while (v >= 0x80) { *p++ = uint8_t(v) | 0x80; v >>= 7; }
-  *p++ = uint8_t(v);
-  return p;
-}
+// the 10-byte form — bit-exact with crypto.py's _varint. ONE shared
+// implementation with libevolu_host (wire.h).
+using ::wire_varint_size;
 
 // New-format OpenPGP packet header length octets (RFC 4880 §4.2.2).
 inline size_t pkt_len_size(size_t n) { return n < 192 ? 1 : (n < 8384 ? 2 : 5); }
@@ -168,11 +162,11 @@ constexpr int64_t INT32_LO = -(int64_t(1) << 31), INT32_HI = (int64_t(1) << 31) 
 size_t content_size(const int32_t lens[4], int8_t vkind, int64_t ival) {
   size_t n = 0;
   for (int f = 0; f < 3; f++)
-    n += 1 + varint_size(uint64_t(lens[f])) + size_t(lens[f]);
+    n += 1 + wire_varint_size(uint64_t(lens[f])) + size_t(lens[f]);
   if (vkind == 1) {
-    n += 1 + varint_size(uint64_t(lens[3])) + size_t(lens[3]);
+    n += 1 + wire_varint_size(uint64_t(lens[3])) + size_t(lens[3]);
   } else if (vkind == 2) {
-    n += 1 + varint_size(uint64_t(ival));  // field 5 or 7, same wire size
+    n += 1 + wire_varint_size(uint64_t(ival));  // field 5 or 7, same wire size
   } else if (vkind == 3) {
     n += 1 + 8;
   }
@@ -184,18 +178,18 @@ uint8_t *put_content(uint8_t *p, const uint8_t *strs, const int32_t lens[4],
   const uint8_t *s = strs;
   for (int f = 0; f < 3; f++) {
     *p++ = uint8_t(((f + 1) << 3) | 2);
-    p = put_varint(p, uint64_t(lens[f]));
+    p = wire_put_varint(p, uint64_t(lens[f]));
     memcpy(p, s, size_t(lens[f]));
     p += lens[f]; s += lens[f];
   }
   if (vkind == 1) {
     *p++ = uint8_t((4 << 3) | 2);
-    p = put_varint(p, uint64_t(lens[3]));
+    p = wire_put_varint(p, uint64_t(lens[3]));
     memcpy(p, s, size_t(lens[3]));
     p += lens[3];
   } else if (vkind == 2) {
     *p++ = uint8_t(ival >= INT32_LO && ival <= INT32_HI ? (5 << 3) : (7 << 3));
-    p = put_varint(p, uint64_t(ival));
+    p = wire_put_varint(p, uint64_t(ival));
   } else if (vkind == 3) {
     *p++ = uint8_t((6 << 3) | 1);
     uint64_t bits;
@@ -356,12 +350,12 @@ int ehc_encrypt_wire_batch(int64_t n, const uint8_t *ts_blob,
       return 1;
     size_t c = content_size(L, vkinds[i], ivals[i]);
     size_t ct = message_size(c);
-    size_t in = 1 + varint_size(uint64_t(ts_lens[i])) + size_t(ts_lens[i]) +
-                1 + varint_size(ct) + ct;
+    size_t in = 1 + wire_varint_size(uint64_t(ts_lens[i])) + size_t(ts_lens[i]) +
+                1 + wire_varint_size(ct) + ct;
     clen[size_t(i)] = c;
     ctsz[size_t(i)] = ct;
     inner[size_t(i)] = in;
-    out_total += 1 + varint_size(in) + in;
+    out_total += 1 + wire_varint_size(in) + in;
   }
   uint8_t *out = static_cast<uint8_t *>(malloc(out_total ? out_total : 1));
   if (!out) return 1;
@@ -375,14 +369,14 @@ int ehc_encrypt_wire_batch(int64_t n, const uint8_t *ts_blob,
   for (int64_t i = 0; i < n; i++) {
     const int32_t *L = lens4 + 4 * i;
     *p++ = 0x0A;  // SyncRequest.messages, field 1, wt 2
-    p = put_varint(p, uint64_t(inner[size_t(i)]));
+    p = wire_put_varint(p, uint64_t(inner[size_t(i)]));
     *p++ = 0x0A;  // EncryptedCrdtMessage.timestamp
-    p = put_varint(p, uint64_t(ts_lens[i]));
+    p = wire_put_varint(p, uint64_t(ts_lens[i]));
     memcpy(p, ts, size_t(ts_lens[i]));
     p += ts_lens[i];
     ts += ts_lens[i];
     *p++ = 0x12;  // EncryptedCrdtMessage.content, field 2, wt 2
-    p = put_varint(p, uint64_t(ctsz[size_t(i)]));
+    p = wire_put_varint(p, uint64_t(ctsz[size_t(i)]));
     if (!emit_message(cx, password, size_t(pw_len), rnd.data() + 24 * i, strs,
                       L, vkinds[i], ivals[i], dvals[i], clen[size_t(i)], plainbuf,
                       p)) {

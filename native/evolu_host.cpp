@@ -33,6 +33,8 @@
 #include <string>
 #include <vector>
 
+#include "wire.h"
+
 extern "C" {
 
 // --- SQLite C ABI (subset) ---
@@ -676,17 +678,6 @@ int eh_get_messages(sqlite3 *db, const char *user, int32_t user_len,
 // protocol.encode_sync_response's messages section, with zero per-row
 // Python objects. The caller appends the merkleTree field 2. ---
 
-static size_t eh_varint_size(uint64_t v) {
-  size_t n = 1;
-  while (v >= 0x80) { v >>= 7; n++; }
-  return n;
-}
-
-static void eh_put_varint(std::string &buf, uint64_t v) {
-  while (v >= 0x80) { buf.push_back(char(uint8_t(v) | 0x80)); v >>= 7; }
-  buf.push_back(char(uint8_t(v)));
-}
-
 int eh_get_messages_wire(sqlite3 *db, const char *user, int32_t user_len,
                          const char *since, const char *node,
                          int32_t node_len, unsigned char **out,
@@ -716,14 +707,14 @@ int eh_get_messages_wire(sqlite3 *db, const char *user, int32_t user_len,
     }
     const void *blob = sqlite3_column_blob(st, 1);
     size_t clen = size_t(sqlite3_column_bytes(st, 1));
-    size_t inner = 2 + 46 + 1 + eh_varint_size(clen) + clen;
+    size_t inner = 2 + 46 + 1 + wire_varint_size(clen) + clen;
     buf.push_back(char(0x0A));
-    eh_put_varint(buf, inner);
+    wire_put_varint(buf, inner);
     buf.push_back(char(0x0A));
     buf.push_back(char(46));
     buf.append(reinterpret_cast<const char *>(ts), 46);
     buf.push_back(char(0x12));
-    eh_put_varint(buf, clen);
+    wire_put_varint(buf, clen);
     if (clen) buf.append(static_cast<const char *>(blob), clen);
     rows++;
   }

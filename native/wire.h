@@ -1,0 +1,26 @@
+// Shared protobuf wire primitives for the native layer — ONE varint
+// implementation for libevolu_host (relay response stream) and
+// libevolu_crypto (SyncRequest stream / response parse), so the wire
+// encoding can never drift between the two .so files.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+inline size_t wire_varint_size(uint64_t v) {
+  size_t n = 1;
+  while (v >= 0x80) { v >>= 7; n++; }
+  return n;
+}
+
+inline void wire_put_varint(std::string &buf, uint64_t v) {
+  while (v >= 0x80) { buf.push_back(char(uint8_t(v) | 0x80)); v >>= 7; }
+  buf.push_back(char(uint8_t(v)));
+}
+
+inline uint8_t *wire_put_varint(uint8_t *p, uint64_t v) {
+  while (v >= 0x80) { *p++ = uint8_t(v) | 0x80; v >>= 7; }
+  *p++ = uint8_t(v);
+  return p;
+}
