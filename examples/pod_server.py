@@ -1,0 +1,90 @@
+"""Pod-scale relay: the WHOLE server spanning a jax.distributed
+cluster (`engine.reconcile_pod` — reference apps/server/src/index.ts
+at the BASELINE "one pod pass" scale).
+
+Each process owns the storage shards of the owners the stable crc32
+hash assigns to it; the Merkle device leg runs as ONE SPMD dispatch
+over the global mesh (DCN carries collectives, never rows), and the
+XOR digest all-reduce lets every process verify the pod agreed on the
+batch. Identical request batches must reach every process (the
+broadcast-ingest model — e.g. a front-end fanning out, or a shared
+queue).
+
+Single process (degenerates to the plain engine, byte-identically):
+
+    python examples/pod_server.py
+
+Two processes on one machine (4 virtual CPU devices each → an
+8-device global mesh; same flags a real multi-host pod would use,
+with real addresses):
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+      python examples/pod_server.py --nproc 2 --pid 0 &
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+      python examples/pod_server.py --nproc 2 --pid 1
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nproc", type=int, default=1)
+    ap.add_argument("--pid", type=int, default=0)
+    ap.add_argument("--coordinator", default="127.0.0.1:9765")
+    ap.add_argument("--store", default=":memory:")
+    args = ap.parse_args()
+
+    if args.nproc > 1:
+        from evolu_tpu.parallel.multihost import initialize_multihost
+
+        mesh = initialize_multihost(args.coordinator, args.nproc, args.pid)
+    else:
+        from evolu_tpu.parallel.mesh import create_mesh
+
+        mesh = create_mesh()
+
+    from evolu_tpu.core.merkle import (
+        apply_prefix_xors,
+        merkle_tree_to_string,
+        minute_deltas_host,
+    )
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.server.engine import reconcile_pod
+    from evolu_tpu.server.relay import ShardedRelayStore
+    from evolu_tpu.sync import protocol
+
+    store = ShardedRelayStore(args.store, shards=4)
+
+    # A demo batch: 8 owners pushing their own new messages with their
+    # post-apply trees (the steady-state shape). In production this
+    # batch arrives from the ingest fabric, identical on every process.
+    base = 1_700_000_000_000
+    requests = []
+    for o in range(8):
+        msgs = [
+            protocol.EncryptedCrdtMessage(
+                timestamp_to_string(Timestamp(base + (o * 997 + i) * 60_000, i % 4,
+                                              f"{o + 1:016x}")),
+                b"ciphertext-%d-%d" % (o, i),
+            )
+            for i in range(5 + o)
+        ]
+        deltas, _ = minute_deltas_host(m.timestamp for m in msgs)
+        tree = merkle_tree_to_string(apply_prefix_xors({}, deltas))
+        requests.append(protocol.SyncRequest(tuple(msgs), f"owner{o}", "f" * 16, tree))
+
+    responses, digest = reconcile_pod(mesh, store, tuple(requests))
+    mine = [i for i, r in enumerate(responses) if r is not None]
+    print(
+        f"proc {args.pid}/{args.nproc}: answered {len(mine)}/{len(requests)} "
+        f"requests {mine}, pod digest 0x{digest & 0xFFFFFFFF:08x}"
+    )
+    store.close()
+
+
+if __name__ == "__main__":
+    main()
