@@ -57,7 +57,13 @@ def main() -> None:
     from evolu_tpu.server.relay import ShardedRelayStore
     from evolu_tpu.sync import protocol
 
-    store = ShardedRelayStore(args.store, shards=4)
+    # Namespace file-backed stores per process: owner→shard (crc32 % 4)
+    # is independent of owner→process, so a shared path would have two
+    # OS processes writing the same SQLite files.
+    path = args.store if args.store == ":memory:" or args.nproc == 1 else (
+        f"{args.store}.p{args.pid}"
+    )
+    store = ShardedRelayStore(path, shards=4)
 
     # A demo batch: 8 owners pushing their own new messages with their
     # post-apply trees (the steady-state shape). In production this

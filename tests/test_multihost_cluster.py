@@ -102,6 +102,64 @@ def test_pod_server_across_two_processes(tmp_path):
         assert len(set(digests[rnd])) == 1, f"{rnd}: digests diverged {digests[rnd]}"
 
 
+def test_pod_single_process_quarantines_non_canonical_owner(tmp_path):
+    """An owner whose batch carries non-canonical hex case must take
+    the host fold on its owning process (device hashing re-renders
+    canonical case and would diverge) — responses still byte-equal to
+    the single-process engine, which quarantines identically."""
+    from evolu_tpu.server.engine import BatchReconciler, reconcile_pod
+    from evolu_tpu.server.relay import ShardedRelayStore
+    from evolu_tpu.sync.protocol import (
+        EncryptedCrdtMessage,
+        SyncRequest,
+        encode_sync_response,
+    )
+    from evolu_tpu.core.merkle import (
+        apply_prefix_xors,
+        merkle_tree_to_string,
+        minute_deltas_host,
+    )
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.parallel.mesh import create_mesh
+
+    base = 1_700_000_000_000
+    reqs = []
+    for o, canonical in ((0, True), (1, False), (2, True)):
+        node = f"{0xABCDEF1234567890 + o:016x}"  # hex LETTERS present
+        ts = [
+            timestamp_to_string(Timestamp(base + (o * 7 + i) * 60_000, i, node))
+            for i in range(4)
+        ]
+        if not canonical:
+            # Uppercase NODE hex: parses fine, but the reference hashes
+            # the verbatim string — the canonical-case quarantine trigger.
+            ts2 = [t[:25] + t[25:].replace("a", "A").replace("b", "B") for t in ts]
+            assert ts2 != ts, "transform must actually change the strings"
+            ts = ts2
+        msgs = tuple(EncryptedCrdtMessage(t, b"ct-%d" % o) for t in ts)
+        deltas, _ = minute_deltas_host(iter(ts))
+        tree = merkle_tree_to_string(apply_prefix_xors({}, deltas))
+        reqs.append(SyncRequest(msgs, f"owner{o}", "f" * 16, tree))
+
+    mesh = create_mesh()
+    pod_store = ShardedRelayStore(str(tmp_path / "pod"), shards=2)
+    ref_store = ShardedRelayStore(str(tmp_path / "ref"), shards=2)
+    eng = BatchReconciler(ref_store)
+    try:
+        pod_resp, _digest = reconcile_pod(mesh, pod_store, tuple(reqs))
+        ref_resp = eng.reconcile(tuple(reqs))
+        for i, (p, r) in enumerate(zip(pod_resp, ref_resp)):
+            assert p is not None
+            assert encode_sync_response(p) == encode_sync_response(r), f"req {i}"
+        # The non-canonical owner's tree really did come from the host
+        # fold: it must match an independent host recompute verbatim.
+        host_deltas, _ = minute_deltas_host(m.timestamp for m in reqs[1].messages)
+        want = merkle_tree_to_string(apply_prefix_xors({}, host_deltas))
+        assert pod_resp[1].merkle_tree == want
+    finally:
+        eng.close(), pod_store.close(), ref_store.close()
+
+
 def test_two_process_cluster_reconcile():
     port = _free_port()
     env = {k: v for k, v in os.environ.items()
