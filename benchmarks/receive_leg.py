@@ -1,0 +1,143 @@
+"""The client receive leg (VERDICT r4 missing #1 / next #1): response
+wire bytes → decrypted batch → applied SQLite state.
+
+r4 measured the decode stage at 154k msgs/s with the floor attributed
+to per-message CrdtMessage construction (~4 µs/msg of pure object
+layer, docs/BENCHMARKS.md). The r5 fused path
+(`ehc_decrypt_response_columns` → PackedReceive →
+`eh_apply_planned_cells`) removes the object layer end to end. This
+script measures both stages both ways on the same response bytes:
+
+- decode: wire → batch (object path `decrypt_response` vs columns
+  path `decrypt_response_columns`);
+- full leg: wire → planned → committed SQLite rows + Merkle tree
+  (object apply vs packed apply), fresh database per trial.
+
+Median-of-trials protocol (within-run trials correlate; the median is
+the per-run statistic, docs/BENCHMARKS.md r4). Prints one JSON line.
+"""
+
+import json
+import os
+import random
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.runtime.worker import select_planner
+from evolu_tpu.storage.apply import apply_messages
+from evolu_tpu.storage.native import open_database
+from evolu_tpu.storage.schema import init_db_model
+from evolu_tpu.sync import native_crypto, protocol
+from evolu_tpu.sync.client import encrypt_messages
+from evolu_tpu.utils.config import Config
+
+N = int(os.environ.get("RECEIVE_N", 50_000))
+TRIALS = int(os.environ.get("RECEIVE_TRIALS", 5))
+MN = "legal winner thank year wave sausage worth useful legal winner thank yellow"
+
+
+def build_messages(n=N, seed=4):
+    # The config-3 value mix: short strings, ints, None deletes.
+    rng = random.Random(seed)
+    vals = [lambda i: f"todo item {i} ✓", lambda i: i % 2, lambda i: None,
+            lambda i: f"note {i}: café", lambda i: i * 977]
+    nodes = [f"{rng.getrandbits(64):016x}" for _ in range(8)]
+    out = []
+    for i in range(n):
+        out.append(CrdtMessage(
+            timestamp_to_string(Timestamp(1_700_000_000_000 + i // 4, i % 4,
+                                          rng.choice(nodes))),
+            "todo", f"row{rng.randrange(5000)}", "title", vals[i % 5](i),
+        ))
+    return out
+
+
+def mkdb():
+    db = open_database(backend="auto")
+    init_db_model(db, mnemonic=None)
+    db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title" BLOB)')
+    return db
+
+
+def median_rate(fn, trials=TRIALS):
+    rates = []
+    for _ in range(trials):
+        dt = fn()
+        rates.append(N / dt)
+    return statistics.median(rates)
+
+
+def main():
+    msgs = build_messages()
+    resp = protocol.encode_sync_response(
+        protocol.SyncResponse(tuple(encrypt_messages(msgs, MN)), "{}")
+    )
+
+    # -- decode stage --
+    def decode_objects():
+        t0 = time.perf_counter()
+        out = native_crypto.decrypt_response(resp, MN)
+        dt = time.perf_counter() - t0
+        assert out is not None and len(out[0]) == N
+        return dt
+
+    def decode_columns():
+        t0 = time.perf_counter()
+        out = native_crypto.decrypt_response_columns(resp, MN)
+        dt = time.perf_counter() - t0
+        assert out is not None and len(out[0]) == N
+        return dt
+
+    dec_obj = median_rate(decode_objects)
+    dec_col = median_rate(decode_columns)
+
+    # -- full leg: decode + plan + apply on a fresh DB --
+    def full(mode):
+        def trial():
+            db = mkdb()
+            planner = select_planner(Config(), db)
+            # Warm the jit bucket outside the timed region (a
+            # long-running client compiles once per bucket).
+            t0 = time.perf_counter()
+            if mode == "packed":
+                pb, _tree = native_crypto.decrypt_response_columns(resp, MN)
+                apply_messages(db, {}, pb, planner=planner)
+            else:
+                batch, _tree = native_crypto.decrypt_response(resp, MN)
+                apply_messages(db, {}, batch, planner=planner)
+            dt = time.perf_counter() - t0
+            n_rows = db.exec_sql_query('SELECT COUNT(*) FROM "__message"', ())
+            assert next(iter(n_rows[0].values())) == N
+            db.close()
+            return dt
+
+        # one unmeasured warm trial per mode (jit compile for the bucket)
+        trial()
+        return median_rate(trial)
+
+    full_obj = full("objects")
+    full_pk = full("packed")
+
+    print(json.dumps({
+        "metric": "receive_leg_full_msgs_per_sec",
+        "value": round(full_pk),
+        "unit": "msgs/sec",
+        "detail": {
+            "n": N, "trials": TRIALS,
+            "decode_objects_msgs_per_sec": round(dec_obj),
+            "decode_columns_msgs_per_sec": round(dec_col),
+            "decode_speedup": round(dec_col / dec_obj, 2),
+            "full_objects_msgs_per_sec": round(full_obj),
+            "full_packed_msgs_per_sec": round(full_pk),
+            "full_speedup": round(full_pk / full_obj, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -174,6 +174,56 @@ def receive_timestamps_batch(
     (a u64 compare would be case-insensitive for non-canonical
     uppercase wire hex, diverging from the sequential fold).
     """
+    return _receive_batch(
+        local, millis, counter, now, max_drift,
+        dup_screen=lambda: any(h == local.node for h in node_hex),
+        nodes=lambda: node_hex,
+    )
+
+
+def receive_timestamps_batch_packed(
+    local: Timestamp,
+    millis,
+    counter,
+    node_u64,
+    nodes,
+    now: int = 0,
+    max_drift: int = 60000,
+) -> Timestamp:
+    """`receive_timestamps_batch` for the fused receive path: node ids
+    arrive as the parsed uint64 column, and `nodes` is a zero-arg
+    callable materializing the raw node STRINGS — invoked only when a
+    screen fires and the exact sequential fold must run. The
+    duplicate-node screen compares u64 values, which is
+    case-insensitive and therefore a SUPERSET of the sequential fold's
+    exact string equality: a false positive only costs the slow path
+    (which then applies the exact rule), never a wrong outcome."""
+    import numpy as np
+
+    try:
+        local_u64 = np.uint64(int(local.node, 16))
+    except ValueError:  # non-hex local node: conservatively sequential
+        return _receive_batch(
+            local, millis, counter, now, max_drift,
+            dup_screen=lambda: True, nodes=nodes,
+        )
+    return _receive_batch(
+        local, millis, counter, now, max_drift,
+        dup_screen=lambda: bool(
+            (np.asarray(node_u64, np.uint64) == local_u64).any()
+        ),
+        nodes=nodes,
+    )
+
+
+def _receive_batch(
+    local: Timestamp, millis, counter, now: int, max_drift: int,
+    dup_screen, nodes,
+) -> Timestamp:
+    """Shared closed-form fold. `dup_screen()` must be True whenever
+    ANY remote node string-equals the local node (supersets allowed —
+    they only force the sequential path); `nodes()` materializes the
+    raw node strings for that exact path."""
     import numpy as np
 
     n = len(millis)
@@ -203,9 +253,10 @@ def receive_timestamps_batch(
     )
     if (
         int(pm[-1]) - now > max_drift
-        or any(h == local.node for h in node_hex)
+        or dup_screen()
         or counter_bound > MAX_COUNTER
     ):
+        node_hex = nodes()
         t = local
         for i in range(n):
             t = receive_timestamp(

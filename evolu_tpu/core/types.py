@@ -143,6 +143,16 @@ class UnknownError(EvoluError):
         return {"type": self.type, "error": {"message": str(self.error)}}
 
 
+class NonCanonicalStoreError(UnknownError):
+    """A stored relay timestamp is not the canonical 46-byte width, so
+    the packed C fetch paths (which assume fixed-width rows) cannot
+    serve it. Callers fall back to the generic SQL path — a single
+    malformed stored row must degrade that owner's sync to the slow
+    path, not wedge it (advisor r4)."""
+
+    type = "UnknownError"  # wire-visible type is unchanged
+
+
 @dataclass(frozen=True)
 class Owner:
     """A database owner: identity derived from a BIP39 mnemonic (types.ts:149-153)."""

@@ -189,6 +189,12 @@ def plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras=(), return_winn
     updates on TPU — ~100ms+ per call at N=1M vs ~15ms for a sort),
     and no post-sort gathers (all per-row data rides through the sort
     as payload operands, ~8x cheaper than u64 gathers at N=1M).
+
+    MUST be traced inside an enable_x64(True) scope (like
+    segment_xor2_core): the packed merge key is a real i64 — under
+    x64-disabled tracing it would silently degrade to int32 and the
+    `cell << 24` shift would scramble the plan for any cell_id >= 128.
+    Guarded at trace time below.
     """
     n = cell_id.shape[0]
     idx = jnp.arange(n, dtype=jnp.int32)
@@ -203,6 +209,11 @@ def plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras=(), return_winn
         # than the 2-key sort). Cell ids are non-negative (interned,
         # pad = int32 max), so the packed key sorts pads last.
         key = (cell_id.astype(jnp.int64) << jnp.int64(24)) | idx.astype(jnp.int64)
+        if key.dtype != jnp.dtype("int64"):  # x64 disabled: would mis-plan
+            raise TypeError(
+                "plan_merge_sorted_core must be traced under enable_x64(True): "
+                f"packed merge key degraded to {key.dtype}"
+            )
         sorted_ops = jax.lax.sort(
             (key, k1, k2, ex_k1, ex_k2) + tuple(extras),
             num_keys=1, is_stable=False,
@@ -471,6 +482,66 @@ def _plan_full_kernel(cell_id, k1, k2, ex_k1, ex_k2):
     return xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid_sorted
 
 
+def plan_packed_streamed(db, pb, millis, counter, node, cells, touched_ids):
+    """Packed plan with winners streamed from SQLite for the touched
+    cells — ONE copy of the fetch + scatter + kernel-call sequence,
+    shared by the winner cache's streaming mode and the no-cache packed
+    route (they must stay identical or the cache-on/off paths diverge).
+    `cells` are the touched unique cells; `touched_ids` their indices
+    into `pb.cells`. None on a non-canonical stored winner (the caller
+    materializes to the object path)."""
+    from evolu_tpu.storage.apply import fetch_existing_winners
+
+    winners = fetch_existing_winners(db, cells)
+    ex1_t, ex2_t, canonical = winner_key_columns(cells, winners)
+    if not canonical:
+        return None
+    ex1 = np.zeros(len(pb.cells), np.uint64)
+    ex2 = np.zeros(len(pb.cells), np.uint64)
+    ex1[touched_ids] = ex1_t
+    ex2[touched_ids] = ex2_t
+    k1 = pack_ts_key_host(millis, counter)
+    return plan_packed_device_full(
+        pb.cell_id, k1, node, ex1[pb.cell_id], ex2[pb.cell_id], pb.n
+    )
+
+
+def _run_full_plan(cell_ids, k1, k2, ex_k1, ex_k2, n: int):
+    """ONE copy of the full-plan dispatch sequence (pad →
+    `_plan_full_kernel` → one-wave pull → unpermute → delta decode),
+    shared by `plan_batch_device_full` and `plan_packed_device_full` —
+    the object and packed routes must produce identical plans, so the
+    sequence lives here. → (xor_mask, upsert_mask, deltas), masks in
+    batch order, length n. Callers hold the x64 scope and have already
+    verified the canonical-case invariant."""
+    from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas
+
+    (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns(
+        [cell_ids, k1, k2, ex_k1, ex_k2], n
+    )
+    outs = _plan_full_kernel(
+        jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
+        jnp.asarray(ex_k1), jnp.asarray(ex_k2),
+    )
+    xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = to_host_many(*outs)
+    xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s)
+    deltas = decode_owner_minute_deltas(
+        np.zeros(size, np.int32), minute_sorted, seg_end, seg_xor, valid
+    ).get(0, {})
+    return xor_mask[:n], upsert_mask[:n], deltas
+
+
+@with_x64
+def plan_packed_device_full(cell_ids, k1, k2, ex_k1, ex_k2, n: int):
+    """Columns-only twin of `plan_batch_device_full` for the fused
+    receive path (PackedReceive): same kernel, but the result is
+    `(xor_mask, upsert_mask, deltas)` with positional numpy masks
+    only — the packed SQLite apply binds straight from the batch
+    buffers, so no `upserts` message list is ever built."""
+    with span("kernel:merge", "plan_packed_device_full", n=n):
+        return _run_full_plan(cell_ids, k1, k2, ex_k1, ex_k2, n)
+
+
 @with_x64
 def plan_batch_device_full(
     messages: Sequence[CrdtMessage],
@@ -482,8 +553,6 @@ def plan_batch_device_full(
     the apply path never hashes timestamps in Python (the reference's
     hot loop #4 eliminated host-side). `cols` optionally reuses a
     caller's `messages_to_columns` result."""
-    from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas
-
     n = len(messages)
     if n == 0:
         return [], [], {}
@@ -493,21 +562,9 @@ def plan_batch_device_full(
         )
         if not rest[-1]:  # canonical flag
             return _host_fallback(messages, existing_winners, n, with_deltas=True)
-        (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns(
-            [cell_ids, k1, k2, ex_k1, ex_k2], n
+        xor_mask, upsert_mask, deltas = _run_full_plan(
+            cell_ids, k1, k2, ex_k1, ex_k2, n
         )
-        outs = _plan_full_kernel(
-            jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
-            jnp.asarray(ex_k1), jnp.asarray(ex_k2),
-        )
-        # ONE transfer wave for all 7 outputs (per-array pulls pay one
-        # tunnel RTT each).
-        xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = to_host_many(*outs)
-        xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s)
-        xor_mask, upsert_mask = xor_mask[:n], upsert_mask[:n]
-        deltas = decode_owner_minute_deltas(
-            np.zeros(size, np.int32), minute_sorted, seg_end, seg_xor, valid
-        ).get(0, {})
         return PlannedBatch(
             xor_mask.tolist(), select_messages(messages, upsert_mask), deltas, upsert_mask
         )

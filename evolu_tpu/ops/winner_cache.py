@@ -255,6 +255,65 @@ class DeviceWinnerCache:
 
     # -- the planner --
 
+    def _adaptive_gate(self, cells):
+        """ONE copy of the adaptive seeding gate (EWMA + streaming
+        hysteresis) shared by `plan_batch` and `plan_packed` — the two
+        flows must keep identical cache behavior, so the state machine
+        lives here. Updates the EWMA and mode, returns
+        (mode, new_cells): "stream" = plan with SQLite-streamed winners
+        (cache dropped on entry); "cached" = seed `new_cells` then plan
+        from HBM."""
+        if not self.adaptive and self._streaming:
+            # The gate was disabled while streaming (tests / ops
+            # pinning the static path): leave streaming mode so the
+            # cached path reseeds from SQLite — keeping `known = _known`
+            # here would skip seeding cells whose slots were dropped at
+            # the streaming switch (KeyError).
+            self._streaming = False
+            self._known = set()
+        known = self._known if self._streaming else self._slots
+        new_cells = [c for c in cells if c not in known]
+        rate = len(new_cells) / len(cells)
+        if self._skip_ewma_once:
+            self._skip_ewma_once = False
+            self._ewma_suppressed = True
+        else:
+            self._seed_ewma = (
+                (1 - self._EWMA_NEW_WEIGHT) * self._seed_ewma
+                + self._EWMA_NEW_WEIGHT * rate
+            )
+            self._ewma_suppressed = False
+        if not self.adaptive:
+            return "cached", new_cells
+        if self._streaming:
+            # Bound the membership estimator: sustained churn (the
+            # very workload streaming targets) would otherwise grow
+            # it forever. On overflow, restart it from this batch —
+            # the one-batch rate spike only reinforces streaming.
+            if len(self._known) > self._KNOWN_CAP:
+                self._known = set(cells)
+            else:
+                self._known.update(cells)
+            if self._seed_ewma > self.seed_lo:
+                return "stream", new_cells
+            # Churn subsided: warm the cache back up this batch
+            # (known was _known while streaming; recompute vs slots,
+            # and release the estimator — cached mode never reads
+            # it, and a later burst rebuilds it from _slots).
+            self._streaming = False
+            self._known = set()
+            return "cached", [c for c in cells if c not in self._slots]
+        if self._seed_ewma > self.seed_hi:
+            # Seeding dominates: drop the cache (it stops being
+            # maintained, so it must not survive) and stream until
+            # the EWMA decays under seed_lo.
+            self._streaming = True
+            self._known = set(self._slots)
+            self._known.update(cells)
+            self.reset()  # arms no EWMA skip: _streaming is set
+            return "stream", new_cells
+        return "cached", new_cells
+
     @with_x64
     def plan_batch(self, messages: Sequence[CrdtMessage], existing_winners=None):
         """Planner with the `plan_batch_device_full` contract
@@ -276,56 +335,8 @@ class DeviceWinnerCache:
             if not bool(case_ok.all()):
                 return self._host_fallback(messages, cells)
 
-            if not self.adaptive and self._streaming:
-                # The gate was disabled while streaming (tests / ops
-                # pinning the static path): leave streaming mode so the
-                # cached path below reseeds from SQLite — keeping
-                # `known = _known` here would skip seeding cells whose
-                # slots were dropped at the streaming switch (KeyError).
-                self._streaming = False
-                self._known = set()
-            known = self._known if self._streaming else self._slots
-            new_cells = [c for c in cells if c not in known]
-            rate = len(new_cells) / len(cells)
-            if self._skip_ewma_once:
-                self._skip_ewma_once = False
-                self._ewma_suppressed = True
-            else:
-                self._seed_ewma = (
-                    (1 - self._EWMA_NEW_WEIGHT) * self._seed_ewma
-                    + self._EWMA_NEW_WEIGHT * rate
-                )
-                self._ewma_suppressed = False
-            if not self.adaptive:
-                pass
-            elif self._streaming:
-                # Bound the membership estimator: sustained churn (the
-                # very workload streaming targets) would otherwise grow
-                # it forever. On overflow, restart it from this batch —
-                # the one-batch rate spike only reinforces streaming.
-                if len(self._known) > self._KNOWN_CAP:
-                    self._known = set(cells)
-                else:
-                    self._known.update(cells)
-                if self._seed_ewma > self.seed_lo:
-                    return self._plan_streamed(
-                        messages, cells, cell_ids, millis, counter, node
-                    )
-                # Churn subsided: warm the cache back up this batch
-                # (known was _known while streaming; recompute vs slots,
-                # and release the estimator — cached mode never reads
-                # it, and a later burst rebuilds it from _slots).
-                self._streaming = False
-                self._known = set()
-                new_cells = [c for c in cells if c not in self._slots]
-            elif self._seed_ewma > self.seed_hi:
-                # Seeding dominates: drop the cache (it stops being
-                # maintained, so it must not survive) and stream until
-                # the EWMA decays under seed_lo.
-                self._streaming = True
-                self._known = set(self._slots)
-                self._known.update(cells)
-                self.reset()  # arms no EWMA skip: _streaming is set
+            mode, new_cells = self._adaptive_gate(cells)
+            if mode == "stream":
                 return self._plan_streamed(
                     messages, cells, cell_ids, millis, counter, node
                 )
@@ -336,30 +347,94 @@ class DeviceWinnerCache:
                 (self._slots[c] for c in cells), np.int32, len(cells)
             )
             slots = slot_of[cell_ids]
-            k1 = pack_ts_key_host(millis, counter)
-            size = bucket_size(n)
-            pad = size - n
-            cell_p = np.concatenate([cell_ids, np.full(pad, int(_PAD_CELL), np.int32)])
-            slots_p = np.concatenate([slots, np.zeros(pad, np.int32)])
-            k1_p = np.concatenate([k1, np.zeros(pad, np.uint64)])
-            k2_p = np.concatenate([node, np.zeros(pad, np.uint64)])
-
-            self._w1, self._w2, *outs = _cached_plan_kernel(
-                self._w1, self._w2, jnp.asarray(slots_p),
-                jnp.asarray(cell_p), jnp.asarray(k1_p), jnp.asarray(k2_p),
+            xor_mask, upsert_mask, deltas = self._run_cached_plan(
+                cell_ids, slots, millis, counter, node, n
             )
-            xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = (
-                to_host_many(*outs)
-            )
-            xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s)
-            xor_mask, upsert_mask = xor_mask[:n], upsert_mask[:n]
-            deltas = decode_owner_minute_deltas(
-                np.zeros(size, np.int32), minute_sorted, seg_end, seg_xor, valid
-            ).get(0, {})
             return PlannedBatch(
                 xor_mask.tolist(), select_messages(messages, upsert_mask),
                 deltas, upsert_mask,
             )
+
+    def _run_cached_plan(self, cell_ids, slots, millis, counter, node, n):
+        """ONE copy of the cached kernel-call sequence (pad → gather/
+        plan/scatter dispatch → pull → unpermute → delta decode) shared
+        by `plan_batch` and `plan_packed` — the two flows must produce
+        identical plans, so the sequence lives here. →
+        (xor_mask, upsert_mask, deltas), masks in batch order, length n."""
+        k1 = pack_ts_key_host(millis, counter)
+        size = bucket_size(n)
+        pad = size - n
+        cell_p = np.concatenate([cell_ids, np.full(pad, int(_PAD_CELL), np.int32)])
+        slots_p = np.concatenate([slots, np.zeros(pad, np.int32)])
+        k1_p = np.concatenate([k1, np.zeros(pad, np.uint64)])
+        k2_p = np.concatenate([node, np.zeros(pad, np.uint64)])
+
+        self._w1, self._w2, *outs = _cached_plan_kernel(
+            self._w1, self._w2, jnp.asarray(slots_p),
+            jnp.asarray(cell_p), jnp.asarray(k1_p), jnp.asarray(k2_p),
+        )
+        xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = (
+            to_host_many(*outs)
+        )
+        xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s)
+        deltas = decode_owner_minute_deltas(
+            np.zeros(size, np.int32), minute_sorted, seg_end, seg_xor, valid
+        ).get(0, {})
+        return xor_mask[:n], upsert_mask[:n], deltas
+
+    @with_x64
+    def plan_packed(self, pb):
+        """Packed twin of `plan_batch` for PackedReceive batches (the
+        fused receive leg): columns come straight from the C decrypt —
+        timestamps parsed once over the 46-wide slab, cells already
+        interned — and the result is positional numpy masks
+        `(xor_mask, upsert_mask, deltas)` for the packed SQLite apply,
+        so no upsert message list is ever built.
+
+        Returns None when the batch must take the object path instead:
+        non-canonical hex case in the batch (checked BEFORE any EWMA /
+        cache mutation, so the re-route through `plan_batch` keeps
+        adaptive-gate parity with a pure-object flow) or a
+        non-canonical stored winner seed (the re-route's own
+        `_host_fallback` owns invalidation; its only side effect here
+        is one extra EWMA sample on this adversarial shape)."""
+        n = pb.n
+        if n == 0:
+            return np.zeros(0, bool), np.zeros(0, bool), {}
+        self._drop_if_foreign_write()
+        with span("kernel:merge", "winner_cache.plan_packed", n=n):
+            millis, counter, node, case_ok = pb.parse_timestamps()
+            if not bool(case_ok.all()):
+                return None
+            # A slice shares the full batch's interned cell list; only
+            # the ids this chunk touches get slots/seeds.
+            touched_ids = np.unique(pb.cell_id)
+            cells = [pb.cells[int(i)] for i in touched_ids]
+
+            mode, new_cells = self._adaptive_gate(cells)
+            if mode == "stream":
+                return self._plan_packed_streamed(
+                    pb, cells, touched_ids, millis, counter, node
+                )
+            if new_cells and not self._seed_new_cells(new_cells):
+                return None  # non-canonical stored winner → object path
+
+            slot_arr = np.zeros(len(pb.cells), np.int32)
+            for i in touched_ids:
+                slot_arr[int(i)] = self._slots[pb.cells[int(i)]]
+            slots = slot_arr[pb.cell_id]
+            return self._run_cached_plan(
+                pb.cell_id, slots, millis, counter, node, n
+            )
+
+    def _plan_packed_streamed(self, pb, cells, touched_ids, millis, counter, node):
+        """Streaming-mode packed plan: winners from SQLite, no cache
+        state. None on a non-canonical stored winner (object path)."""
+        from evolu_tpu.ops.merge import plan_packed_streamed
+
+        return plan_packed_streamed(
+            self._db, pb, millis, counter, node, cells, touched_ids
+        )
 
     def _plan_streamed(self, messages, cells, cell_ids, millis, counter, node):
         """High-churn mode: winners streamed from SQLite per batch, no

@@ -284,8 +284,15 @@ class Evolu:
     def receive(
         self, messages: tuple, merkle_tree: str, previous_diff: Optional[int] = None
     ) -> None:
-        """Feed a sync response into the engine (db.worker.ts:129-135)."""
-        self.worker.post(msg.Receive(tuple(messages), merkle_tree, previous_diff))
+        """Feed a sync response into the engine (db.worker.ts:129-135).
+        `messages` is either a CrdtMessage sequence or a PackedReceive
+        columnar batch (the fused receive leg) — the worker handles
+        both with identical end state."""
+        from evolu_tpu.core.packed import PackedReceive
+
+        if not isinstance(messages, PackedReceive):
+            messages = tuple(messages)
+        self.worker.post(msg.Receive(messages, merkle_tree, previous_diff))
 
     def _post_sync(self, request: msg.SyncRequestInput) -> None:
         if self._transport is not None:

@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from evolu_tpu.core.merkle import diff_merkle_trees, merkle_tree_from_string, merkle_tree_to_string
 from evolu_tpu.core.timestamp import (
     receive_timestamps_batch,
+    receive_timestamps_batch_packed,
     create_sync_timestamp,
     receive_timestamp,
     send_timestamp,
@@ -112,11 +113,45 @@ def select_planner(config: Config, db: Optional[PySqliteDatabase] = None) -> Cal
             return plan_batch_device_full(batch, existing, cols=cols)
         return plan_batch(batch, existing)
 
+    def plan_packed(pb):
+        """Packed-batch twin of the closure above for PackedReceive
+        (the fused receive leg). None = materialize and route the
+        object path (which owns invalidation for those shapes)."""
+        n = len(pb)
+        if n < threshold or (
+            hot_min is not None and n >= hot_min and _multi_device()
+        ):
+            # Small batches take the host oracle; hot-owner batches
+            # keep their multi-device shard route — both via objects.
+            return None
+        if cache is not None:
+            return cache.plan_packed(pb)
+        if db is None:
+            return None
+        return _plan_packed_streamed_nocache(db, pb)
+
+    planner.plan_packed = plan_packed
     if cache is not None:
         planner.fetches_winners = False
         planner.on_transaction_failed = cache.on_transaction_failed
         planner.cache = cache
     return planner
+
+
+def _plan_packed_streamed_nocache(db, pb):
+    """Packed plan with winners streamed from SQLite (winner_cache
+    off): the PackedReceive analog of `plan_batch_device_full`. None →
+    object path (non-canonical batch or stored winner)."""
+    import numpy as np
+
+    from evolu_tpu.ops.merge import plan_packed_streamed
+
+    millis, counter, node, case_ok = pb.parse_timestamps()
+    if not bool(case_ok.all()):
+        return None
+    touched_ids = np.unique(pb.cell_id)
+    cells = [pb.cells[int(i)] for i in touched_ids]
+    return plan_packed_streamed(db, pb, millis, counter, node, cells, touched_ids)
 
 
 def _multi_device() -> bool:
@@ -374,33 +409,51 @@ class DbWorker:
     def _receive(self, command: msg.Receive) -> None:
         """receive.ts:144-199: merge remote messages, then anti-entropy."""
         clock = read_clock(self.db)
-        if command.messages:
+        if len(command.messages):
             # HLC merge folded over every remote timestamp
             # (receive.ts:45-66) — the reduced vectorized fold, with one
             # wall-clock sample per command like the reference's TimeEnv.
             # A parse failure re-runs the fold sequentially so the FIRST
             # failing message defines the surfaced error, exactly like
             # the reference's per-message traversal.
+            from evolu_tpu.core.packed import PackedReceive
             from evolu_tpu.core.types import TimestampParseError
             from evolu_tpu.ops.host_parse import parse_timestamp_strings
 
             now = self.now()
+            packed = isinstance(command.messages, PackedReceive)
             try:
-                r_millis, r_counter, _ = parse_timestamp_strings(
-                    [m.timestamp for m in command.messages]
-                )
-                t = receive_timestamps_batch(
-                    clock.timestamp, r_millis, r_counter,
-                    [m.timestamp[30:46] for m in command.messages],
-                    now=now, max_drift=self.config.max_drift,
-                )
-            except TimestampParseError:
-                t = clock.timestamp
-                for m in command.messages:
-                    t = receive_timestamp(
-                        t, timestamp_from_string(m.timestamp), now, self.config.max_drift
+                if packed:
+                    # Fused receive: the 46-wide slab parses in one
+                    # native call; node strings materialize only if a
+                    # screen forces the exact sequential fold.
+                    pb = command.messages
+                    r_millis, r_counter, r_node, _case = pb.parse_timestamps()
+                    t = receive_timestamps_batch_packed(
+                        clock.timestamp, r_millis, r_counter, r_node,
+                        lambda: [s[30:46] for s in pb.timestamp_strings()],
+                        now=now, max_drift=self.config.max_drift,
                     )
-            messages = list(command.messages)
+                else:
+                    r_millis, r_counter, _ = parse_timestamp_strings(
+                        [m.timestamp for m in command.messages]
+                    )
+                    t = receive_timestamps_batch(
+                        clock.timestamp, r_millis, r_counter,
+                        [m.timestamp[30:46] for m in command.messages],
+                        now=now, max_drift=self.config.max_drift,
+                    )
+            except TimestampParseError:
+                ts_strings = (
+                    command.messages.timestamp_strings() if packed
+                    else [m.timestamp for m in command.messages]
+                )
+                t = clock.timestamp
+                for s in ts_strings:
+                    t = receive_timestamp(
+                        t, timestamp_from_string(s), now, self.config.max_drift
+                    )
+            messages = command.messages if packed else list(command.messages)
             chunk = self.config.receive_chunk_size
             if chunk and len(messages) > chunk:
                 # Huge history (e.g. initial sync of a restored device):
@@ -489,6 +542,7 @@ class DbWorker:
             from evolu_tpu.storage.native import unpack_packed_rows
         for q in queries:
             sql, parameters = msg.deserialize_query(q)
+            raw = None
             if raw_capable:
                 raw = self.db.exec_sql_query_packed_raw(sql, parameters)
                 prev_raw = self._staged_raw.get(q, self.queries_raw_cache.get(q))
@@ -497,12 +551,17 @@ class DbWorker:
                     self._staged_raw[q] = raw
                     continue  # unchanged — no parse, no diff, no patch
                 rows = unpack_packed_rows(raw)
-                self._staged_raw[q] = raw
             else:
                 rows = self.db.exec_sql_query(sql, parameters)
             prev = self._staged_cache.get(q, self.queries_rows_cache.get(q, []))
             ops = create_patch(prev, rows)
+            # Stage rows BEFORE raw: an exception between unpack and here
+            # leaves both staged caches at their old values — staging raw
+            # first would let the OnError commit path pair NEW bytes with
+            # OLD rows, suppressing the patch forever (advisor r4).
             self._staged_cache[q] = rows
+            if raw is not None:
+                self._staged_raw[q] = raw
             if ops:
                 patches.append((q, ops))
         if patches or on_complete_ids:

@@ -36,6 +36,7 @@ from evolu_tpu.core.timestamp import (
     timestamp_to_hash,
     timestamp_to_string,
 )
+from evolu_tpu.core.types import NonCanonicalStoreError
 from evolu_tpu.storage.native import open_database
 from evolu_tpu.sync import protocol
 
@@ -141,8 +142,11 @@ class RelayStore:
             # lives in BOTH native/evolu_host.cpp::eh_get_messages and
             # the fallback below — change them together
             # (tests assert cross-backend equivalence).
-            rows = self.db.fetch_relay_messages(user_id, since, node_id)
-            return tuple(protocol.EncryptedCrdtMessage(t, c) for t, c in rows)
+            try:
+                rows = self.db.fetch_relay_messages(user_id, since, node_id)
+                return tuple(protocol.EncryptedCrdtMessage(t, c) for t, c in rows)
+            except NonCanonicalStoreError:
+                pass  # a malformed stored width degrades to the SQL path
         rows = self.db.exec_sql_query(
             'SELECT "timestamp", "content" FROM "message" '
             'WHERE "userId" = ? AND "timestamp" > ? AND "timestamp" NOT LIKE \'%\' || ? '
@@ -184,9 +188,17 @@ class RelayStore:
             stream = b""
         else:
             since = timestamp_to_string(create_sync_timestamp(diff))
-            stream, _n = self.db.fetch_relay_messages_wire(
-                request.user_id, since, request.node_id
-            )
+            try:
+                stream, _n = self.db.fetch_relay_messages_wire(
+                    request.user_id, since, request.node_id
+                )
+            except NonCanonicalStoreError:
+                # A single malformed stored timestamp must not wedge
+                # this owner's sync: serve via the object path, whose
+                # get_messages degrades to generic SQL (advisor r4).
+                # add_messages above was idempotent, so the caller's
+                # sync() re-run is safe.
+                return None
         # add_messages just dumped + stored this exact tree: read the
         # stored text back (one small SELECT) instead of a second
         # ~25KB JSON dump per request (review finding).

@@ -164,12 +164,12 @@ def apply_messages(
     `planner` defaults to the host `plan_batch`; the TPU runtime passes
     a device planner with the same contract.
     """
-    if not messages:
+    if not len(messages):
         return merkle_tree
     planner = planner or plan_batch
     try:
         with db.transaction():  # whole-batch atomicity, like the reference's dbTransaction
-            return _apply_messages_in_txn(db, merkle_tree, messages, planner)
+            return _apply_in_txn(db, merkle_tree, messages, planner)
     except BaseException:
         # A planner that mutates its own state at plan time (the HBM
         # winner cache) is now ahead of the rolled-back SQLite; let it
@@ -188,6 +188,28 @@ def _notify_plan_failure(planner) -> None:
         on_failed = getattr(owner, "on_transaction_failed", None)
     if on_failed is not None:
         on_failed()
+
+
+def _apply_in_txn(db, merkle_tree, messages, planner):
+    """Dispatch inside the transaction: a PackedReceive batch (the
+    fused receive leg) takes the columnar plan+apply when both the
+    planner and the backend support it; everything else — and every
+    packed batch the planner bounces (non-canonical case, host-oracle
+    shapes, small batches) — materializes to CrdtMessage objects and
+    runs the standard path, so behavior and error surfaces are
+    identical either way (test-pinned)."""
+    from evolu_tpu.core.packed import PackedReceive
+
+    if isinstance(messages, PackedReceive):
+        plan_packed = getattr(planner, "plan_packed", None)
+        if plan_packed is not None and hasattr(db, "apply_planned_cells"):
+            plan = plan_packed(messages)
+            if plan is not None:
+                _xor_mask, upsert_mask, deltas = plan
+                db.apply_planned_cells(messages, upsert_mask)
+                return apply_prefix_xors(merkle_tree, deltas)
+        messages = messages.to_messages()
+    return _apply_messages_in_txn(db, merkle_tree, messages, planner)
 
 
 def _apply_messages_in_txn(db, merkle_tree, messages, planner):

@@ -26,7 +26,7 @@ import numpy as np
 from contextlib import contextmanager
 from typing import Iterable, List, Optional, Sequence, Tuple
 
-from evolu_tpu.core.types import UnknownError
+from evolu_tpu.core.types import NonCanonicalStoreError, UnknownError
 from evolu_tpu.utils.native_loader import load_native_library
 
 _SQLITE_ROW = 100
@@ -77,6 +77,9 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.eh_apply_sequential.argtypes = [p, i64, sp, sp, sp, sp, i32p, i64p, dp, sp, i32p, u8p]
     lib.eh_apply_planned_packed.argtypes = [
         p, i64, s, i32p, s, i32p, s, i32p, s, i32p, i32p, i64p, dp, s, i32p, u8p,
+    ]
+    lib.eh_apply_planned_cells.argtypes = [
+        p, i64, s, i64, s, i32p, i32p, u8p, i64p, dp, s, i32p, u8p,
     ]
     lib.eh_relay_insert.argtypes = [p, i64, sp, sp, sp, i32p, u8p]
     lib.eh_relay_insert_packed.argtypes = [p, i64, sp, i64p, s, s, i32p, u8p]
@@ -578,6 +581,50 @@ class CppSqliteDatabase:
         if rc != 0:
             raise self._err()
 
+    def apply_planned_cells(self, pb, upsert_mask) -> None:
+        """`eh_apply_planned_cells`: apply a planner-computed upsert
+        mask + bulk __message insert for a PackedReceive batch in one C
+        call — the buffers flow from the C decrypt straight to the C
+        apply with zero per-row Python. Caller manages the
+        transaction. End state identical to `apply_planned` on the
+        materialized batch (test-pinned)."""
+        n = pb.n
+        if n == 0:
+            return
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        cell_id = np.ascontiguousarray(pb.cell_id, np.int32)
+        vkinds = np.ascontiguousarray(pb.vkinds, np.uint8)
+        ivals = np.ascontiguousarray(pb.ivals, np.int64)
+        dvals = np.ascontiguousarray(pb.dvals, np.float64)
+        vlens = np.ascontiguousarray(pb.vlens, np.int32)
+        cell_lens = np.ascontiguousarray(pb.cell_lens, np.int32)
+        # A slice's text payloads occupy a contiguous vblob span
+        # starting at its first row's offset (vlens is 0 for non-text).
+        base = int(pb.voffs[0])
+        vblob = pb.vblob[base : base + int(vlens.sum())]
+        mask_np = np.ascontiguousarray(np.asarray(upsert_mask, dtype=np.uint8))
+        if len(mask_np) != n:  # C reads n bytes; a short buffer would be OOB
+            raise ValueError(f"upsert_mask length {len(mask_np)} != rows {n}")
+        with self._lock:
+            self._check_open()
+            rc = self._lib.eh_apply_planned_cells(
+                self._db, n, pb.ts_slab, len(pb.cells), pb.cell_blob,
+                cell_lens.ctypes.data_as(i32p),
+                cell_id.ctypes.data_as(i32p),
+                vkinds.ctypes.data_as(u8p),
+                ivals.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                dvals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                vblob, vlens.ctypes.data_as(i32p),
+                mask_np.ctypes.data_as(u8p),
+            )
+        if rc == 3:
+            raise UnknownError("identifier contains NUL")
+        if rc == 2:
+            raise UnknownError("apply_planned_cells: cell index out of range")
+        if rc != 0:
+            raise self._err()
+
     def fetch_relay_messages(
         self, user_id: str, since: str, node_id: str
     ) -> List[Tuple[str, bytes]]:
@@ -601,7 +648,7 @@ class CppSqliteDatabase:
         if rc == 1:
             raise self._err()
         if rc == 2:
-            raise UnknownError("non-canonical timestamp width in relay store")
+            raise NonCanonicalStoreError("non-canonical timestamp width in relay store")
         if rc != 0:
             raise UnknownError("relay message fetch failed (out of memory?)")
         count = n.value
@@ -648,7 +695,7 @@ class CppSqliteDatabase:
         if rc == 1:
             raise self._err()
         if rc == 2:
-            raise UnknownError("non-canonical timestamp width in relay store")
+            raise NonCanonicalStoreError("non-canonical timestamp width in relay store")
         if rc != 0:
             raise UnknownError("relay message fetch failed (out of memory?)")
         try:

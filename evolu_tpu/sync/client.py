@@ -283,17 +283,27 @@ class SyncTransport:
         try:
             from evolu_tpu.sync import native_crypto
 
-            # Fused receive path: protobuf parse + decrypt in one C
-            # call; None → the pure decoder (identical error surface).
-            fused = native_crypto.decrypt_response(
+            # Fully-fused receive: protobuf parse + decrypt +
+            # columnarization in one C call → PackedReceive, feeding
+            # the worker's packed apply with zero per-row objects. Any
+            # non-canonical shape → the object-path fused decoder →
+            # the pure decoder (identical error surfaces down the
+            # chain).
+            packed = native_crypto.decrypt_response_columns(
                 response_bytes, request.owner.mnemonic
             )
-            if fused is not None:
-                messages, merkle_tree = fused
+            if packed is not None:
+                messages, merkle_tree = packed
             else:
-                response = protocol.decode_sync_response(response_bytes)
-                messages = decrypt_messages(response.messages, request.owner.mnemonic)
-                merkle_tree = response.merkle_tree
+                fused = native_crypto.decrypt_response(
+                    response_bytes, request.owner.mnemonic
+                )
+                if fused is not None:
+                    messages, merkle_tree = fused
+                else:
+                    response = protocol.decode_sync_response(response_bytes)
+                    messages = decrypt_messages(response.messages, request.owner.mnemonic)
+                    merkle_tree = response.merkle_tree
             log("sync:response", messages=len(messages), bytes=len(response_bytes))
             return (messages, merkle_tree, request.previous_diff)
         except Exception as e:  # noqa: BLE001

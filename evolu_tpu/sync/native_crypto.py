@@ -63,6 +63,11 @@ def _configure(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
         c.c_char_p, c.c_int64, c.c_char_p, c.c_int32,
         c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
     ]
+    lib.ehc_decrypt_response_columns.restype = c.c_int
+    lib.ehc_decrypt_response_columns.argtypes = [
+        c.c_char_p, c.c_int64, c.c_char_p, c.c_int32,
+        c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
+    ]
     lib.ehc_free.argtypes = [c.c_void_p]
     if not lib.ehc_available():
         return None
@@ -335,6 +340,40 @@ def decrypt_response(response_bytes: bytes, password: str):
         )
         out.append(CrdtMessage(timestamp, table, row, column, value))
     return tuple(out), tree
+
+
+def decrypt_response_columns(response_bytes: bytes, password: str):
+    """The fully-fused receive decode: SyncResponse protobuf walk +
+    decrypt + columnarization in ONE C call → (PackedReceive, tree) —
+    zero per-row Python objects, interned cells, a 46-wide timestamp
+    slab, bind-ready value columns. None whenever ANY row needs the
+    object path (demoted crypto, non-46 timestamp, invalid UTF-8,
+    non-canonical wire) — the caller then runs `decrypt_response` /
+    the pure decoder, which own the exact error surface. Success here
+    implies the object path would have produced the same batch
+    (pinned by tests), so behavior is identical either way."""
+    lib = load_library()
+    if lib is None:
+        return None
+    pw = password.encode("utf-8")
+    out_p = ctypes.c_void_p()
+    out_len = ctypes.c_int64()
+    rc = lib.ehc_decrypt_response_columns(
+        response_bytes, len(response_bytes), pw, len(pw),
+        ctypes.byref(out_p), ctypes.byref(out_len),
+    )
+    if rc != 0:
+        return None
+    try:
+        raw = ctypes.string_at(out_p.value, out_len.value)
+    finally:
+        lib.ehc_free(out_p)
+    from evolu_tpu.core.packed import PackedReceive
+
+    try:
+        return PackedReceive.from_blob(raw)
+    except UnicodeDecodeError:  # defense in depth: C validated UTF-8
+        return None
 
 
 def _pure_one(m, password: str) -> CrdtMessage:

@@ -28,6 +28,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 #include "wire.h"
@@ -732,6 +733,202 @@ int ehc_decrypt_response(const uint8_t *resp, int64_t resp_len,
   memcpy(blob, out.data(), out.size());
   *out_blob = blob;
   *out_len = int64_t(out.size());
+  return 0;
+}
+
+// Strict UTF-8 validation matching CPython's decoder: rejects bare
+// continuations, overlong encodings, surrogates (U+D800..U+DFFF), and
+// code points above U+10FFFF. The columnar receive path commits these
+// bytes to SQLite with explicit lengths; anything Python's .decode()
+// would reject must bounce the batch to the object path instead.
+static bool utf8_ok(const uint8_t *s, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    uint8_t b = s[i];
+    if (b < 0x80) { i++; continue; }
+    if (b < 0xC2) return false;  // continuation byte or overlong 2-byte
+    if (b < 0xE0) {
+      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return false;
+      i += 2;
+    } else if (b < 0xF0) {
+      if (i + 2 >= n) return false;
+      uint8_t b1 = s[i + 1], b2 = s[i + 2];
+      if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80) return false;
+      if (b == 0xE0 && b1 < 0xA0) return false;   // overlong
+      if (b == 0xED && b1 >= 0xA0) return false;  // surrogate
+      i += 3;
+    } else if (b < 0xF5) {
+      if (i + 3 >= n) return false;
+      uint8_t b1 = s[i + 1], b2 = s[i + 2], b3 = s[i + 3];
+      if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80 || (b3 & 0xC0) != 0x80)
+        return false;
+      if (b == 0xF0 && b1 < 0x90) return false;   // overlong
+      if (b == 0xF4 && b1 >= 0x90) return false;  // > U+10FFFF
+      i += 4;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// Columnar twin of ehc_decrypt_response for the fused receive→apply
+// path (reference sync.worker.ts:135-173 → receive.ts:144 →
+// applyMessages.ts:78 as ONE leg). Succeeds ONLY when every message
+// decrypts on the canonical fast path, every timestamp is exactly 46
+// ASCII bytes, and every string field (incl. the tree) is strict
+// UTF-8 — the Python side then feeds the batch straight into the
+// planner and the packed SQLite apply with ZERO per-row objects.
+// Cells (table,row,column) are interned in first-appearance order
+// (parity with host_parse.intern_cells) so only k unique triples ever
+// become Python strings.
+// Returns 0 ok; 2 non-canonical wire; 3 some row needs the object
+// path (the caller falls back to ehc_decrypt_response, whose per-row
+// oracle demotion owns the exact error surface); 1 internal.
+// Output blob layout (little-endian, naturally aligned):
+//   [i64 n][i64 k][i64 tree_len][i64 vblob_len][i64 cell_blob_len]
+//   ivals i64[n]; dvals f64[n];
+//   cell_id i32[n]; vlens i32[n]; cell_lens i32[3k];
+//   vkinds u8[n] (SQLite bind encoding: 0 null, 1 int, 2 double, 3 text)
+//   ts_slab u8[46*n]; vblob; cell_blob; tree
+int ehc_decrypt_response_columns(const uint8_t *resp, int64_t resp_len,
+                                 const uint8_t *password, int32_t pw_len,
+                                 uint8_t **out_blob, int64_t *out_len) {
+  Ctxs cx;
+  if (!cx.ok() || resp_len < 0 || pw_len < 0) return 1;
+  size_t n_ = size_t(resp_len);
+  const uint8_t *tree = nullptr;
+  size_t tree_len = 0;
+  std::vector<uint8_t> plain;
+  std::vector<Pkt> pkts, inner;
+
+  std::vector<int64_t> ivals;
+  std::vector<double> dvals;
+  std::vector<int32_t> cell_ids, vlens, cell_lens;
+  std::string vkinds, ts_slab, vblob, cell_blob;
+  std::unordered_map<std::string, int32_t> intern;
+  std::string keybuf;
+
+  size_t pos = 0;
+  while (pos < n_) {
+    uint64_t key;
+    if (!read_varint64(resp, n_, pos, key)) return 2;
+    uint64_t field = key >> 3;
+    int wt = int(key & 7);
+    if (wt != 2) return 2;  // canonical SyncResponse is all wt-2
+    uint64_t len;
+    if (!read_varint64(resp, n_, pos, len)) return 2;
+    if (len > n_ - pos) return 2;  // overflow-safe: pos <= n_
+    const uint8_t *body = resp + pos;
+    size_t blen = size_t(len);
+    pos += blen;
+    if (field == 2) {
+      tree = body;  // last wins, like the Python decoder
+      tree_len = blen;
+      continue;
+    }
+    if (field != 1) continue;  // unknown length-delimited field: skip
+
+    // EncryptedCrdtMessage { timestamp=1, content=2 } — last wins.
+    const uint8_t *ts = nullptr, *ct = nullptr;
+    size_t ts_len = 0, ct_len = 0;
+    size_t mp = 0;
+    while (mp < blen) {
+      uint64_t mkey;
+      if (!read_varint64(body, blen, mp, mkey)) return 2;
+      uint64_t mf = mkey >> 3;
+      int mwt = int(mkey & 7);
+      if (mwt != 2) return 2;
+      uint64_t mlen;
+      if (!read_varint64(body, blen, mp, mlen)) return 2;
+      if (mlen > blen - mp) return 2;  // overflow-safe: mp <= blen
+      if (mf == 1) { ts = body + mp; ts_len = size_t(mlen); }
+      else if (mf == 2) { ct = body + mp; ct_len = size_t(mlen); }
+      mp += size_t(mlen);
+    }
+    // The packed apply path assumes fixed-width canonical timestamps;
+    // ASCII also guarantees the (rare) later string materialization
+    // decodes losslessly.
+    if (ts_len != 46) return 3;
+    for (size_t j = 0; j < 46; j++)
+      if (ts[j] >= 0x80) return 3;
+    Content c;
+    if (!ct || !decrypt_one(cx, ct, ct_len, password, size_t(pw_len), plain,
+                            pkts, inner, c))
+      return 3;  // any demoted row → whole batch takes the object path
+
+    // Intern the cell; validate UTF-8 once per unique triple.
+    keybuf.clear();
+    uint32_t tl32 = uint32_t(c.tl), rl32 = uint32_t(c.rl);
+    keybuf.append(reinterpret_cast<const char *>(&tl32), 4);
+    keybuf.append(reinterpret_cast<const char *>(&rl32), 4);
+    if (c.tl) keybuf.append(reinterpret_cast<const char *>(c.t), c.tl);
+    if (c.rl) keybuf.append(reinterpret_cast<const char *>(c.r), c.rl);
+    if (c.cl) keybuf.append(reinterpret_cast<const char *>(c.c), c.cl);
+    auto it = intern.find(keybuf);
+    int32_t cid;
+    if (it != intern.end()) {
+      cid = it->second;
+    } else {
+      if (!utf8_ok(c.t, c.tl) || !utf8_ok(c.r, c.rl) || !utf8_ok(c.c, c.cl))
+        return 3;
+      cid = int32_t(intern.size());
+      intern.emplace(keybuf, cid);
+      cell_lens.push_back(int32_t(c.tl));
+      cell_lens.push_back(int32_t(c.rl));
+      cell_lens.push_back(int32_t(c.cl));
+      if (c.tl) cell_blob.append(reinterpret_cast<const char *>(c.t), c.tl);
+      if (c.rl) cell_blob.append(reinterpret_cast<const char *>(c.r), c.rl);
+      if (c.cl) cell_blob.append(reinterpret_cast<const char *>(c.c), c.cl);
+    }
+    cell_ids.push_back(cid);
+    ts_slab.append(reinterpret_cast<const char *>(ts), 46);
+    // Content vkind (0 none, 1 str, 2 int, 3 double) → the SQLite bind
+    // encoding shared with eh_apply_planned_packed (0 null, 1 int,
+    // 2 double, 3 text).
+    switch (c.vkind) {
+      case 1:
+        if (!utf8_ok(c.s, c.sl)) return 3;
+        vkinds.push_back(char(3));
+        vlens.push_back(int32_t(c.sl));
+        if (c.sl) vblob.append(reinterpret_cast<const char *>(c.s), c.sl);
+        break;
+      case 2: vkinds.push_back(char(1)); vlens.push_back(0); break;
+      case 3: vkinds.push_back(char(2)); vlens.push_back(0); break;
+      default: vkinds.push_back(char(0)); vlens.push_back(0); break;
+    }
+    ivals.push_back(c.ival);
+    dvals.push_back(c.dval);
+  }
+  if (tree_len && !utf8_ok(tree, tree_len)) return 3;
+
+  int64_t n = int64_t(cell_ids.size());
+  int64_t k = int64_t(intern.size());
+  int64_t header[5] = {n, k, int64_t(tree_len), int64_t(vblob.size()),
+                       int64_t(cell_blob.size())};
+  size_t total = sizeof(header) + size_t(n) * (8 + 8 + 4 + 4 + 1) +
+                 size_t(k) * 12 + ts_slab.size() + vblob.size() +
+                 cell_blob.size() + tree_len;
+  uint8_t *blob = static_cast<uint8_t *>(malloc(total ? total : 1));
+  if (!blob) return 1;
+  uint8_t *p = blob;
+  auto put = [&p](const void *src, size_t len) {
+    if (len) memcpy(p, src, len);
+    p += len;
+  };
+  put(header, sizeof(header));
+  put(ivals.data(), size_t(n) * 8);
+  put(dvals.data(), size_t(n) * 8);
+  put(cell_ids.data(), size_t(n) * 4);
+  put(vlens.data(), size_t(n) * 4);
+  put(cell_lens.data(), size_t(k) * 12);
+  put(vkinds.data(), vkinds.size());
+  put(ts_slab.data(), ts_slab.size());
+  put(vblob.data(), vblob.size());
+  put(cell_blob.data(), cell_blob.size());
+  put(tree, tree_len);
+  *out_blob = blob;
+  *out_len = int64_t(total);
   return 0;
 }
 

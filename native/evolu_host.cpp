@@ -410,6 +410,81 @@ int eh_apply_planned_packed(sqlite3 *db, int64_t n,
 }
 
 
+// --- hot path 3b: apply a device-computed plan from INTERNED columns ---
+//
+// The fused receive leg (ehc_decrypt_response_columns) emits the batch
+// as a fixed-width 46-byte timestamp slab plus k unique
+// (table,row,column) cells and per-row cell indices; this applies the
+// plan straight from those buffers — no per-row string expansion on
+// the Python side at all. Semantics are identical to
+// eh_apply_planned_packed (upserts for masked rows, bulk __message
+// insert for all rows, explicit byte lengths everywhere so embedded
+// NULs round-trip). kinds use the bind encoding (0 null, 1 int,
+// 2 double, 3 text). Returns 0 ok, 1 SQLite error, 2 bad cell index,
+// 3 NUL inside an upserted identifier.
+int eh_apply_planned_cells(sqlite3 *db, int64_t n, const char *ts_slab,
+                           int64_t k, const char *cell_blob,
+                           const int32_t *cell_lens, const int32_t *cell_ids,
+                           const uint8_t *kinds, const int64_t *ivals,
+                           const double *dvals, const char *val_blob,
+                           const int32_t *val_lens,
+                           const uint8_t *upsert_mask) {
+  StmtCache cache(db);
+  sqlite3_stmt *ins = cache.get(kInsertMessage);
+  if (!ins) return 1;
+  // Per-cell field offsets into cell_blob (k is small: unique cells).
+  std::vector<int64_t> coff(size_t(k) * 3 + 1);
+  int64_t o = 0;
+  for (int64_t j = 0; j < k * 3; ++j) {
+    coff[size_t(j)] = o;
+    o += cell_lens[j];
+  }
+  coff[size_t(k) * 3] = o;
+  // Upsert statements resolved once per cell, not per row (lazy: most
+  // cells in a steady-state batch never win).
+  std::vector<sqlite3_stmt *> up_stmt(size_t(k), nullptr);
+  std::vector<int8_t> up_state(size_t(k), 0);  // 0 unresolved, 1 ok, 3 NUL
+
+  int64_t val_o = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    int32_t cid = cell_ids[i];
+    if (cid < 0 || int64_t(cid) >= k) return 2;
+    const char *tbl = cell_blob + coff[size_t(cid) * 3];
+    const char *row = cell_blob + coff[size_t(cid) * 3 + 1];
+    const char *col = cell_blob + coff[size_t(cid) * 3 + 2];
+    const int tbll = cell_lens[cid * 3], rowl = cell_lens[cid * 3 + 1],
+              coll = cell_lens[cid * 3 + 2];
+    const char *val = val_blob + val_o;
+    const int vall = val_lens[i];
+    if (kinds[i] == 3) val_o += vall;
+    if (upsert_mask[i]) {
+      if (up_state[size_t(cid)] == 0) {
+        if (memchr(tbl, 0, tbll) || memchr(col, 0, coll)) {
+          up_state[size_t(cid)] = 3;
+        } else {
+          std::string tname(tbl, tbll), cname(col, coll);
+          up_stmt[size_t(cid)] = cache.get(upsert_sql(tname.c_str(), cname.c_str()));
+          up_state[size_t(cid)] = up_stmt[size_t(cid)] ? 1 : 2;
+        }
+      }
+      if (up_state[size_t(cid)] == 3) return 3;
+      if (up_state[size_t(cid)] != 1) return 1;
+      sqlite3_stmt *up = up_stmt[size_t(cid)];
+      sqlite3_bind_text(up, 1, row, rowl, SQLITE_STATIC);
+      bind_value_static(up, 2, kinds[i], ivals[i], dvals[i], val, vall);
+      bind_value_static(up, 3, kinds[i], ivals[i], dvals[i], val, vall);
+      if (step_done(up) != SQLITE_OK) return 1;
+    }
+    sqlite3_bind_text(ins, 1, ts_slab + i * 46, 46, SQLITE_STATIC);
+    sqlite3_bind_text(ins, 2, tbl, tbll, SQLITE_STATIC);
+    sqlite3_bind_text(ins, 3, row, rowl, SQLITE_STATIC);
+    sqlite3_bind_text(ins, 4, col, coll, SQLITE_STATIC);
+    bind_value_static(ins, 5, kinds[i], ivals[i], dvals[i], val, vall);
+    if (step_done(ins) != SQLITE_OK) return 1;
+  }
+  return 0;
+}
+
 // --- relay hot path: bulk (timestamp, userId, content) insert with
 // per-row "was new" flags (INSERT OR IGNORE changes()==1 semantics,
 // apps/server/src/index.ts:148-159). content is a blob. ---

@@ -1,0 +1,365 @@
+"""The fused receive leg (r5): `ehc_decrypt_response_columns` →
+PackedReceive → packed plan (`plan_packed`) → `eh_apply_planned_cells`.
+
+Reference path being replaced, as ONE leg:
+packages/evolu/src/sync.worker.ts:135-173 → receive.ts:144 →
+applyMessages.ts:78. The invariant throughout: the packed path either
+produces EXACTLY the object path's outcome (state, clock, errors) or
+bounces to the object path before any side effect.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.storage.apply import apply_messages
+from evolu_tpu.storage.native import native_available, open_database
+from evolu_tpu.storage.schema import init_db_model
+from evolu_tpu.sync import native_crypto, protocol
+from evolu_tpu.sync.client import encrypt_messages
+from evolu_tpu.utils.config import Config
+
+MN = "legal winner thank year wave sausage worth useful legal winner thank yellow"
+
+pytestmark = pytest.mark.skipif(
+    not native_crypto.native_available(), reason="native crypto unavailable"
+)
+
+
+def _mk_msgs(n=400, seed=11, nodes=("a1b2c3d4e5f60718", "ffeeddccbbaa9988")):
+    rng = random.Random(seed)
+    vals = [
+        lambda i: f"título {i} ✓",
+        lambda i: i % 2,
+        lambda i: None,
+        lambda i: i * 0.25,
+        lambda i: "x\x00y",  # NUL-bearing value must round-trip
+        lambda i: -(2**63) if i % 2 else 2**63 - 1,
+        lambda i: "",
+    ]
+    out = []
+    for i in range(n):
+        out.append(
+            CrdtMessage(
+                timestamp_to_string(
+                    Timestamp(
+                        1_700_000_000_000 + (i // 3) * 977, i % 3, rng.choice(nodes)
+                    )
+                ),
+                rng.choice(["todo", "todoCategory"]),
+                f"row{rng.randrange(n // 5 or 1)}",
+                rng.choice(["title", "isCompleted"]),
+                vals[i % len(vals)](i),
+            )
+        )
+    rng.shuffle(out)
+    return out
+
+
+def _response_bytes(msgs, tree='{"m":1}'):
+    enc = encrypt_messages(msgs, MN)
+    return protocol.encode_sync_response(protocol.SyncResponse(tuple(enc), tree))
+
+
+def test_columns_materialization_matches_object_path():
+    """decrypt_response_columns must reproduce the object path exactly:
+    same messages (incl. NUL/unicode/int64-extreme values), same tree,
+    and interning must preserve first-appearance semantics."""
+    msgs = _mk_msgs(120)
+    resp = _response_bytes(msgs)
+    out = native_crypto.decrypt_response_columns(resp, MN)
+    assert out is not None
+    pb, tree = out
+    obj = native_crypto.decrypt_response(resp, MN)
+    assert pb.to_messages() == obj[0] == tuple(msgs)
+    assert tree == obj[1] == '{"m":1}'
+    # Cell interning matches the host interner (first appearance).
+    from evolu_tpu.ops.host_parse import intern_cells
+
+    cid, cells = intern_cells(
+        [m.table for m in msgs], [m.row for m in msgs], [m.column for m in msgs]
+    )
+    assert cells == pb.cells
+    assert np.array_equal(cid, pb.cell_id)
+    # Slices materialize their exact row range.
+    assert pb[10:37].to_messages() == tuple(msgs[10:37])
+
+
+def test_columns_fallbacks_to_object_path():
+    """Every non-canonical shape returns None BEFORE any output: a
+    demoted ciphertext (gpg-compressed), wrong password, truncated
+    wire, a non-46-byte timestamp, and invalid UTF-8 inside decrypted
+    content. The object/pure chain then owns the exact error."""
+    from pathlib import Path
+
+    msgs = _mk_msgs(8)
+    enc = list(native_crypto.encrypt_batch(msgs, MN))
+    fixtures = Path(__file__).parent / "fixtures"
+    gpg_ct = (fixtures / "gpg_aes256_s2k1024_zip.pgp").read_bytes()
+    ts46 = msgs[0].timestamp
+    spliced = list(enc)
+    spliced.insert(3, protocol.EncryptedCrdtMessage(ts46, gpg_ct))
+    resp = protocol.encode_sync_response(protocol.SyncResponse(tuple(spliced), "{}"))
+    assert native_crypto.decrypt_response_columns(resp, MN) is None
+    # ...but the object path still serves it (oracle demotion).
+    assert native_crypto.decrypt_response(resp, MN) is not None
+
+    ok = protocol.encode_sync_response(protocol.SyncResponse(tuple(enc), "{}"))
+    assert native_crypto.decrypt_response_columns(ok, "wrong-pw") is None
+    assert native_crypto.decrypt_response_columns(ok[:-1], MN) is None
+
+    short_ts = list(enc)
+    short_ts[2] = protocol.EncryptedCrdtMessage("short-ts", short_ts[2].content)
+    resp = protocol.encode_sync_response(protocol.SyncResponse(tuple(short_ts), "{}"))
+    assert native_crypto.decrypt_response_columns(resp, MN) is None
+    assert native_crypto.decrypt_response(resp, MN) is not None
+
+    # Invalid UTF-8 inside a decrypted string field: the pure path
+    # raises (ValueError family); columns must bounce, not emit bytes
+    # Python would reject.
+    from evolu_tpu.sync.crypto import encrypt_symmetric
+
+    bad_content = b"\x0a\x02t\xff" + b"\x12\x01r" + b"\x1a\x01c"
+    bad = protocol.EncryptedCrdtMessage(ts46, encrypt_symmetric(bad_content, MN))
+    resp = protocol.encode_sync_response(protocol.SyncResponse((bad,), "{}"))
+    assert native_crypto.decrypt_response_columns(resp, MN) is None
+    with pytest.raises(ValueError):
+        msgs_out = native_crypto.decrypt_response(resp, MN)
+        if msgs_out is None:  # pure-path ownership
+            from evolu_tpu.sync.client import decrypt_messages
+
+            decrypt_messages(
+                protocol.decode_sync_response(resp).messages, MN
+            )
+
+
+@pytest.mark.skipif(not native_available(), reason="native host unavailable")
+def test_packed_apply_state_equals_object_apply():
+    """The full fused leg vs the object leg, same response bytes, two
+    fresh databases: identical __message rows, app-table rows, and
+    Merkle tree — including a second wave on top of stored winners and
+    chunked slices."""
+    from evolu_tpu.runtime.worker import select_planner
+
+    msgs = _mk_msgs(2000, seed=3)
+    resp = _response_bytes(msgs)
+    pb, _tree = native_crypto.decrypt_response_columns(resp, MN)
+
+    def mkdb():
+        db = open_database(backend="auto")
+        init_db_model(db, mnemonic=None)
+        for t in ("todo", "todoCategory"):
+            db.exec(
+                f'CREATE TABLE "{t}" ("id" TEXT PRIMARY KEY, "title" BLOB, '
+                '"isCompleted" BLOB)'
+            )
+        return db
+
+    def dump(db):
+        return (
+            db.exec_sql_query(
+                'SELECT * FROM "__message" ORDER BY "timestamp","table","row","column"',
+                (),
+            ),
+            db.exec_sql_query('SELECT * FROM "todo" ORDER BY "id"', ()),
+            db.exec_sql_query('SELECT * FROM "todoCategory" ORDER BY "id"', ()),
+        )
+
+    results = {}
+    for mode in ("objects", "packed"):
+        db = mkdb()
+        planner = select_planner(Config(min_device_batch=64), db)
+        half = len(msgs) // 2
+        b1 = tuple(msgs[:half]) if mode == "objects" else pb[:half]
+        b2 = tuple(msgs[half:]) if mode == "objects" else pb[half:]
+        t1 = apply_messages(db, {}, b1, planner=planner)
+        t2 = apply_messages(db, t1, b2, planner=planner)
+        results[mode] = (dump(db), t2)
+        db.close()
+    assert results["objects"] == results["packed"]
+
+
+@pytest.mark.skipif(not native_available(), reason="native host unavailable")
+def test_packed_noncanonical_case_routes_to_host_oracle():
+    """Uppercase node hex is non-canonical: the packed planner must
+    bounce (None) and the materialized object path's host oracle must
+    produce the reference's raw-string-order state — equal to the
+    pure-Python backend applying the same messages."""
+    from evolu_tpu.runtime.worker import select_planner
+
+    msgs = _mk_msgs(1500, seed=9, nodes=("A1B2C3D4E5F60718", "ffeeddccbbaa9988"))
+    resp = _response_bytes(msgs)
+    pb, _tree = native_crypto.decrypt_response_columns(resp, MN)
+    assert pb is not None  # ASCII case parses; canonicality is a PLAN concern
+    _m, _c, _n, case_ok = pb.parse_timestamps()
+    assert not bool(case_ok.all())
+
+    def mk(backend):
+        db = open_database(backend=backend)
+        init_db_model(db, mnemonic=None)
+        for t in ("todo", "todoCategory"):
+            db.exec(
+                f'CREATE TABLE "{t}" ("id" TEXT PRIMARY KEY, "title" BLOB, '
+                '"isCompleted" BLOB)'
+            )
+        return db
+
+    db_packed = mk("auto")
+    planner = select_planner(Config(min_device_batch=64), db_packed)
+    assert planner.plan_packed(pb) is None
+    tree_packed = apply_messages(db_packed, {}, pb, planner=planner)
+
+    db_pure = mk("python")
+    tree_pure = apply_messages(db_pure, {}, tuple(msgs))
+    q = 'SELECT * FROM "__message" ORDER BY "timestamp","table","row","column"'
+    assert db_packed.exec_sql_query(q, ()) == db_pure.exec_sql_query(q, ())
+    assert tree_packed == tree_pure
+    db_packed.close(), db_pure.close()
+
+
+def test_fuzz_columns_never_diverges_from_oracle():
+    """Mutation fuzz over response bytes: whenever the columns walker
+    accepts the wire, its materialization must equal the pure
+    decode+decrypt value exactly. (Columns never accepts an erroring
+    wire — any demotion is a None — so an accepted wire implies the
+    oracle succeeds too.)"""
+    from evolu_tpu.sync.client import decrypt_messages
+    from evolu_tpu.sync.crypto import PgpError
+
+    rng = random.Random(29)
+    base = _response_bytes(_mk_msgs(6), tree='{"x":1}')
+    accepted = 0
+    for trial in range(200):
+        b = bytearray(base)
+        for _ in range(rng.randint(1, 5)):
+            op = rng.random()
+            if op < 0.6 and b:
+                b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+            elif op < 0.8 and len(b) > 2:
+                del b[rng.randrange(len(b))]
+            else:
+                b.insert(rng.randrange(len(b) + 1), rng.randrange(256))
+        data = bytes(b)
+        out = native_crypto.decrypt_response_columns(data, MN)
+        if out is None:
+            continue  # production falls through to the object/pure chain
+        accepted += 1
+        pb, tree = out
+        try:
+            resp = protocol.decode_sync_response(data)
+            oracle = (decrypt_messages(resp.messages, MN), resp.merkle_tree)
+        except (PgpError, ValueError) as e:  # pragma: no cover - divergence
+            raise AssertionError(
+                f"columns accepted a wire the oracle rejects ({e!r}), trial {trial}"
+            )
+        assert (pb.to_messages(), tree) == oracle, f"trial {trial}"
+    assert accepted  # the fuzz must exercise the accept path at least once
+
+
+@pytest.mark.skipif(not native_available(), reason="native host unavailable")
+def test_packed_streaming_and_nocache_routes_match_oracle():
+    """The two packed plan routes that do NOT use HBM-cached winners —
+    the adaptive gate's STREAMING mode and a `winner_cache=False`
+    deployment — are production-routed and must equal the pure-Python
+    oracle's state exactly (they share `plan_packed_streamed`, but each
+    entry point is exercised here on purpose)."""
+    from evolu_tpu.runtime.worker import select_planner
+
+    msgs = _mk_msgs(1500, seed=31)
+    resp = _response_bytes(msgs)
+    pb, _tree = native_crypto.decrypt_response_columns(resp, MN)
+    q = 'SELECT * FROM "__message" ORDER BY "timestamp","table","row","column"'
+
+    def mk(backend):
+        db = open_database(backend=backend)
+        init_db_model(db, mnemonic=None)
+        for t in ("todo", "todoCategory"):
+            db.exec(
+                f'CREATE TABLE "{t}" ("id" TEXT PRIMARY KEY, "title" BLOB, '
+                '"isCompleted" BLOB)'
+            )
+        return db
+
+    db_oracle = mk("python")
+    tree_oracle = apply_messages(db_oracle, {}, tuple(msgs))
+    want = db_oracle.exec_sql_query(q, ())
+
+    # (a) winner_cache off → worker._plan_packed_streamed_nocache.
+    db_a = mk("auto")
+    planner_a = select_planner(
+        Config(min_device_batch=64, winner_cache=False), db_a
+    )
+    assert getattr(planner_a, "cache", None) is None
+    tree_a = apply_messages(db_a, {}, pb, planner=planner_a)
+    assert db_a.exec_sql_query(q, ()) == want and tree_a == tree_oracle
+
+    # (b) adaptive streaming mode → DeviceWinnerCache._plan_packed_streamed.
+    db_b = mk("auto")
+    planner_b = select_planner(Config(min_device_batch=64), db_b)
+    cache = planner_b.cache
+    cache._streaming = True
+    cache._known = set()
+    cache._seed_ewma = 1.0  # above seed_lo: the gate stays streaming
+    tree_b = apply_messages(db_b, {}, pb, planner=planner_b)
+    assert cache._streaming, "the gate left streaming mode unexpectedly"
+    assert db_b.exec_sql_query(q, ()) == want and tree_b == tree_oracle
+    db_oracle.close(), db_a.close(), db_b.close()
+
+
+@pytest.mark.skipif(not native_available(), reason="native host unavailable")
+def test_worker_receive_packed_equals_objects():
+    """DbWorker._receive fed the SAME response as PackedReceive vs
+    CrdtMessage tuple: identical database state, clock, and outputs —
+    and identical HLC error surfaces (duplicate node)."""
+    from evolu_tpu.runtime import messages as rmsg
+    from evolu_tpu.runtime.worker import DbWorker
+
+    msgs = _mk_msgs(1600, seed=21)
+    resp = _response_bytes(msgs, tree="{}")
+    pb, tree = native_crypto.decrypt_response_columns(resp, MN)
+
+    def run(batch):
+        db = open_database(backend="auto")
+        outputs = []
+        worker = DbWorker(
+            db,
+            Config(min_device_batch=64),
+            on_output=outputs.append,
+            now=lambda: 1_700_001_000_000,  # past every message: no drift error
+        )
+        worker.start(mnemonic=MN)
+        for t in ("todo", "todoCategory"):
+            db.exec(
+                f'CREATE TABLE IF NOT EXISTS "{t}" ("id" TEXT PRIMARY KEY, '
+                '"title" BLOB, "isCompleted" BLOB)'
+            )
+        worker.post(rmsg.Receive(batch, tree, None))
+        worker.flush()
+        state = (
+            db.exec_sql_query(
+                'SELECT * FROM "__message" ORDER BY "timestamp","table","row","column"',
+                (),
+            ),
+            db.exec_sql_query('SELECT * FROM "todo" ORDER BY "id"', ()),
+            # Clock WITHOUT the node suffix: the node id is random per
+            # device, so only millis/counter and the tree must match.
+            [
+                (r["timestamp"][:29], r["merkleTree"])
+                for r in db.exec_sql_query(
+                    'SELECT "timestamp", "merkleTree" FROM "__clock"', ()
+                )
+            ],
+        )
+        kinds = [type(o).__name__ for o in outputs]
+        worker.stop()
+        db.close()
+        return state, kinds
+
+    s_obj, k_obj = run(tuple(msgs))
+    s_pk, k_pk = run(pb)
+    assert s_obj == s_pk
+    assert s_obj[0], "no rows applied — the receive leg never ran"
+    assert k_obj == k_pk

@@ -556,6 +556,50 @@ def test_sync_wire_byte_identical_to_object_path():
         a.close(), b.close()
 
 
+def test_malformed_stored_timestamp_degrades_not_wedges():
+    """A stored relay timestamp that is not the canonical 46-byte width
+    breaks the packed C fetch paths (rc 2). That must DEGRADE the
+    owner's sync to the generic SQL path — same rows as the pure-Python
+    backend — not wedge every subsequent sync with an HTTP 500
+    (advisor r4: sync_wire raised UnknownError)."""
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.storage.native import native_available
+
+    if not native_available():
+        pytest.skip("native backend unavailable")
+    msgs = tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(
+                Timestamp(1_700_000_000_000 + i * 60_000, 0, "a1b2c3d4e5f60718")
+            ),
+            b"c%d" % i,
+        )
+        for i in range(5)
+    )
+    native, pure = RelayStore(), RelayStore(backend="python")
+    try:
+        for s in (native, pure):
+            s.add_messages("u1", msgs)
+            # A malformed row can only enter via external corruption —
+            # add_messages parses strictly — so inject it directly.
+            s.db.run(
+                'INSERT INTO "message" ("timestamp", "userId", "content") '
+                "VALUES (?, ?, ?)",
+                ("2099-01-01T00:00:00.000Z-00ff", "u1", b"bad"),
+            )
+        cold = protocol.SyncRequest((), "u1", "e" * 16, "{}")
+        # sync_wire falls back to the object path (None), not a raise...
+        assert native.sync_wire(cold) is None
+        # ...and the object path serves the SAME rows as the pure
+        # backend (generic-SQL fallback inside get_messages).
+        got = native.sync(cold)
+        want = pure.sync(cold)
+        assert got.messages == want.messages
+        assert {m.timestamp for m in got.messages} >= {m.timestamp for m in msgs}
+    finally:
+        native.close(), pure.close()
+
+
 def test_merkle_tree_string_verbatim_and_respond_reuse():
     """`get_merkle_tree_string` must return the STORED text verbatim
     (the respond path serves it without a parse→re-dump round trip —
