@@ -170,9 +170,11 @@ class PackedReceive:
         return None
 
     def touched_cells(self):
-        """The unique cells this batch actually touches (a slice may
-        reference only part of `cells`)."""
-        return [self.cells[int(i)] for i in np.unique(self.cell_id)]
+        """→ (touched_ids, cells): the unique cell ids this batch
+        actually references (a slice may touch only part of `cells`)
+        and their (table,row,column) tuples, aligned."""
+        touched_ids = np.unique(self.cell_id)
+        return touched_ids, [self.cells[int(i)] for i in touched_ids]
 
     # -- exact materialization (fallback paths) --
 

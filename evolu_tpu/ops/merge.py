@@ -268,6 +268,83 @@ def plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras=(), return_winn
     return xor_sorted, upsert_sorted, i_s, s1, s2, extras_sorted
 
 
+def plan_merge_sorted_flags(cell_id, k1, k2, ex_k1, ex_k2, extras=()):
+    """`plan_merge_sorted_core` with the stored-winner payloads REPLACED
+    by two flag bits riding in the sort key (r5 kernel restructure).
+
+    The insight: the planner never needs the stored winner's VALUE —
+    only its relation to each row's own key. Both e-dependent
+    expressions reduce to per-row comparisons computable BEFORE the
+    sort:
+
+      xor:    lex_max(p, e) == s  ⟺  (p==s ∨ e==s) ∧ p≤s ∧ e≤s
+              — e only enters via (e>s) and (e==s);
+      upsert: `beats = t >lex e` is only consumed at rows where s == t
+              (first_eligible ⟹ eligible ⟹ s == t), where it equals
+              s >lex e ⟺ ¬(e>s) ∧ ¬(e==s).
+
+    So a = (e >lex s) and b = (e ==lex s) are computed elementwise on
+    the unsorted columns and packed into the key's two lowest bits:
+    key = cell<<26 | idx<<2 | b<<1 | a. The key still total-orders by
+    (cell, idx) — idx is unique, the flag bits are never reached — so
+    the sort order, masks, and every downstream stage are BIT-IDENTICAL
+    to the payload form (property-pinned), but the sort carries 2 u64
+    payloads instead of 4 (r4 pricing: ~0.75 ms/payload at 1M).
+
+    Capacity: idx needs 24 bits and cell 36 (n ≤ 2^24 — same guard as
+    the packed-key form; larger batches fall back to the payload
+    core). The winner-cache kernel keeps `plan_merge_sorted_core`: its
+    `return_winners` scatter needs the stored-winner VALUES.
+
+    MUST be traced inside an enable_x64(True) scope (guarded below).
+    """
+    n = cell_id.shape[0]
+    if n > 1 << 24:
+        return plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    a = (ex_k1 > k1) | ((ex_k1 == k1) & (ex_k2 > k2))  # e >lex s
+    b = (ex_k1 == k1) & (ex_k2 == k2)                  # e ==lex s
+    key = (
+        (cell_id.astype(jnp.int64) << jnp.int64(26))
+        | (idx.astype(jnp.int64) << jnp.int64(2))
+        | (b.astype(jnp.int64) << jnp.int64(1))
+        | a.astype(jnp.int64)
+    )
+    if key.dtype != jnp.dtype("int64"):  # x64 disabled: would mis-plan
+        raise TypeError(
+            "plan_merge_sorted_flags must be traced under enable_x64(True): "
+            f"packed merge key degraded to {key.dtype}"
+        )
+    sorted_ops = jax.lax.sort((key, k1, k2) + tuple(extras), num_keys=1, is_stable=False)
+    key_s = sorted_ops[0]
+    c = (key_s >> jnp.int64(26)).astype(jnp.int32)
+    i_s = ((key_s >> jnp.int64(2)) & jnp.int64((1 << 24) - 1)).astype(jnp.int32)
+    a_s = (key_s & jnp.int64(1)) != 0
+    b_s = (key_s & jnp.int64(2)) != 0
+    s1, s2 = sorted_ops[1:3]
+    extras_sorted = sorted_ops[3:]
+
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), c[1:] != c[:-1]])
+    m1, m2 = _segmented_max_scan(seg_start, s1, s2)
+    zero = jnp.zeros((), jnp.uint64)
+    p1 = jnp.where(seg_start, zero, jnp.roll(m1, 1))
+    p2 = jnp.where(seg_start, zero, jnp.roll(m2, 1))
+    p_eq_s = (p1 == s1) & (p2 == s2)
+    p_gt_s = (p1 > s1) | ((p1 == s1) & (p2 > s2))
+    # lex_max(p, e) == s ⟺ (p==s ∨ e==s) ∧ p≤s ∧ e≤s; xor is its negation.
+    xor_sorted = ~((p_eq_s | b_s) & ~p_gt_s & ~a_s)
+
+    seg_end = jnp.concatenate([seg_start[1:], jnp.ones((1,), bool)])
+    t1, t2 = _segmented_max_scan(seg_end, m1, m2, reverse=True)
+    eligible = (s1 == t1) & (s2 == t2)
+    first_eligible = eligible & ~((p1 == t1) & (p2 == t2))
+    real = c != _PAD_CELL
+    # beats (t >lex e) read only where s == t: there it is ¬(a ∨ b).
+    upsert_sorted = first_eligible & ~(a_s | b_s) & real
+    xor_sorted = xor_sorted & real
+    return xor_sorted, upsert_sorted, i_s, s1, s2, extras_sorted
+
+
 def unpermute_masks(xor_sorted, upsert_sorted, i_s, block_size: int = 0):
     """Host side: sorted-order masks + permutation → original batch
     order. With `block_size` > 0 the arrays are concatenated per-shard
@@ -472,7 +549,7 @@ def _plan_full_kernel(cell_id, k1, k2, ex_k1, ex_k2):
     from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
     from evolu_tpu.ops.merkle_ops import owner_minute_segments
 
-    xor_s, upsert_s, i_s, s1, s2, _ = plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2)
+    xor_s, upsert_s, i_s, s1, s2, _ = plan_merge_sorted_flags(cell_id, k1, k2, ex_k1, ex_k2)
     millis_s, counter_s = unpack_ts_keys(s1)
     hashes = jnp.where(xor_s, timestamp_hashes(millis_s, counter_s, s2), jnp.uint32(0))
     zero_owner = jnp.zeros((), jnp.int32)

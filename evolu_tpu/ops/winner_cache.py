@@ -408,8 +408,7 @@ class DeviceWinnerCache:
                 return None
             # A slice shares the full batch's interned cell list; only
             # the ids this chunk touches get slots/seeds.
-            touched_ids = np.unique(pb.cell_id)
-            cells = [pb.cells[int(i)] for i in touched_ids]
+            touched_ids, cells = pb.touched_cells()
 
             mode, new_cells = self._adaptive_gate(cells)
             if mode == "stream":

@@ -34,7 +34,7 @@ from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
 from evolu_tpu.ops.merge import (
     _PAD_CELL,
     messages_to_columns,
-    plan_merge_sorted_core,
+    plan_merge_sorted_flags,
     select_messages,
     unpermute_masks,
 )
@@ -71,7 +71,7 @@ def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
     rows directly, and the two bool masks return to the host with
     `i_s` for a vectorized numpy unpermute — no device restoring
     sort."""
-    xor_s, upsert_s, i_s, s1, s2, (owner_s,) = plan_merge_sorted_core(
+    xor_s, upsert_s, i_s, s1, s2, (owner_s,) = plan_merge_sorted_flags(
         cell_id, k1, k2, ex_k1, ex_k2, extras=(owner_ix.astype(jnp.int32),)
     )
     millis_s, counter_s = unpack_ts_keys(s1)

@@ -142,15 +142,12 @@ def _plan_packed_streamed_nocache(db, pb):
     """Packed plan with winners streamed from SQLite (winner_cache
     off): the PackedReceive analog of `plan_batch_device_full`. None →
     object path (non-canonical batch or stored winner)."""
-    import numpy as np
-
     from evolu_tpu.ops.merge import plan_packed_streamed
 
     millis, counter, node, case_ok = pb.parse_timestamps()
     if not bool(case_ok.all()):
         return None
-    touched_ids = np.unique(pb.cell_id)
-    cells = [pb.cells[int(i)] for i in touched_ids]
+    touched_ids, cells = pb.touched_cells()
     return plan_packed_streamed(db, pb, millis, counter, node, cells, touched_ids)
 
 

@@ -527,3 +527,49 @@ def test_segmented_xor_scan_matches_reference():
         exp = segmented_xor_scan_reference(jnp.asarray(flags), jnp.asarray(v))
         got = segmented_xor_scan(jnp.asarray(flags), jnp.asarray(v))
         assert (np.asarray(exp) == np.asarray(got)).all(), n
+
+
+def test_flags_kernel_matches_payload_kernel():
+    """The r5 production kernel (`plan_merge_sorted_flags`: stored-winner
+    relations as two flag bits in the sort key, 2 u64 payloads) must be
+    BIT-identical to the payload core on adversarial shapes — exact key
+    ties, e==s, zero keys, heavy cell contention, padding rows, and an
+    extras payload — since both `_plan_full_kernel` and the sharded
+    reconcile now route through it."""
+    import jax.numpy as jnp
+
+    from evolu_tpu.ops.merge import (
+        _PAD_CELL,
+        plan_merge_sorted_core,
+        plan_merge_sorted_flags,
+    )
+
+    old_j = jax.jit(lambda *a: plan_merge_sorted_core(*a[:5], extras=(a[5],)))
+    new_j = jax.jit(lambda *a: plan_merge_sorted_flags(*a[:5], extras=(a[5],)))
+    rng = np.random.default_rng(17)
+    N = 1024
+    with jax.enable_x64(True):
+        for trial in range(25):
+            n = int(rng.integers(4, N))
+            cells = int(rng.integers(1, max(2, n // 2)))
+            cell = np.full(N, int(_PAD_CELL), np.int32)
+            cell[:n] = rng.integers(0, cells, n)
+            # Tiny key range → many exact ties, e==s rows, p>s runs.
+            k1 = np.zeros(N, np.uint64)
+            k2 = np.zeros(N, np.uint64)
+            k1[:n] = rng.integers(0, 6, n)
+            k2[:n] = rng.integers(0, 4, n)
+            ex1 = np.zeros(N, np.uint64)
+            ex2 = np.zeros(N, np.uint64)
+            has = rng.random(cells) < 0.7
+            ex1_c = np.where(has, rng.integers(0, 6, cells), 0).astype(np.uint64)
+            ex2_c = np.where(has, rng.integers(0, 4, cells), 0).astype(np.uint64)
+            ex1[:n] = ex1_c[cell[:n]]
+            ex2[:n] = ex2_c[cell[:n]]
+            owner = rng.integers(0, 64, N).astype(np.int32)
+            args = tuple(map(jnp.asarray, (cell, k1, k2, ex1, ex2, owner)))
+            old = old_j(*args)
+            new = new_j(*args)
+            for j in range(5):
+                assert np.array_equal(np.asarray(old[j]), np.asarray(new[j])), (trial, j)
+            assert np.array_equal(np.asarray(old[5][0]), np.asarray(new[5][0])), trial
