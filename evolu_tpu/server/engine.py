@@ -412,6 +412,12 @@ class BatchReconciler:
     ) -> List[protocol.SyncResponse]:
         """One batched pass; responses align with `requests` order.
         End state is identical to running `store.sync` per request."""
+        trees, strings = self._ingest(requests)
+        return self._respond(requests, trees, strings)
+
+    def _ingest(self, requests):
+        """The batched ingest, routed by store shape → (trees, strings).
+        ONE copy shared by `reconcile` and `reconcile_wire`."""
         from evolu_tpu.server.relay import ShardedRelayStore
 
         strings: Dict[str, str] = {}
@@ -428,7 +434,7 @@ class BatchReconciler:
             trees = self._ingest_packed(requests, strings)
         else:
             trees = self._ingest_generic(requests, strings)
-        return self._respond(requests, trees, strings)
+        return trees, strings
 
     def _shards(self):
         from evolu_tpu.server.relay import ShardedRelayStore
@@ -860,41 +866,108 @@ class BatchReconciler:
                 )
         return trees
 
+    def _resolve_tree(self, user_id: str, trees, tree_strings):
+        """Tree + serialized string for one owner, reusing the ingest's
+        caches; owners not in `trees` (no new rows this batch — the
+        cold-sync shape) read the STORED string verbatim and parse it
+        once for the diff, never re-dumping (the parse→re-dump
+        round-trip, ~1.25 ms per realistic owner tree, was the measured
+        respond wall at 1k divergent owners — docs/BENCHMARKS.md r4).
+        Mutates both caches; ONE copy shared by `_respond` and
+        `_respond_wire`."""
+        from evolu_tpu.core.merkle import merkle_tree_from_string
+
+        tree = trees.get(user_id)
+        if tree is None:
+            if hasattr(self.store, "get_merkle_tree_string"):
+                raw = self.store.get_merkle_tree_string(user_id)
+                tree = merkle_tree_from_string(raw)
+            else:
+                tree = self.store.get_merkle_tree(user_id)
+                raw = merkle_tree_to_string(tree)
+            trees[user_id] = tree
+            tree_strings.setdefault(user_id, raw)
+        raw = tree_strings.get(user_id)
+        if raw is None:
+            raw = tree_strings[user_id] = merkle_tree_to_string(tree)
+        return tree, raw
+
     def _respond(
         self, requests, trees: Dict[str, dict],
         tree_strings: Optional[Dict[str, str]] = None,
     ) -> List[protocol.SyncResponse]:
-        """Standard diff per request against the updated trees.
-
-        `tree_strings` carries serializations the ingest already
-        computed for the merkleTree upsert; owners not in `trees`
-        (no new rows this batch — the cold-sync shape) read the STORED
-        string verbatim and parse it once for the diff, never
-        re-dumping. The parse→re-dump round-trip (~1.25 ms per
-        realistic owner tree) was the measured respond wall at 1k
-        divergent owners (docs/BENCHMARKS.md r4)."""
+        """Standard diff per request against the updated trees."""
         from evolu_tpu.core.merkle import merkle_tree_from_string
 
         responses = []
         tree_strings = dict(tree_strings or {})
         for r in requests:
-            tree = trees.get(r.user_id)
-            if tree is None:
-                if hasattr(self.store, "get_merkle_tree_string"):
-                    raw = self.store.get_merkle_tree_string(r.user_id)
-                    tree = merkle_tree_from_string(raw)
-                else:
-                    tree = self.store.get_merkle_tree(r.user_id)
-                    raw = merkle_tree_to_string(tree)
-                trees[r.user_id] = tree
-                tree_strings.setdefault(r.user_id, raw)
+            tree, ts = self._resolve_tree(r.user_id, trees, tree_strings)
             client_tree = merkle_tree_from_string(r.merkle_tree)
             messages = self.store.get_messages(r.user_id, r.node_id, tree, client_tree)
-            ts = tree_strings.get(r.user_id)
-            if ts is None:
-                ts = tree_strings[r.user_id] = merkle_tree_to_string(tree)
             responses.append(protocol.SyncResponse(messages, ts))
         return responses
+
+    def reconcile_wire(
+        self, requests: Sequence[protocol.SyncRequest]
+    ) -> List[bytes]:
+        """`reconcile` with BYTES-mode responses: each entry is the
+        fully encoded SyncResponse, the messages stream emitted
+        straight from C (`eh_get_messages_wire`) — for consumers that
+        only forward protobuf (the HTTP/pod serve paths), where the
+        per-message SyncResponse objects of `_respond` were pure
+        retention cost (docs/BENCHMARKS.md r4: the divergent respond
+        leg was ~196k msgs/s object-bound while the relay's identical
+        C leg served 1.39M). Byte-identical to
+        `encode_sync_response(reconcile(...)[i])` (test-pinned);
+        per-request fallback to the object path + encoder where the C
+        entry is missing or a stored row is non-canonical."""
+        trees, strings = self._ingest(requests)
+        return self._respond_wire(requests, trees, strings)
+
+    def _respond_wire(
+        self, requests, trees: Dict[str, dict],
+        tree_strings: Optional[Dict[str, str]] = None,
+    ) -> List[bytes]:
+        """Bytes-mode twin of `_respond`. The response composition is
+        `relay.fetch_response_stream` (ONE copy shared with
+        `RelayStore.sync_wire`) plus the field-2 tree string — the SAME
+        serialized tree `_respond` would carry, so encodings are
+        byte-identical. Requests a shard cannot C-serve (python
+        backend, malformed stored row) degrade to ONE batched
+        object-path respond at their original positions."""
+        from evolu_tpu.core.merkle import merkle_tree_from_string
+        from evolu_tpu.core.types import NonCanonicalStoreError
+        from evolu_tpu.server.relay import fetch_response_stream
+
+        shards, shard_ix = self._shards()
+        tree_strings = dict(tree_strings or {})
+        out: List[Optional[bytes]] = []
+        fallback: List[Tuple[int, protocol.SyncRequest]] = []
+        for i, r in enumerate(requests):
+            tree, raw = self._resolve_tree(r.user_id, trees, tree_strings)
+            db = shards[shard_ix(r.user_id)].db
+            if not hasattr(db, "fetch_relay_messages_wire"):
+                fallback.append((i, r))
+                out.append(None)
+                continue
+            client_tree = merkle_tree_from_string(r.merkle_tree)
+            try:
+                stream = fetch_response_stream(
+                    db, r.user_id, r.node_id, tree, client_tree
+                )
+            except NonCanonicalStoreError:
+                # A malformed stored width degrades this request to the
+                # object path (generic SQL), like sync_wire.
+                fallback.append((i, r))
+                out.append(None)
+                continue
+            out.append(stream + protocol._string(2, raw))
+        if fallback:
+            resps = self._respond([r for _i, r in fallback], trees, tree_strings)
+            for (i, _r), resp in zip(fallback, resps):
+                out[i] = protocol.encode_sync_response(resp)
+        return out
 
 
 # -- pod-scale multi-process reconcile (VERDICT r3 #3) --
@@ -924,8 +997,9 @@ def owner_process(user_id: str, nproc: int) -> int:
 
 @with_x64
 def reconcile_pod(
-    mesh: Mesh, store, requests: Sequence[protocol.SyncRequest]
-) -> Tuple[List[Optional[protocol.SyncResponse]], int]:
+    mesh: Mesh, store, requests: Sequence[protocol.SyncRequest],
+    wire: bool = False,
+) -> Tuple[List, int]:
     """One pod pass. Call on EVERY process of the cluster with
     identical `requests` (the ingest fabric broadcasts a batch; each
     process answers for the owners it stores). Returns (responses,
@@ -935,6 +1009,12 @@ def reconcile_pod(
     optimistically like `reconcile_stream`), replicated to all
     processes by the all-reduce, so agreement across processes is an
     end-to-end integrity check of the global dispatch.
+
+    With `wire=True`, owned requests get the BYTES-mode response
+    (`_respond_wire`: the encoded SyncResponse with its messages stream
+    straight from C) — the pod serve path only forwards protobuf, so
+    the object layer is skipped; byte-identical to encoding the
+    object-mode response (test-pinned).
 
     Storage semantics per owner are identical to the single-process
     `BatchReconciler.reconcile`: in-batch dedup in request order, PK
@@ -1102,10 +1182,11 @@ def reconcile_pod(
 
     # 5) Respond for MY requests (message-less cold-sync requests route
     # by the same stable owner hash).
-    responses: List[Optional[protocol.SyncResponse]] = []
+    respond = eng._respond_wire if wire else eng._respond
+    responses: List = []
     for r in requests:
         if owner_process(r.user_id, nproc) == pid:
-            responses.append(eng._respond([r], trees, tree_strings)[0])
+            responses.append(respond([r], trees, tree_strings)[0])
         else:
             responses.append(None)
     return responses, digest

@@ -42,6 +42,23 @@ from evolu_tpu.sync import protocol
 
 MAX_BODY_BYTES = 20 * 1024 * 1024  # index.ts:222
 
+
+def fetch_response_stream(db, user_id, node_id, server_tree, client_tree) -> bytes:
+    """The C-served SyncResponse `messages` stream for one request:
+    tree diff → since timestamp → `eh_get_messages_wire`. b"" when the
+    trees agree; raises NonCanonicalStoreError for a malformed stored
+    row (callers degrade that request to the object path). ONE copy of
+    this byte-format-coupled composition, shared by
+    `RelayStore.sync_wire` and `BatchReconciler._respond_wire` — the
+    serve rule must never drift between them (byte-identity with the
+    object path is test-pinned at both call sites)."""
+    diff = diff_merkle_trees(server_tree, client_tree)
+    if diff is None:
+        return b""
+    since = timestamp_to_string(create_sync_timestamp(diff))
+    stream, _n = db.fetch_relay_messages_wire(user_id, since, node_id)
+    return stream
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -183,22 +200,17 @@ class RelayStore:
             return None
         tree = self.add_messages(request.user_id, request.messages)
         client_tree = merkle_tree_from_string(request.merkle_tree)
-        diff = diff_merkle_trees(tree, client_tree)
-        if diff is None:
-            stream = b""
-        else:
-            since = timestamp_to_string(create_sync_timestamp(diff))
-            try:
-                stream, _n = self.db.fetch_relay_messages_wire(
-                    request.user_id, since, request.node_id
-                )
-            except NonCanonicalStoreError:
-                # A single malformed stored timestamp must not wedge
-                # this owner's sync: serve via the object path, whose
-                # get_messages degrades to generic SQL (advisor r4).
-                # add_messages above was idempotent, so the caller's
-                # sync() re-run is safe.
-                return None
+        try:
+            stream = fetch_response_stream(
+                self.db, request.user_id, request.node_id, tree, client_tree
+            )
+        except NonCanonicalStoreError:
+            # A single malformed stored timestamp must not wedge this
+            # owner's sync: serve via the object path, whose
+            # get_messages degrades to generic SQL (advisor r4).
+            # add_messages above was idempotent, so the caller's
+            # sync() re-run is safe.
+            return None
         # add_messages just dumped + stored this exact tree: read the
         # stored text back (one small SELECT) instead of a second
         # ~25KB JSON dump per request (review finding).

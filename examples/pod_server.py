@@ -83,11 +83,16 @@ def main() -> None:
         tree = merkle_tree_to_string(apply_prefix_xors({}, deltas))
         requests.append(protocol.SyncRequest(tuple(msgs), f"owner{o}", "f" * 16, tree))
 
-    responses, digest = reconcile_pod(mesh, store, tuple(requests))
+    # wire=True: a server only forwards response BYTES, so the serve
+    # path skips the per-message object layer entirely (r5; the bytes
+    # are exactly encode_sync_response of the object-mode responses).
+    responses, digest = reconcile_pod(mesh, store, tuple(requests), wire=True)
     mine = [i for i, r in enumerate(responses) if r is not None]
+    served = sum(len(r) for r in responses if r is not None)
     print(
         f"proc {args.pid}/{args.nproc}: answered {len(mine)}/{len(requests)} "
-        f"requests {mine}, pod digest 0x{digest & 0xFFFFFFFF:08x}"
+        f"requests {mine} ({served} response bytes), "
+        f"pod digest 0x{digest & 0xFFFFFFFF:08x}"
     )
     store.close()
 

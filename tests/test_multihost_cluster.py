@@ -143,6 +143,7 @@ def test_pod_single_process_quarantines_non_canonical_owner(tmp_path):
 
     mesh = create_mesh()
     pod_store = ShardedRelayStore(str(tmp_path / "pod"), shards=2)
+    wire_store = ShardedRelayStore(str(tmp_path / "wire"), shards=2)
     ref_store = ShardedRelayStore(str(tmp_path / "ref"), shards=2)
     eng = BatchReconciler(ref_store)
     try:
@@ -156,8 +157,13 @@ def test_pod_single_process_quarantines_non_canonical_owner(tmp_path):
         host_deltas, _ = minute_deltas_host(m.timestamp for m in reqs[1].messages)
         want = merkle_tree_to_string(apply_prefix_xors({}, host_deltas))
         assert pod_resp[1].merkle_tree == want
+        # r5 pod serve path: wire=True must emit the exact encodings of
+        # the object-mode responses (fresh store — same ingest inputs).
+        wire_resp, _d = reconcile_pod(mesh, wire_store, tuple(reqs), wire=True)
+        for i, (w, r) in enumerate(zip(wire_resp, ref_resp)):
+            assert w == encode_sync_response(r), f"wire req {i}"
     finally:
-        eng.close(), pod_store.close(), ref_store.close()
+        eng.close(), pod_store.close(), wire_store.close(), ref_store.close()
 
 
 def test_two_process_cluster_reconcile():

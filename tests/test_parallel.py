@@ -323,6 +323,64 @@ def test_batch_reconciler_idempotent_and_cross_device_fetch():
     assert len(resp.messages) == len(msgs)
 
 
+def test_reconcile_wire_byte_identical_to_object_respond():
+    """`BatchReconciler.reconcile_wire` (r5: bytes-mode respond over
+    `eh_get_messages_wire`) must be BYTE-identical to
+    `encode_sync_response(reconcile(...)[i])` across push, cold pull,
+    steady state, NUL-bearing ids, a sharded store, and the
+    python-backend fallback — and a malformed stored timestamp must
+    degrade that request to the object path, not wedge it."""
+    from evolu_tpu.server.engine import BatchReconciler
+    from evolu_tpu.server.relay import RelayStore, ShardedRelayStore
+    from evolu_tpu.sync import protocol
+
+    def enc(msgs):
+        return tuple(
+            protocol.EncryptedCrdtMessage(m.timestamp, b"ct\x00-" + m.timestamp.encode())
+            for m in msgs
+        )
+
+    owners = {f"w{i:03d}": _mk_messages(f"{i + 7:016x}", 25 + i * 3) for i in range(6)}
+    owners["u\x00evil"] = _mk_messages("a" * 16, 10)  # NUL-bearing id
+    push = [
+        _sync_req(o, msgs[0].timestamp[30:46], enc(msgs)) for o, msgs in owners.items()
+    ]
+    cold = [_sync_req(o, "e" * 16) for o in owners]  # other-device pulls
+
+    for mk in (lambda: RelayStore(), lambda: ShardedRelayStore(shards=3),
+               lambda: RelayStore(backend="python")):
+        obj_store, wire_store = mk(), mk()
+        obj_eng = BatchReconciler(obj_store, create_mesh())
+        wire_eng = BatchReconciler(wire_store, create_mesh())
+        for batch in (push, cold, cold):  # cold twice = steady-state repeat
+            want = [protocol.encode_sync_response(r) for r in obj_eng.reconcile(batch)]
+            got = wire_eng.reconcile_wire(batch)
+            assert got == want
+        obj_eng.close(), wire_eng.close()
+        obj_store.close(), wire_store.close()
+
+    # Malformed stored width: rc 2 must degrade that request to the
+    # object path (both engines serve the same bytes, no exception).
+    from evolu_tpu.storage.native import native_available
+
+    if native_available():
+        obj_store, wire_store = RelayStore(), RelayStore()
+        for s in (obj_store, wire_store):
+            s.add_messages("u1", enc(owners["w000"]))
+            s.db.run(
+                'INSERT INTO "message" ("timestamp", "userId", "content") '
+                "VALUES (?, ?, ?)",
+                ("2099-01-01T00:00:00.000Z-00ff", "u1", b"bad"),
+            )
+        obj_eng = BatchReconciler(obj_store, create_mesh())
+        wire_eng = BatchReconciler(wire_store, create_mesh())
+        (want,) = obj_eng.reconcile([_sync_req("u1", "e" * 16)])
+        (got,) = wire_eng.reconcile_wire([_sync_req("u1", "e" * 16)])
+        assert got == protocol.encode_sync_response(want)
+        obj_eng.close(), wire_eng.close()
+        obj_store.close(), wire_store.close()
+
+
 def test_hot_owner_cell_sharding_matches_single_device():
     """One hot owner's batch sharded by cell ranges over 8 devices must
     produce the single-device planner's exact masks, minute deltas, and
