@@ -76,9 +76,60 @@ def main():
             "batch": N, "owners": OWNERS, "devices": n_dev,
             "per_chip": round(total_rate / n_dev),
             "p50_ms": round(p50 * 1e3, 3),
+            "pod_pass": pod_pass(mesh),
             "platform": jax.devices()[0].platform,
         },
     }))
+
+
+def pod_pass(mesh):
+    """r5 (VERDICT r4 next #4): ONE WHOLE-SERVER pod pass — the literal
+    BASELINE "one pod pass" shape (reference apps/server/src/index.ts:
+    224-248 at pod scale). `reconcile_pod` runs ingest + the SPMD
+    Merkle dispatch over this mesh + the wire-mode serve on a fresh
+    store per trial; single-process degenerate semantics are byte-equal
+    to the plain engine (test-pinned)."""
+    from evolu_tpu.core.merkle import (
+        apply_prefix_xors,
+        merkle_tree_to_string,
+        minute_deltas_host,
+    )
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.server.engine import reconcile_pod
+    from evolu_tpu.server.relay import ShardedRelayStore
+    from evolu_tpu.sync import protocol
+
+    pod_owners = int(os.environ.get("POD_OWNERS", 500))
+    per = int(os.environ.get("POD_N", 200_000)) // pod_owners
+    pod_n = per * pod_owners  # honest: the rows actually built
+    base = 1_700_000_000_000
+    requests = []
+    for o in range(pod_owners):
+        ts = [
+            timestamp_to_string(Timestamp(base + (o * 977 + i) * 1000, i % 4, f"{o + 1:016x}"))
+            for i in range(per)
+        ]
+        msgs = tuple(protocol.EncryptedCrdtMessage(t, b"c" * 64) for t in ts)
+        deltas, _ = minute_deltas_host(iter(ts))
+        requests.append(protocol.SyncRequest(
+            msgs, f"owner{o}", "f" * 16,
+            merkle_tree_to_string(apply_prefix_xors({}, deltas)),
+        ))
+    times = []
+    for _ in range(3):
+        store = ShardedRelayStore(shards=min(8, mesh.devices.size))
+        t0 = time.perf_counter()
+        _resp, _digest = reconcile_pod(mesh, store, tuple(requests), wire=True)
+        times.append(time.perf_counter() - t0)
+        store.close()
+    p50 = statistics.median(times)
+    return {
+        "msgs_per_sec": round(pod_n / p50),
+        "p50_ms": round(p50 * 1e3, 1),
+        "rows": pod_n,
+        "owners": pod_owners,
+        "wire_serve": True,
+    }
 
 
 if __name__ == "__main__":

@@ -202,7 +202,10 @@ def receive_timestamps_batch_packed(
 
     try:
         local_u64 = np.uint64(int(local.node, 16))
-    except ValueError:  # non-hex local node: conservatively sequential
+    except (ValueError, OverflowError):
+        # Non-hex or out-of-u64-range local node: conservatively
+        # sequential (unreachable via the worker — strict parse pins 16
+        # hex chars — but direct API callers get the safe path).
         return _receive_batch(
             local, millis, counter, now, max_drift,
             dup_screen=lambda: True, nodes=nodes,
