@@ -89,32 +89,16 @@ def pod_pass(mesh):
     Merkle dispatch over this mesh + the wire-mode serve on a fresh
     store per trial; single-process degenerate semantics are byte-equal
     to the plain engine (test-pinned)."""
-    from evolu_tpu.core.merkle import (
-        apply_prefix_xors,
-        merkle_tree_to_string,
-        minute_deltas_host,
-    )
-    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from benchmarks.pod_requests import build_pod_requests
     from evolu_tpu.server.engine import reconcile_pod
     from evolu_tpu.server.relay import ShardedRelayStore
-    from evolu_tpu.sync import protocol
 
     pod_owners = int(os.environ.get("POD_OWNERS", 500))
     per = int(os.environ.get("POD_N", 200_000)) // pod_owners
     pod_n = per * pod_owners  # honest: the rows actually built
-    base = 1_700_000_000_000
-    requests = []
-    for o in range(pod_owners):
-        ts = [
-            timestamp_to_string(Timestamp(base + (o * 977 + i) * 1000, i % 4, f"{o + 1:016x}"))
-            for i in range(per)
-        ]
-        msgs = tuple(protocol.EncryptedCrdtMessage(t, b"c" * 64) for t in ts)
-        deltas, _ = minute_deltas_host(iter(ts))
-        requests.append(protocol.SyncRequest(
-            msgs, f"owner{o}", "f" * 16,
-            merkle_tree_to_string(apply_prefix_xors({}, deltas)),
-        ))
+    requests, _expect = build_pod_requests(
+        owners=pod_owners, per=per, factor=977, stride_ms=1000, payload=b"c" * 64
+    )
     times = []
     for _ in range(3):
         store = ShardedRelayStore(shards=min(8, mesh.devices.size))

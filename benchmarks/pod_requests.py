@@ -1,0 +1,48 @@
+"""Synthetic pod-server request batches — ONE copy of the SyncRequest
+synthesis shared by the multichip dryrun (`__graft_entry__`) and
+`benchmarks/config5_mesh.py`, so the artifact cross-check and the
+bench can never drift apart on the request shape."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.core.merkle import (
+    apply_prefix_xors,
+    merkle_tree_to_string,
+    minute_deltas_host,
+)
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.sync import protocol
+
+_BASE = 1_700_000_000_000
+
+
+def build_pod_requests(owners: int, per: int, factor: int, stride_ms: int,
+                       payload: bytes = b"ct"):
+    """→ (requests, expected_digest): `owners` owners each pushing
+    `per` canonical messages (millis = base + (o*factor + i)*stride_ms)
+    with their post-apply trees — the steady-state push shape.
+    `expected_digest` is the XOR of the host minute-fold digests, which
+    a clean pod pass (no duplicates) must reproduce on device."""
+    requests = []
+    expect = 0
+    for o in range(owners):
+        ts = [
+            timestamp_to_string(
+                Timestamp(_BASE + (o * factor + i) * stride_ms, i % 4,
+                          f"{o + 1:016x}")
+            )
+            for i in range(per)
+        ]
+        msgs = tuple(
+            protocol.EncryptedCrdtMessage(t, payload + b"-%d" % o) for t in ts
+        )
+        deltas, owner_digest = minute_deltas_host(iter(ts))
+        expect ^= owner_digest
+        requests.append(protocol.SyncRequest(
+            msgs, f"owner{o}", "f" * 16,
+            merkle_tree_to_string(apply_prefix_xors({}, deltas)),
+        ))
+    return requests, expect & 0xFFFFFFFF
