@@ -65,6 +65,32 @@ def main():
         out["changed_cycle_ms"] = round(
             out["raw_read_ms"] + out["unpack_ms"] + out["diff_ms"], 2)
 
+        # r5: the row-granular changed path — mutate ONE row of 10k,
+        # then the full production cycle: packed read with offsets →
+        # row-aligned partial unpack (unchanged rows reuse prev dicts)
+        # → identity-shortcut diff.
+        from evolu_tpu.storage.native import unpack_changed_rows
+
+        prev_raw, prev_offs = w.db.exec_sql_query_packed_raw(
+            sql, params, with_offsets=True
+        )
+        prev_rows = unpack_packed_rows(prev_raw)
+        # Toggle `done` on one mid-result row: the canonical reactive
+        # mutation — sort position and row count unchanged.
+        row_id = prev_rows[ROWS // 2]["id"]
+        w.db.run('UPDATE "todo" SET "done" = 1 WHERE "id" = ?', (row_id,))
+
+        def changed_row_cycle():
+            raw2, offs2 = w.db.exec_sql_query_packed_raw(
+                sql, params, with_offsets=True
+            )
+            rows2 = unpack_changed_rows(raw2, offs2, prev_raw, prev_offs, prev_rows)
+            return create_patch(prev_rows, rows2)
+
+        ops = changed_row_cycle()
+        assert ops, "the mutation must produce a patch"
+        out["changed_1row_granular_cycle_ms"] = round(med(changed_row_cycle), 2)
+
     def per_cell():
         with w.db._lock:
             r, c = w.db._execute(sql, params)

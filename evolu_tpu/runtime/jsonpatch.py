@@ -23,7 +23,9 @@ def create_patch(prev: Sequence[Dict[str, Any]], next_: Sequence[Dict[str, Any]]
     ops: List[dict] = []
     common = min(len(prev), len(next_))
     for i in range(common):
-        if prev[i] != next_[i]:
+        # Identity first: the row-granular unpack reuses unchanged row
+        # dicts, so most rows shortcut without a key-by-key compare.
+        if prev[i] is not next_[i] and prev[i] != next_[i]:
             ops.append({"op": "replace", "path": f"/{i}", "value": next_[i]})
     # Removals are emitted back-to-front so paths stay valid while applying.
     for i in range(len(prev) - 1, common - 1, -1):

@@ -204,16 +204,17 @@ class DbWorker:
         self.sync_lock = sync_lock or get_sync_lock(db.path)
         self.owner: Optional[Owner] = None
         self.queries_rows_cache: Dict[str, List[dict]] = {}
-        # Raw packed result bytes per query — the change detector for
-        # the reactive loop; lifecycle mirrors queries_rows_cache
-        # exactly (staged per command, committed on success, evicted
-        # and cleared together — a desynced pair would suppress or
-        # duplicate patches).
-        self.queries_raw_cache: Dict[str, bytes] = {}
+        # (raw packed result bytes, per-row offsets) per query — the
+        # change detector for the reactive loop (bytes) plus the r5
+        # row-granular alignment key (offsets); lifecycle mirrors
+        # queries_rows_cache exactly (staged per command, committed on
+        # success, evicted and cleared together — a desynced pair would
+        # suppress or duplicate patches).
+        self.queries_raw_cache: Dict[str, tuple] = {}
         self._planner = select_planner(self.config, self.db)
         self._staged_effects: List = []
         self._staged_cache: Dict[str, List[dict]] = {}
-        self._staged_raw: Dict[str, bytes] = {}
+        self._staged_raw: Dict[str, tuple] = {}
         self._queue: "queue.Queue[object]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._stop = object()
@@ -290,7 +291,7 @@ class DbWorker:
         and surface as OnError (db.worker.ts:57-73)."""
         self._staged_effects = []
         self._staged_cache: Dict[str, List[dict]] = {}
-        self._staged_raw: Dict[str, bytes] = {}
+        self._staged_raw: Dict[str, tuple] = {}
         try:
             from contextlib import nullcontext
 
@@ -536,29 +537,48 @@ class DbWorker:
         patches = []
         raw_capable = hasattr(self.db, "exec_sql_query_packed_raw")
         if raw_capable:
-            from evolu_tpu.storage.native import unpack_packed_rows
+            from evolu_tpu.storage.native import (
+                unpack_changed_rows,
+                unpack_packed_rows,
+            )
         for q in queries:
             sql, parameters = msg.deserialize_query(q)
-            raw = None
+            entry = None
             if raw_capable:
-                raw = self.db.exec_sql_query_packed_raw(sql, parameters)
-                prev_raw = self._staged_raw.get(q, self.queries_raw_cache.get(q))
+                raw, offs = self.db.exec_sql_query_packed_raw(
+                    sql, parameters, with_offsets=True
+                )
+                entry = (raw, offs)
+                prev_entry = self._staged_raw.get(q, self.queries_raw_cache.get(q))
                 cached = q in self._staged_cache or q in self.queries_rows_cache
-                if cached and prev_raw == raw:
-                    self._staged_raw[q] = raw
+                if cached and prev_entry is not None and prev_entry[0] == raw:
+                    self._staged_raw[q] = prev_entry
                     continue  # unchanged — no parse, no diff, no patch
-                rows = unpack_packed_rows(raw)
+                prev = self._staged_cache.get(q, self.queries_rows_cache.get(q, []))
+                if (
+                    prev_entry is not None and prev
+                    and offs is not None and prev_entry[1] is not None
+                ):
+                    # Row-granular: only changed row spans unpack; rows
+                    # with unchanged bytes reuse prev's dict objects
+                    # (identity-stable — create_patch shortcuts on
+                    # `is`, and subscribers keep referential equality).
+                    rows = unpack_changed_rows(
+                        raw, offs, prev_entry[0], prev_entry[1], prev
+                    )
+                else:  # no prior entry, or a stale .so gave no offsets
+                    rows = unpack_packed_rows(raw)
             else:
                 rows = self.db.exec_sql_query(sql, parameters)
-            prev = self._staged_cache.get(q, self.queries_rows_cache.get(q, []))
+                prev = self._staged_cache.get(q, self.queries_rows_cache.get(q, []))
             ops = create_patch(prev, rows)
             # Stage rows BEFORE raw: an exception between unpack and here
             # leaves both staged caches at their old values — staging raw
             # first would let the OnError commit path pair NEW bytes with
             # OLD rows, suppressing the patch forever (advisor r4).
             self._staged_cache[q] = rows
-            if raw is not None:
-                self._staged_raw[q] = raw
+            if entry is not None:
+                self._staged_raw[q] = entry
             if ops:
                 patches.append((q, ops))
         if patches or on_complete_ids:

@@ -820,9 +820,15 @@ int eh_get_messages_wire(sqlite3 *db, const char *user, int32_t user_len,
 //   per row: ncols x ([u8 type] + payload) where type/payload is
 //     1 int (i64), 2 float (f64), 3 text (u32 len + bytes),
 //     4 blob (u32 len + bytes), 5 null (no payload)
+// `out_offsets` (nullable): malloc'd int64[rows+1] — byte offset of each
+// row's start within `out`, with offsets[0] = header size and
+// offsets[rows] = total length. The worker's row-granular change
+// detection diffs consecutive result sets per ROW span and unpacks only
+// changed rows (runtime/worker.py::_query, r5).
 int eh_exec_packed(sqlite3_stmt *st, unsigned char **out, int64_t *out_len,
-                   int64_t *out_rows) {
+                   int64_t *out_rows, int64_t **out_offsets) {
   std::string buf;
+  std::vector<int64_t> offsets;
   int ncols = sqlite3_column_count(st);
   auto put_i32 = [&buf](int32_t v) {
     buf.append(reinterpret_cast<const char *>(&v), 4);
@@ -838,6 +844,7 @@ int eh_exec_packed(sqlite3_stmt *st, unsigned char **out, int64_t *out_len,
   int rc;
   while ((rc = sqlite3_step(st)) == SQLITE_ROW) {
     rows++;
+    if (out_offsets) offsets.push_back(int64_t(buf.size()));
     for (int c = 0; c < ncols; ++c) {
       int t = sqlite3_column_type(st, c);
       if (t == SQLITE_INTEGER) {
@@ -870,6 +877,16 @@ int eh_exec_packed(sqlite3_stmt *st, unsigned char **out, int64_t *out_len,
       static_cast<unsigned char *>(malloc(buf.size() ? buf.size() : 1));
   if (!p) return 3;
   memcpy(p, buf.data(), buf.size());
+  if (out_offsets) {
+    offsets.push_back(int64_t(buf.size()));  // [rows] = total length
+    int64_t *op = static_cast<int64_t *>(malloc(offsets.size() * 8));
+    if (!op) {
+      free(p);
+      return 3;
+    }
+    memcpy(op, offsets.data(), offsets.size() * 8);
+    *out_offsets = op;
+  }
   *out = p;
   *out_len = static_cast<int64_t>(buf.size());
   *out_rows = rows;

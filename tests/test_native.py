@@ -332,3 +332,65 @@ def test_packed_query_reader_full_type_matrix():
     raw_empty = cpp.exec_sql_query_packed_raw('SELECT "a" FROM "t" WHERE "a" = -42')
     assert unpack_packed_rows(raw_empty) == []
     cpp.close(), py.close()
+
+
+def test_unpack_changed_rows_matches_full_unpack():
+    """The r5 row-granular unpack (`unpack_changed_rows`) must produce
+    EXACTLY `unpack_packed_rows(raw)` for any pair of consecutive
+    result sets — in-place edits (same and different encoded length),
+    appends, deletions, reorders, type changes, NULL/blob values, and
+    empty↔nonempty transitions — while reusing the previous result's
+    dict OBJECTS for rows whose packed bytes are unchanged."""
+    import random
+
+    from evolu_tpu.storage.native import (
+        native_available,
+        open_database,
+        unpack_changed_rows,
+        unpack_packed_rows,
+    )
+
+    if not native_available():
+        pytest.skip("native backend unavailable")
+    db = open_database(backend="auto")
+    db.exec('CREATE TABLE "t" ("id" TEXT PRIMARY KEY, "a" BLOB, "b" BLOB)')
+    rng = random.Random(5)
+    SQL = 'SELECT * FROM "t" ORDER BY "id"'
+
+    def populate(n, mutate=None):
+        db.run('DELETE FROM "t"', ())
+        for i in range(n):
+            v = (mutate or {}).get(i, f"val{i}")
+            db.run('INSERT INTO "t" VALUES (?, ?, ?)',
+                   (f"id{i:05d}", v, i * (1.5 if i % 3 else 1)))
+
+    populate(300)
+    prev_raw, prev_offs = db.exec_sql_query_packed_raw(SQL, (), with_offsets=True)
+    prev_rows = unpack_packed_rows(prev_raw)
+
+    # In-place same-length edit: exactly one fresh dict, rest reused.
+    populate(300, {50: "VAL50"})
+    raw, offs = db.exec_sql_query_packed_raw(SQL, (), with_offsets=True)
+    got = unpack_changed_rows(raw, offs, prev_raw, prev_offs, prev_rows)
+    assert got == unpack_packed_rows(raw)
+    assert sum(g is p for g, p in zip(got, prev_rows)) == 299
+
+    # Append keeps the whole previous prefix by identity.
+    populate(310)
+    raw, offs = db.exec_sql_query_packed_raw(SQL, (), with_offsets=True)
+    got = unpack_changed_rows(raw, offs, prev_raw, prev_offs, prev_rows)
+    assert got == unpack_packed_rows(raw)
+
+    # Random mutation chains (incl. NULL/blob/length changes/shrink).
+    for trial in range(40):
+        n = rng.randrange(0, 40)
+        mutate = {
+            i: rng.choice([None, b"\x00\xffbin", "m" * rng.randrange(1, 9), 7, 2.5])
+            for i in rng.sample(range(max(n, 1)), min(n, rng.randrange(0, 6)))
+        }
+        populate(n, mutate)
+        raw, offs = db.exec_sql_query_packed_raw(SQL, (), with_offsets=True)
+        got = unpack_changed_rows(raw, offs, prev_raw, prev_offs, prev_rows)
+        assert got == unpack_packed_rows(raw), trial
+        prev_raw, prev_offs, prev_rows = raw, offs, got
+    db.close()

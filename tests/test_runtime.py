@@ -308,6 +308,44 @@ def test_reactive_raw_short_circuit_lifecycle():
     e.dispose()
 
 
+def test_changed_query_reuses_unchanged_row_objects():
+    """r5 row-granular unpack in the live worker: after a one-row
+    mutation of a multi-row subscribed query, the re-executed rows must
+    (a) be correct, (b) REUSE the previous dict objects for every
+    unchanged row (identity stability feeds both the differ's `is`
+    shortcut and subscribers' referential equality), and (c) emit
+    exactly one replace patch."""
+    from evolu_tpu.storage.native import native_available
+
+    if not native_available():
+        pytest.skip("native backend unavailable (raw path is native-only)")
+    import evolu_tpu.runtime.messages as m
+
+    e = create_evolu(TODO_SCHEMA)
+    ids = []
+    with e.batching():
+        for i in range(50):
+            ids.append(e.create("todo", {"title": f"item {i:03d}", "isCompleted": 0}))
+    e.worker.flush()
+    q = table("todo").select("id", "title", "isCompleted").order_by("title").serialize()
+    e.subscribe_query(q, lambda: None)
+    e.worker.flush()
+    before = e.worker.queries_rows_cache[q]
+    assert len(before) == 50
+
+    # In-place flag toggle on one mid-result row (sort key unchanged).
+    e.update("todo", ids[25], {"isCompleted": 1})
+    e.worker.flush()
+    e.worker.post(m.Query((q,)))
+    e.worker.flush()
+    after = e.worker.queries_rows_cache[q]
+    assert [r["isCompleted"] for r in after].count(1) == 1
+    # updatedAt also changes for the mutated row; every OTHER row must
+    # be the SAME object as before.
+    reused = sum(1 for a, b in zip(after, before) if a is b)
+    assert reused == 49, reused
+
+
 def test_byte_equality_is_exact_because_nan_cannot_be_stored():
     """Why raw-byte change detection is EXACT, not approximate: the
     one value where byte-equality and deep-equality could diverge is
