@@ -7,7 +7,10 @@ Measures a 10k-row subscribed query's per-cycle cost in three shapes:
   unchanged  — r4 production steady state: packed raw read + byte
                compare, no dict materialization, no diff
   changed    — r4 production when the result set changed: packed raw
-               read + unpack + rfc6902 diff
+               read + FULL unpack + rfc6902 diff
+  changed_1row_granular — r5 production: packed read with offsets +
+               row-aligned partial unpack (unchanged rows reuse prev
+               dicts) + identity-shortcut diff
 
 Prints one JSON line; conclusions live in docs/BENCHMARKS.md.
 """

@@ -17,6 +17,7 @@ millis < 2**48 for any representable date (year 9999 ≈ 2**47.8), so
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -63,21 +64,61 @@ def _hex_nibble(x, upper: bool):
     return jnp.where(x < 10, x + _ZERO, x + (_UPPER_A if upper else _LOWER_A))
 
 
+def _millis_clock_parts(millis):
+    """millis → (ms uint32, seconds-of-day uint32, days int32).
+
+    ONE batch-level `lax.cond` picks the all-uint32 divmod chain —
+    exact for 0 ≤ millis < 1000·2³² (through March 2109): two u32
+    hi/lo divmods replace four EMULATED 64-bit divisions, measured
+    **1.06 ms off the 1M merge pipeline on v5e** (the render was 1.18
+    of the 1.29 ms hash stage, r5 ablation) — or the exact int64 path
+    for out-of-range batches (pre-1970 / far-future). Bit-identical
+    either way (property-pinned incl. the boundary)."""
+    millis = jnp.asarray(millis, jnp.int64)
+
+    def fast(m):
+        mu = m.astype(jnp.uint64)
+        hi = (mu >> jnp.uint64(32)).astype(jnp.uint32)  # < 1000 in range
+        lo = mu.astype(jnp.uint32)
+        # millis = hi·2³² + lo; 2³² = 4294967·1000 + 296, so
+        # millis ≡ hi·296 + lo (mod 1000) and
+        # millis//1000 = hi·4294967 + (hi·296 + lo)//1000 — all u32.
+        lo_q = lo // jnp.uint32(1000)
+        lo_r = lo - lo_q * jnp.uint32(1000)
+        t = hi * jnp.uint32(296) + lo_r
+        ms = t % jnp.uint32(1000)
+        secs = hi * jnp.uint32(4294967) + lo_q + t // jnp.uint32(1000)
+        days = secs // jnp.uint32(86400)
+        sod = secs - days * jnp.uint32(86400)
+        return ms, sod, days.astype(jnp.int32)
+
+    def slow(m):
+        ms = (m % 1000).astype(jnp.uint32)
+        secs = m // 1000
+        days = (secs // 86400).astype(jnp.int32)
+        sod = (secs % 86400).astype(jnp.uint32)
+        return ms, sod, days
+
+    if millis.shape[0] == 0:
+        return slow(millis)
+    in_range = (jnp.min(millis) >= 0) & (
+        jnp.max(millis) < (jnp.int64(1000) << jnp.int64(32))
+    )
+    return jax.lax.cond(in_range, fast, slow, millis)
+
+
 def _timestamp_bytes_u32(millis, counter, node):
     """The 46 canonical-string bytes as a list of 46 uint32 arrays
     (`YYYY-MM-DDTHH:mm:ss.sssZ-CCCC-n*16`, timestamp.ts:43-48).
 
-    Only two int64 divmods touch the raw millis; everything after is
-    uint32 so XLA keeps the whole computation in one fused elementwise
-    pass (no 64-bit emulation in the digit/hex extraction).
+    Only the initial millis divmods touch 64-bit (u32 fast path under
+    a range cond — `_millis_clock_parts`); everything after is uint32
+    so XLA keeps the whole computation in one fused elementwise pass
+    (no 64-bit emulation in the digit/hex extraction).
     """
-    millis = jnp.asarray(millis, jnp.int64)
     counter = jnp.asarray(counter, jnp.int32)
     node = jnp.asarray(node, jnp.uint64)
-    ms = (millis % 1000).astype(jnp.uint32)
-    secs = millis // 1000
-    days = (secs // 86400).astype(jnp.int32)
-    sod = (secs % 86400).astype(jnp.uint32)
+    ms, sod, days = _millis_clock_parts(millis)
     hh, mm, ss = sod // 3600, (sod // 60) % 60, sod % 60
     y, mo, d = _civil_from_days(days)
     y, mo, d = y.astype(jnp.uint32), mo.astype(jnp.uint32), d.astype(jnp.uint32)

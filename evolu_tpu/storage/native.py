@@ -153,7 +153,9 @@ def _parse_packed_row(raw: bytes, cols, pos: int):
     return dict(zip(cols, vals)), pos
 
 
-def unpack_packed_rows(raw: bytes, start: int = None, end: int = None) -> List[dict]:
+def unpack_packed_rows(
+    raw: bytes, start: Optional[int] = None, end: Optional[int] = None
+) -> List[dict]:
     """`eh_exec_packed` buffer → list of row dicts (the
     `exec_sql_query` contract). Layout documented at the C function.
     `start`/`end` optionally bound the ROW region (byte offsets from

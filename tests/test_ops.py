@@ -573,3 +573,38 @@ def test_flags_kernel_matches_payload_kernel():
             for j in range(5):
                 assert np.array_equal(np.asarray(old[j]), np.asarray(new[j])), (trial, j)
             assert np.array_equal(np.asarray(old[5][0]), np.asarray(new[5][0])), trial
+
+
+def test_millis_u32_fast_path_matches_i64_at_boundaries():
+    """The r5 u32 divmod chain in the hash render must be bit-identical
+    to the exact int64 path across its `lax.cond` boundary: in-range
+    batches (fast path), pre-1970 and post-2109 batches (exact path),
+    and batches STRADDLING the boundary (whole batch exact)."""
+    import jax.numpy as jnp
+
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_hash
+    from evolu_tpu.ops.encode import timestamp_hashes, u64_to_node_hex
+
+    bound = 1000 << 32  # first out-of-fast-range milli (March 2109)
+    shapes = {
+        "in_range": np.array([0, 999, 1000, 86_400_000 - 1, 1_700_000_000_000,
+                              bound - 1], np.int64),
+        "far_future": np.array([bound, bound + 12345, 250_000_000_000_000], np.int64),
+        "pre_epoch": np.array([-1, -86_400_000, -62_135_596_800_000 + 86_400_000], np.int64),
+        "straddling": np.array([0, bound - 1, bound, 1_700_000_000_000], np.int64),
+    }
+    with jax.enable_x64(True):
+        for name, millis in shapes.items():
+            n = len(millis)
+            counter = np.arange(n, dtype=np.int32) * 7 % 65536
+            node = (np.arange(n, dtype=np.uint64) * 0x9E3779B97F4A7C15 | 1)
+            got = np.asarray(timestamp_hashes(
+                jnp.asarray(millis), jnp.asarray(counter.astype(np.int32)),
+                jnp.asarray(node),
+            ))
+            for i in range(n):
+                want = timestamp_to_hash(
+                    Timestamp(int(millis[i]), int(counter[i]),
+                              u64_to_node_hex(int(node[i])))
+                ) & 0xFFFFFFFF
+                assert int(got[i]) == want, (name, i, int(millis[i]))
