@@ -64,30 +64,58 @@ def _hex_nibble(x, upper: bool):
     return jnp.where(x < 10, x + _ZERO, x + (_UPPER_A if upper else _LOWER_A))
 
 
+# First millis value the u32 fast paths cannot represent: seconds no
+# longer fit uint32 (the classic 2106-02-07 rollover).
+U32_MILLIS_BOUND = 1000 << 32
+
+
+def u32_divmod_hi_lo(m_i64, divisor: int):
+    """Floor-divmod of millis = hi·2³² + lo by a compile-time constant,
+    entirely in uint32 — the 64-bit divide is EMULATED on the 32-bit
+    v5e VPU and was the dominant cost of the hash render (r5 ablation:
+    1.06 ms/1M for four of them). With q32, r32 = divmod(2³², divisor):
+    m ≡ hi·r32 + lo (mod divisor) and
+    m // divisor = hi·q32 + (hi·r32 + lo) // divisor.
+    Exact for 0 ≤ m < U32_MILLIS_BOUND and divisor ≤ 86400·1000 (the
+    intermediates then fit u32: hi < 1000). ONE copy of this
+    overflow-sensitive chain, shared by the hash render and the minute
+    stage. → (quotient u32, remainder u32)."""
+    q32, r32 = divmod(1 << 32, divisor)
+    mu = m_i64.astype(jnp.uint64)
+    hi = (mu >> jnp.uint64(32)).astype(jnp.uint32)  # < 1000 in range
+    lo = mu.astype(jnp.uint32)
+    lo_q = lo // jnp.uint32(divisor)
+    lo_r = lo - lo_q * jnp.uint32(divisor)
+    t = hi * jnp.uint32(r32) + lo_r
+    return hi * jnp.uint32(q32) + lo_q + t // jnp.uint32(divisor), t % jnp.uint32(divisor)
+
+
+def millis_range_cond(millis, fast, slow):
+    """Batch-level `lax.cond` routing between a u32 fast branch (exact
+    for 0 ≤ millis < U32_MILLIS_BOUND) and the exact int64 branch —
+    ONE copy of the guard shared by `_millis_clock_parts` and
+    `merkle_ops.js_minutes`. Non-1-D or empty inputs take the exact
+    branch unconditionally (scalars have no batch to reduce over)."""
+    millis = jnp.asarray(millis, jnp.int64)
+    if millis.ndim != 1 or millis.shape[0] == 0:
+        return slow(millis)
+    in_range = (jnp.min(millis) >= 0) & (
+        jnp.max(millis) < jnp.int64(U32_MILLIS_BOUND)
+    )
+    return jax.lax.cond(in_range, fast, slow, millis)
+
+
 def _millis_clock_parts(millis):
     """millis → (ms uint32, seconds-of-day uint32, days int32).
 
-    ONE batch-level `lax.cond` picks the all-uint32 divmod chain —
-    exact for 0 ≤ millis < 1000·2³² (through March 2109): two u32
-    hi/lo divmods replace four EMULATED 64-bit divisions, measured
-    **1.06 ms off the 1M merge pipeline on v5e** (the render was 1.18
-    of the 1.29 ms hash stage, r5 ablation) — or the exact int64 path
-    for out-of-range batches (pre-1970 / far-future). Bit-identical
-    either way (property-pinned incl. the boundary)."""
-    millis = jnp.asarray(millis, jnp.int64)
+    The u32 hi/lo divmod chain replaces four EMULATED 64-bit divisions
+    (measured **1.06 ms off the 1M merge pipeline on v5e**; the render
+    was 1.18 of the 1.29 ms hash stage, r5 ablation); out-of-range
+    batches (pre-1970 / beyond 2106-02-07) keep the exact int64 path.
+    Bit-identical either way (property-pinned incl. the boundary)."""
 
     def fast(m):
-        mu = m.astype(jnp.uint64)
-        hi = (mu >> jnp.uint64(32)).astype(jnp.uint32)  # < 1000 in range
-        lo = mu.astype(jnp.uint32)
-        # millis = hi·2³² + lo; 2³² = 4294967·1000 + 296, so
-        # millis ≡ hi·296 + lo (mod 1000) and
-        # millis//1000 = hi·4294967 + (hi·296 + lo)//1000 — all u32.
-        lo_q = lo // jnp.uint32(1000)
-        lo_r = lo - lo_q * jnp.uint32(1000)
-        t = hi * jnp.uint32(296) + lo_r
-        ms = t % jnp.uint32(1000)
-        secs = hi * jnp.uint32(4294967) + lo_q + t // jnp.uint32(1000)
+        secs, ms = u32_divmod_hi_lo(m, 1000)
         days = secs // jnp.uint32(86400)
         sod = secs - days * jnp.uint32(86400)
         return ms, sod, days.astype(jnp.int32)
@@ -99,12 +127,7 @@ def _millis_clock_parts(millis):
         sod = (secs % 86400).astype(jnp.uint32)
         return ms, sod, days
 
-    if millis.shape[0] == 0:
-        return slow(millis)
-    in_range = (jnp.min(millis) >= 0) & (
-        jnp.max(millis) < (jnp.int64(1000) << jnp.int64(32))
-    )
-    return jax.lax.cond(in_range, fast, slow, millis)
+    return millis_range_cond(millis, fast, slow)
 
 
 def _timestamp_bytes_u32(millis, counter, node):

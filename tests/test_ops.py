@@ -578,14 +578,16 @@ def test_flags_kernel_matches_payload_kernel():
 def test_millis_u32_fast_path_matches_i64_at_boundaries():
     """The r5 u32 divmod chain in the hash render must be bit-identical
     to the exact int64 path across its `lax.cond` boundary: in-range
-    batches (fast path), pre-1970 and post-2109 batches (exact path),
+    batches (fast path), pre-1970 and post-2106 batches (exact path),
     and batches STRADDLING the boundary (whole batch exact)."""
     import jax.numpy as jnp
 
     from evolu_tpu.core.timestamp import Timestamp, timestamp_to_hash
     from evolu_tpu.ops.encode import timestamp_hashes, u64_to_node_hex
 
-    bound = 1000 << 32  # first out-of-fast-range milli (March 2109)
+    from evolu_tpu.ops.merkle_ops import js_minutes
+
+    bound = 1000 << 32  # first out-of-fast-range milli (2106-02-07)
     shapes = {
         "in_range": np.array([0, 999, 1000, 86_400_000 - 1, 1_700_000_000_000,
                               bound - 1], np.int64),
@@ -602,6 +604,12 @@ def test_millis_u32_fast_path_matches_i64_at_boundaries():
                 jnp.asarray(millis), jnp.asarray(counter.astype(np.int32)),
                 jnp.asarray(node),
             ))
+            # The minute stage shares the u32 divmod chain — pin it at
+            # the same boundaries against the exact i64 division.
+            got_min = np.asarray(jax.jit(js_minutes)(jnp.asarray(millis)))
+            assert np.array_equal(
+                got_min, (millis // 60000).astype(np.int32)
+            ), name
             for i in range(n):
                 want = timestamp_to_hash(
                     Timestamp(int(millis[i]), int(counter[i]),
