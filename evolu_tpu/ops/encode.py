@@ -76,11 +76,17 @@ def u32_divmod_hi_lo(m_i64, divisor: int):
     1.06 ms/1M for four of them). With q32, r32 = divmod(2³², divisor):
     m ≡ hi·r32 + lo (mod divisor) and
     m // divisor = hi·q32 + (hi·r32 + lo) // divisor.
-    Exact for 0 ≤ m < U32_MILLIS_BOUND and divisor ≤ 86400·1000 (the
-    intermediates then fit u32: hi < 1000). ONE copy of this
-    overflow-sensitive chain, shared by the hash render and the minute
-    stage. → (quotient u32, remainder u32)."""
+    Exact for 0 ≤ m < U32_MILLIS_BOUND (hi ≤ 999) PROVIDED the
+    intermediate t = hi·r32 + (divisor-1) fits u32 — asserted below at
+    trace time, since it depends on the divisor's REMAINDER, not its
+    size (86_400_000 would overflow: r32 = 61_367_296). ONE copy of
+    this overflow-sensitive chain, shared by the hash render and the
+    minute stage. → (quotient u32, remainder u32)."""
     q32, r32 = divmod(1 << 32, divisor)
+    assert 999 * r32 + (divisor - 1) < (1 << 32), (
+        f"u32_divmod_hi_lo: divisor {divisor} overflows the u32 chain "
+        f"(999*{r32} + {divisor - 1} >= 2**32)"
+    )
     mu = m_i64.astype(jnp.uint64)
     hi = (mu >> jnp.uint64(32)).astype(jnp.uint32)  # < 1000 in range
     lo = mu.astype(jnp.uint32)

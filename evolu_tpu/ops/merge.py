@@ -268,6 +268,42 @@ def plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras=(), return_winn
     return xor_sorted, upsert_sorted, i_s, s1, s2, extras_sorted
 
 
+def winner_flags(k1, k2, ex_k1, ex_k2):
+    """Per-row stored-winner relation bits, computed elementwise BEFORE
+    the sort: a = e >lex s, b = e ==lex s. ONE copy shared by
+    `plan_merge_sorted_flags` and the packed-owner shard kernel."""
+    a = (ex_k1 > k1) | ((ex_k1 == k1) & (ex_k2 > k2))
+    b = (ex_k1 == k1) & (ex_k2 == k2)
+    return a, b
+
+
+def masks_from_sorted_flags(grp, s1, s2, a_s, b_s, real):
+    """The post-sort planner tail shared by `plan_merge_sorted_flags`
+    and the packed-owner shard kernel (`parallel.reconcile`): segment
+    boundaries from the sorted GROUP key (the sort-key bits above the
+    idx/flag fields — cell, or owner|cell), the two segmented max
+    scans, and the flag-bit xor/upsert algebra — ONE copy of the
+    correctness-critical mask logic, so the two kernels can never
+    drift. → (xor_sorted, upsert_sorted), both already masked by
+    `real`."""
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), grp[1:] != grp[:-1]])
+    m1, m2 = _segmented_max_scan(seg_start, s1, s2)
+    zero = jnp.zeros((), jnp.uint64)
+    p1 = jnp.where(seg_start, zero, jnp.roll(m1, 1))
+    p2 = jnp.where(seg_start, zero, jnp.roll(m2, 1))
+    p_eq_s = (p1 == s1) & (p2 == s2)
+    p_gt_s = (p1 > s1) | ((p1 == s1) & (p2 > s2))
+    # lex_max(p, e) == s ⟺ (p==s ∨ e==s) ∧ p≤s ∧ e≤s; xor is its negation.
+    xor_sorted = ~((p_eq_s | b_s) & ~p_gt_s & ~a_s)
+    seg_end = jnp.concatenate([seg_start[1:], jnp.ones((1,), bool)])
+    t1, t2 = _segmented_max_scan(seg_end, m1, m2, reverse=True)
+    eligible = (s1 == t1) & (s2 == t2)
+    first_eligible = eligible & ~((p1 == t1) & (p2 == t2))
+    # beats (t >lex e) read only where s == t: there it is ¬(a ∨ b).
+    upsert_sorted = first_eligible & ~(a_s | b_s) & real
+    return xor_sorted & real, upsert_sorted
+
+
 def plan_merge_sorted_flags(cell_id, k1, k2, ex_k1, ex_k2, extras=()):
     """`plan_merge_sorted_core` with the stored-winner payloads REPLACED
     by two flag bits riding in the sort key (r5 kernel restructure).
@@ -302,8 +338,7 @@ def plan_merge_sorted_flags(cell_id, k1, k2, ex_k1, ex_k2, extras=()):
     if n > 1 << 24:
         return plan_merge_sorted_core(cell_id, k1, k2, ex_k1, ex_k2, extras)
     idx = jnp.arange(n, dtype=jnp.int32)
-    a = (ex_k1 > k1) | ((ex_k1 == k1) & (ex_k2 > k2))  # e >lex s
-    b = (ex_k1 == k1) & (ex_k2 == k2)                  # e ==lex s
+    a, b = winner_flags(k1, k2, ex_k1, ex_k2)
     key = (
         (cell_id.astype(jnp.int64) << jnp.int64(26))
         | (idx.astype(jnp.int64) << jnp.int64(2))
@@ -323,25 +358,9 @@ def plan_merge_sorted_flags(cell_id, k1, k2, ex_k1, ex_k2, extras=()):
     b_s = (key_s & jnp.int64(2)) != 0
     s1, s2 = sorted_ops[1:3]
     extras_sorted = sorted_ops[3:]
-
-    seg_start = jnp.concatenate([jnp.ones((1,), bool), c[1:] != c[:-1]])
-    m1, m2 = _segmented_max_scan(seg_start, s1, s2)
-    zero = jnp.zeros((), jnp.uint64)
-    p1 = jnp.where(seg_start, zero, jnp.roll(m1, 1))
-    p2 = jnp.where(seg_start, zero, jnp.roll(m2, 1))
-    p_eq_s = (p1 == s1) & (p2 == s2)
-    p_gt_s = (p1 > s1) | ((p1 == s1) & (p2 > s2))
-    # lex_max(p, e) == s ⟺ (p==s ∨ e==s) ∧ p≤s ∧ e≤s; xor is its negation.
-    xor_sorted = ~((p_eq_s | b_s) & ~p_gt_s & ~a_s)
-
-    seg_end = jnp.concatenate([seg_start[1:], jnp.ones((1,), bool)])
-    t1, t2 = _segmented_max_scan(seg_end, m1, m2, reverse=True)
-    eligible = (s1 == t1) & (s2 == t2)
-    first_eligible = eligible & ~((p1 == t1) & (p2 == t2))
-    real = c != _PAD_CELL
-    # beats (t >lex e) read only where s == t: there it is ¬(a ∨ b).
-    upsert_sorted = first_eligible & ~(a_s | b_s) & real
-    xor_sorted = xor_sorted & real
+    xor_sorted, upsert_sorted = masks_from_sorted_flags(
+        key_s >> jnp.int64(26), s1, s2, a_s, b_s, c != _PAD_CELL
+    )
     return xor_sorted, upsert_sorted, i_s, s1, s2, extras_sorted
 
 

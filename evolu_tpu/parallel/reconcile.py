@@ -33,10 +33,12 @@ from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
 from evolu_tpu.ops.merge import (
     _PAD_CELL,
+    masks_from_sorted_flags,
     messages_to_columns,
     plan_merge_sorted_flags,
     select_messages,
     unpermute_masks,
+    winner_flags,
 )
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.parallel.mesh import (
@@ -60,17 +62,84 @@ def xor_allreduce(x, axis_name: str = OWNERS_AXIS):
     return jax.lax.reduce(gathered, jnp.uint32(0), jnp.bitwise_xor, (0,))
 
 
+# Packed-owner sort key layout (r5): owner(12) | cell(25) | idx(24) |
+# flags(2) = 63 bits — the whole per-row identity rides the ONE i64
+# sort key, so the merge sort carries only the two u64 HLC keys as
+# payloads (the owner i32 payload measured ~0.28 ms/1M on v5e).
+# Owner value 4095 is the padding sentinel (sorts last), so real
+# owners must be < 4095 and cell ids < 2^25; `shard_kernel_for` routes
+# batches exceeding either bound to `_shard_kernel_wide` on HOST data.
+_OWNER_BITS, _CELL_BITS = 12, 25
+_PAD_OWNER = (1 << _OWNER_BITS) - 1
+
+
 def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
     """Per-shard reconcile: LWW plan + (owner, minute) XOR deltas +
     shard digest. All inputs are this shard's local (S,) slices.
 
-    The whole shard pipeline runs in cell-sorted order: the sorted HLC
-    keys give back the timestamp columns (millis = s1 >> 16, counter =
-    s1 & 0xFFFF, node = s2), only owner_ix rides as an extra payload,
-    hashing and the (owner, minute) segmented XOR consume the sorted
-    rows directly, and the two bool masks return to the host with
-    `i_s` for a vectorized numpy unpermute — no device restoring
-    sort."""
+    Packed-owner variant (the production and bench default): the sort
+    key is owner<<51 | cell<<26 | idx<<2 | eq<<1 | gt (stored-winner
+    flag bits as in `plan_merge_sorted_flags`), segments group by
+    (owner, cell) — identical segmentation to cell-grouping because
+    cell ids are unique per owner (global interning; every caller's
+    layout guarantees it). The sorted HLC keys give back the timestamp
+    columns, hashing and the (owner, minute) segmented XOR consume the
+    sorted rows directly, and the two bool masks return to the host
+    with `i_s` for a vectorized numpy unpermute — no device restoring
+    sort. Must be traced under enable_x64(True)."""
+    n = cell_id.shape[0]
+    if n > 1 << 24:  # idx no longer fits its 24 key bits
+        return _shard_kernel_wide(cell_id, k1, k2, ex_k1, ex_k2, owner_ix)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    a, b = winner_flags(k1, k2, ex_k1, ex_k2)
+    own = jnp.where(
+        cell_id == _PAD_CELL, jnp.int64(_PAD_OWNER), owner_ix.astype(jnp.int64)
+    )
+    key = (
+        (own << jnp.int64(_CELL_BITS + 26))
+        | ((cell_id.astype(jnp.int64) & jnp.int64((1 << _CELL_BITS) - 1))
+           << jnp.int64(26))
+        | (idx.astype(jnp.int64) << jnp.int64(2))
+        | (b.astype(jnp.int64) << jnp.int64(1))
+        | a.astype(jnp.int64)
+    )
+    if key.dtype != jnp.dtype("int64"):  # x64 disabled: would mis-plan
+        raise TypeError(
+            "_shard_kernel must be traced under enable_x64(True): "
+            f"packed key degraded to {key.dtype}"
+        )
+    key_s, s1, s2 = jax.lax.sort((key, k1, k2), num_keys=1, is_stable=False)
+    owner_s = (key_s >> jnp.int64(_CELL_BITS + 26)).astype(jnp.int32)
+    i_s = ((key_s >> jnp.int64(2)) & jnp.int64((1 << 24) - 1)).astype(jnp.int32)
+    a_s = (key_s & jnp.int64(1)) != 0
+    b_s = (key_s & jnp.int64(2)) != 0
+    real = owner_s != jnp.int32(_PAD_OWNER)
+    # Segment key = key bits above idx/flags = (owner, cell); the mask
+    # algebra is the ONE shared copy in ops.merge.
+    xor_s, upsert_s = masks_from_sorted_flags(
+        key_s >> jnp.int64(26), s1, s2, a_s, b_s, real
+    )
+
+    millis_s, counter_s = unpack_ts_keys(s1)
+    hashes = jnp.where(
+        xor_s, timestamp_hashes(millis_s, counter_s, s2), jnp.uint32(0)
+    )
+    owner_sorted, minute_sorted, seg_end_m, seg_xor, valid_sorted = owner_minute_segments(
+        owner_s, millis_s, hashes, xor_s
+    )
+    digest = xor_allreduce(jax.lax.reduce(hashes, jnp.uint32(0), jnp.bitwise_xor, (0,)))
+    return (
+        xor_s, upsert_s, i_s,
+        owner_sorted, minute_sorted, seg_end_m, seg_xor, valid_sorted, digest,
+    )
+
+
+def _shard_kernel_wide(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
+    """The wide-id fallback (cell ≥ 2^25 or owner ≥ 4095): owner rides
+    as an i32 sort payload and segmentation is by cell alone —
+    bit-identical masks/deltas/digest whenever the packed variant's
+    preconditions hold (parity-pinned), and the only variant that can
+    serve batches beyond them."""
     xor_s, upsert_s, i_s, s1, s2, (owner_s,) = plan_merge_sorted_flags(
         cell_id, k1, k2, ex_k1, ex_k2, extras=(owner_ix.astype(jnp.int32),)
     )
@@ -88,11 +157,25 @@ def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
     )
 
 
+def shard_kernel_for(cols: Dict[str, np.ndarray]):
+    """Static host-side routing between the packed-owner kernel and the
+    wide fallback: the packed key needs every real cell id < 2^25 and
+    every owner index < 4095 (the padding sentinel). `cols` are the
+    HOST numpy columns, so the choice is made before tracing — no
+    device cond, two separately compiled kernels."""
+    real = cols["cell_id"] != int(_PAD_CELL)
+    cell_max = int(cols["cell_id"].max(initial=0, where=real))
+    owner_max = int(cols["owner_ix"].max(initial=0))
+    if cell_max < (1 << _CELL_BITS) and owner_max < _PAD_OWNER:
+        return _shard_kernel
+    return _shard_kernel_wide
+
+
 @functools.lru_cache(maxsize=None)
-def _compiled_kernel(mesh: Mesh):
+def _compiled_kernel(mesh: Mesh, kernel=None):
     spec = P(OWNERS_AXIS)
     mapped = shard_map(
-        _shard_kernel,
+        kernel or _shard_kernel,
         mesh=mesh,
         in_specs=(spec,) * 6,
         out_specs=(spec,) * 8 + (P(),),
@@ -119,7 +202,7 @@ def reconcile_columns_sharded(mesh: Mesh, cols: Dict[str, np.ndarray]):
         put_sharded(cols[k], shd)
         for k in ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
     ]
-    return _compiled_kernel(mesh)(*args)
+    return _compiled_kernel(mesh, shard_kernel_for(cols))(*args)
 
 
 def build_owner_columns(

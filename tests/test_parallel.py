@@ -599,3 +599,90 @@ def test_reconcile_stream_bad_batch_lands_prior_batch():
         s.db.exec('SELECT COUNT(*) FROM "message"')[0][0] for s in store.shards
     )
     assert stored == 25
+
+
+def test_packed_owner_kernel_matches_wide_kernel():
+    """The r5 packed-owner shard kernel (owner in the sort key's top
+    bits, zero extra payloads) must produce BIT-identical outputs to
+    the wide fallback on owner-consistent inputs — ties, stored-winner
+    equal/greater flags, padding rows, multiple owners — and the
+    host router must pick the wide kernel when ids exceed the packed
+    bounds."""
+    import jax
+    import jax.numpy as jnp
+
+    from evolu_tpu.ops.merge import _PAD_CELL
+    from evolu_tpu.parallel.reconcile import (
+        _shard_kernel,
+        _shard_kernel_wide,
+        shard_kernel_for,
+    )
+
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    rng = np.random.default_rng(23)
+    N = 1024  # 8 shards × 128
+    mesh = create_mesh()
+
+    def mapped(kern):
+        spec = P("owners")
+        return jax.jit(shard_map(
+            kern, mesh=mesh, in_specs=(spec,) * 6,
+            out_specs=(spec,) * 8 + (P(),), check_vma=False,
+        ))
+
+    with jax.enable_x64(True):
+        packed = mapped(_shard_kernel)
+        wide = mapped(_shard_kernel_wide)
+        for trial in range(10):
+            n = int(rng.integers(8, N))
+            cells = int(rng.integers(1, n))
+            cell = np.full(N, int(_PAD_CELL), np.int32)
+            cell[:n] = rng.integers(0, cells, n)
+            owner_of_cell = rng.integers(0, 16, cells)  # owner = f(cell)
+            owner = np.zeros(N, np.int64)
+            owner[:n] = owner_of_cell[cell[:n]]
+            k1 = np.zeros(N, np.uint64); k2 = np.zeros(N, np.uint64)
+            k1[:n] = rng.integers(1, 9, n); k2[:n] = rng.integers(0, 5, n)
+            ex1 = np.zeros(N, np.uint64); ex2 = np.zeros(N, np.uint64)
+            ex1_c = rng.integers(0, 9, cells).astype(np.uint64)
+            ex2_c = rng.integers(0, 5, cells).astype(np.uint64)
+            ex1[:n] = ex1_c[cell[:n]]; ex2[:n] = ex2_c[cell[:n]]
+            args = tuple(map(jnp.asarray, (cell, k1, k2, ex1, ex2, owner)))
+            a = packed(*args)
+            b = wide(*args)
+            # Sort orders differ (owner-major vs cell-major): compare
+            # the masks in BATCH order via the shard-local permutation.
+            from evolu_tpu.ops.merge import unpermute_masks
+
+            block = N // mesh.devices.size
+            xa, ua = unpermute_masks(
+                np.asarray(a[0]), np.asarray(a[1]), np.asarray(a[2]),
+                block_size=block,
+            )
+            xb, ub = unpermute_masks(
+                np.asarray(b[0]), np.asarray(b[1]), np.asarray(b[2]),
+                block_size=block,
+            )
+            assert np.array_equal(xa, xb), (trial, "xor")
+            assert np.array_equal(ua, ub), (trial, "upsert")
+            assert int(a[8]) == int(b[8]), (trial, "digest")
+            # The (owner, minute) Merkle feed too — sorted orders
+            # differ, so compare the order-insensitive decode.
+            from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas
+
+            da = decode_owner_minute_deltas(*(np.asarray(o) for o in a[3:8]))
+            db_ = decode_owner_minute_deltas(*(np.asarray(o) for o in b[3:8]))
+            assert da == db_, (trial, "minute deltas")
+
+    # Router: in-bounds → packed; oversized cell ids or owners → wide.
+    small = {"cell_id": np.array([1, int(_PAD_CELL)], np.int32),
+             "owner_ix": np.array([3, 0], np.int64)}
+    assert shard_kernel_for(small) is _shard_kernel
+    big_cell = {"cell_id": np.array([1 << 25], np.int32),
+                "owner_ix": np.array([0], np.int64)}
+    assert shard_kernel_for(big_cell) is _shard_kernel_wide
+    big_owner = {"cell_id": np.array([1], np.int32),
+                 "owner_ix": np.array([4095], np.int64)}
+    assert shard_kernel_for(big_owner) is _shard_kernel_wide
