@@ -117,7 +117,7 @@ def make_loop(mesh, iters, kernel=None):
     `kernel` is injectable for exactly that perturbation test."""
     import jax.numpy as jnp
 
-    from jax import shard_map
+    from evolu_tpu.ops import shard_map
     from jax.sharding import PartitionSpec as P
 
     if kernel is None:

@@ -1,0 +1,305 @@
+"""Thread-safe metrics registry: counters, gauges, log-bucket histograms.
+
+The only runtime signal used to be the print-gated logger; this module
+gives every hot-path decision point (winner-cache hit/miss, host-oracle
+routing, packed-vs-object bounces, shard sizes, sync wire volume, relay
+latency) a numeric home that the relay can serve as Prometheus v0.0.4
+text exposition (`render_prometheus`) or a JSON snapshot (`snapshot`).
+
+Design constraints (the device-path invariant from the issue):
+- HOST-SIDE ONLY. This package must never import jax: instrumentation
+  records Python ints/floats the hot paths already hold. Nothing here
+  may force a device pull or insert ops into the fused jit pipeline —
+  mechanically enforced by tests/test_import_hygiene.py (no jax import
+  in `evolu_tpu.obs`) and tests/test_bench_liveness.py (bench checksum
+  and jit cache unchanged with metrics on).
+- O(1) and cheap per event: one lock + one dict update. A disabled
+  registry (`set_enabled(False)`) short-circuits before the lock so
+  the bench guard can prove zero interaction with the timed graph.
+- NO module-level jnp anything (trivially: no jax at all) — the
+  "breaks `jax.distributed.initialize`" invariant applies to this
+  package like any other.
+
+Histograms use FIXED log-spaced buckets chosen per family at first
+observe (defaults below) so exposition shape is batch-independent and
+two snapshots always subtract cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def log_buckets(lo: float, hi: float, ratio: float = 2.0) -> Tuple[float, ...]:
+    """Fixed log-spaced bucket upper bounds: lo, lo*ratio, ... >= hi."""
+    edges: List[float] = []
+    b = float(lo)
+    while b < hi:
+        edges.append(b)
+        b *= ratio
+    edges.append(b)
+    return tuple(edges)
+
+
+# Default bucket families (upper bounds; +Inf is implicit).
+# Durations in ms: 62.5us .. ~65.5s, x2.
+LATENCY_MS_BUCKETS = log_buckets(0.0625, 1 << 16)
+# Wire/byte sizes: 64B .. 64MB, x4 (the relay caps bodies at 20MB).
+SIZE_BUCKETS = log_buckets(64, 1 << 26, 4.0)
+# Row/message counts: 1 .. 16M, x4 (batches cap at 2^24 rows).
+COUNT_BUCKETS = log_buckets(1, 1 << 24, 4.0)
+
+_LabelItems = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, object]) -> _LabelItems:
+    return tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(items: _LabelItems, extra: str = "") -> str:
+    parts = [f'{k}="{_escape(v)}"' for k, v in items]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_num(v: float) -> str:
+    """Prometheus sample value / le bound: trim floats that are ints."""
+    f = float(v)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Hist:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)  # last = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+
+class MetricsRegistry:
+    """Counters, gauges, histograms keyed by (name, sorted labels).
+
+    The flat imperative API (`inc`/`set_gauge`/`observe`) keeps call
+    sites one line and the per-event cost one lock + one dict op —
+    families (help text, histogram buckets) register implicitly on
+    first use, or explicitly via `describe`.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.enabled = True
+        self._counters: Dict[str, Dict[_LabelItems, float]] = {}
+        self._gauges: Dict[str, Dict[_LabelItems, float]] = {}
+        self._hists: Dict[str, Dict[_LabelItems, _Hist]] = {}
+        self._buckets: Dict[str, Tuple[float, ...]] = {}
+        self._help: Dict[str, str] = {}
+
+    # -- write side (hot paths) --
+
+    def inc(self, name: str, value: float = 1, **labels) -> None:
+        if not self.enabled or value == 0:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            fam = self._counters.setdefault(name, {})
+            fam[key] = fam.get(key, 0) + value
+
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        if not self.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            self._gauges.setdefault(name, {})[key] = float(value)
+
+    def observe(
+        self, name: str, value: float,
+        buckets: Optional[Sequence[float]] = None, **labels,
+    ) -> None:
+        """Record into a histogram; `buckets` fixes the family's edges
+        on first observe (LATENCY_MS_BUCKETS otherwise) and is ignored
+        afterwards — exposition shape must not drift per call."""
+        if not self.enabled:
+            return
+        key = _label_key(labels)
+        with self._lock:
+            edges = self._buckets.get(name)
+            if edges is None:
+                edges = self._buckets[name] = tuple(
+                    buckets if buckets is not None else LATENCY_MS_BUCKETS
+                )
+            fam = self._hists.setdefault(name, {})
+            h = fam.get(key)
+            if h is None:
+                h = fam[key] = _Hist(len(edges))
+            i = _bisect(edges, value)
+            h.counts[i] += 1
+            h.sum += value
+            h.count += 1
+
+    def describe(self, name: str, help_: str) -> None:
+        with self._lock:
+            self._help[name] = help_
+
+    # -- read side --
+
+    def get_counter(self, name: str, **labels) -> float:
+        with self._lock:
+            return self._counters.get(name, {}).get(_label_key(labels), 0)
+
+    def get_gauge(self, name: str, **labels) -> Optional[float]:
+        with self._lock:
+            return self._gauges.get(name, {}).get(_label_key(labels))
+
+    def get_histogram(self, name: str, **labels):
+        """(bucket_edges, cumulative_counts_incl_inf, sum, count) or None."""
+        with self._lock:
+            h = self._hists.get(name, {}).get(_label_key(labels))
+            if h is None:
+                return None
+            edges = self._buckets[name]
+            cum, acc = [], 0
+            for c in h.counts:
+                acc += c
+                cum.append(acc)
+            return edges, cum, h.sum, h.count
+
+    def quantile(self, name: str, q: float, **labels) -> Optional[float]:
+        """Estimate the q-quantile (0..1) from a histogram's log-spaced
+        buckets by linear interpolation inside the bucket. The +Inf
+        bucket clamps to the top finite edge — an estimate, not exact."""
+        got = self.get_histogram(name, **labels)
+        if got is None:
+            return None
+        edges, cum, _s, count = got
+        if count == 0:
+            return None
+        target = q * count
+        lo_edge = 0.0
+        for i, hi_cum in enumerate(cum):
+            if hi_cum >= target:
+                if i >= len(edges):  # +Inf bucket
+                    return float(edges[-1])
+                lo_cum = cum[i - 1] if i else 0
+                width = hi_cum - lo_cum
+                frac = (target - lo_cum) / width if width else 1.0
+                return lo_edge + frac * (edges[i] - lo_edge)
+            if i < len(edges):
+                lo_edge = edges[i]
+        return float(edges[-1])
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+            # _buckets/_help persist: family shape is configuration,
+            # not data — a post-reset observe keeps identical buckets.
+
+    # -- exposition --
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition format version 0.0.4."""
+        with self._lock:
+            lines: List[str] = []
+            for name in sorted(self._counters):
+                self._head(lines, name, "counter")
+                for key, v in sorted(self._counters[name].items()):
+                    lines.append(f"{name}{_fmt_labels(key)} {_fmt_num(v)}")
+            for name in sorted(self._gauges):
+                self._head(lines, name, "gauge")
+                for key, v in sorted(self._gauges[name].items()):
+                    lines.append(f"{name}{_fmt_labels(key)} {_fmt_num(v)}")
+            for name in sorted(self._hists):
+                self._head(lines, name, "histogram")
+                edges = self._buckets[name]
+                for key, h in sorted(self._hists[name].items()):
+                    acc = 0
+                    for edge, c in zip(edges, h.counts):
+                        acc += c
+                        le = _fmt_labels(key, f'le="{_fmt_num(edge)}"')
+                        lines.append(f"{name}_bucket{le} {acc}")
+                    acc += h.counts[-1]
+                    le = _fmt_labels(key, 'le="+Inf"')
+                    lines.append(f"{name}_bucket{le} {acc}")
+                    lines.append(f"{name}_sum{_fmt_labels(key)} {_fmt_num(h.sum)}")
+                    lines.append(f"{name}_count{_fmt_labels(key)} {h.count}")
+            return "\n".join(lines) + ("\n" if lines else "")
+
+    def _head(self, lines: List[str], name: str, typ: str) -> None:
+        help_ = self._help.get(name)
+        if help_:
+            lines.append(f"# HELP {name} {_escape(help_)}")
+        lines.append(f"# TYPE {name} {typ}")
+
+    def snapshot(self) -> dict:
+        """JSON-ready snapshot of every metric (same data as the text
+        exposition, structured)."""
+        with self._lock:
+            out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+            for name, fam in self._counters.items():
+                out["counters"][name] = [
+                    {"labels": dict(k), "value": v} for k, v in sorted(fam.items())
+                ]
+            for name, fam in self._gauges.items():
+                out["gauges"][name] = [
+                    {"labels": dict(k), "value": v} for k, v in sorted(fam.items())
+                ]
+            for name, fam in self._hists.items():
+                edges = self._buckets[name]
+                out["histograms"][name] = [
+                    {
+                        "labels": dict(k),
+                        "buckets": list(edges),
+                        "counts": list(h.counts),
+                        "sum": h.sum,
+                        "count": h.count,
+                    }
+                    for k, h in sorted(fam.items())
+                ]
+            return out
+
+    def snapshot_json(self) -> str:
+        return json.dumps(self.snapshot())
+
+
+def _bisect(edges: Sequence[float], value: float) -> int:
+    """Index of the first bucket whose upper bound >= value (len(edges)
+    = the +Inf bucket). Buckets are short tuples (<= ~24): a linear
+    scan beats bisect's call overhead at this size."""
+    for i, e in enumerate(edges):
+        if value <= e:
+            return i
+    return len(edges)
+
+
+# Module-level default registry (the process's metric store — the relay
+# endpoint and the JSON snapshot both serve this instance).
+registry = MetricsRegistry()
+
+inc = registry.inc
+observe = registry.observe
+set_gauge = registry.set_gauge
+get_counter = registry.get_counter
+render_prometheus = registry.render_prometheus
+snapshot = registry.snapshot
+reset = registry.reset
+quantile = registry.quantile
+
+# Content-Type for the text exposition endpoint.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def set_enabled(flag: bool) -> None:
+    """Global instrumentation kill switch (bench guard / overhead
+    measurement). Disabled = every write is a single attribute check."""
+    registry.enabled = bool(flag)

@@ -22,6 +22,37 @@ import os
 
 import jax
 
+# jax-version compat: the x64 config context manager is spelled
+# `jax.enable_x64` on newer jax but still lives at
+# `jax.experimental.enable_x64` on the 0.4.x line this container
+# ships. Every kernel entry point (and the test suite) uses the
+# `jax.enable_x64` spelling; alias it once here — this package is the
+# first evolu_tpu import on every device-side path.
+if not hasattr(jax, "enable_x64"):
+    from jax.experimental import enable_x64 as _compat_enable_x64
+
+    jax.enable_x64 = _compat_enable_x64
+
+# Same story for shard_map: newer jax exports it at the top level and
+# names the replication-check kwarg `check_vma`; the 0.4.x line has it
+# under jax.experimental with the kwarg named `check_rep`. Callers
+# import THIS symbol and always write `check_vma=`.
+try:
+    from jax import shard_map as _jax_shard_map
+
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _jax_shard_map
+
+    _SHARD_MAP_CHECK_KW = "check_rep"
+
+
+def shard_map(f, **kwargs):
+    """Version-portable `jax.shard_map` (see the compat block above)."""
+    if "check_vma" in kwargs and _SHARD_MAP_CHECK_KW != "check_vma":
+        kwargs[_SHARD_MAP_CHECK_KW] = kwargs.pop("check_vma")
+    return _jax_shard_map(f, **kwargs)
+
 # Cold-start relief: kernels compile once per power-of-two bucket; a
 # persistent compilation cache makes that a per-machine (not
 # per-process) cost. Only set when the embedder hasn't configured one,

@@ -531,9 +531,12 @@ def _host_fallback(messages, existing_winners, n, with_deltas=False):
     persisting a non-canonical winner into a hot cell) is visible in
     the kernel logs. `with_deltas` keeps plan_batch_device_full's
     3-tuple contract (host fold with verbatim node case)."""
+    from evolu_tpu.obs import metrics
     from evolu_tpu.storage.apply import plan_batch
     from evolu_tpu.utils.log import log
 
+    metrics.inc("evolu_merge_host_fallbacks_total")
+    metrics.inc("evolu_merge_host_fallback_messages_total", n)
     log("kernel:merge", "non-canonical hex case: host-planner fallback", n=n)
     xor_mask, upserts = plan_batch(messages, existing_winners)
     if not with_deltas:

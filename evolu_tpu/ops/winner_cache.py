@@ -58,6 +58,7 @@ from evolu_tpu.ops.merge import (
     select_messages,
     unpermute_masks,
 )
+from evolu_tpu.obs import metrics
 from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas, owner_minute_segments
 from evolu_tpu.utils.log import span
 
@@ -175,6 +176,7 @@ class DeviceWinnerCache:
         if version != self._data_version:
             self._data_version = version
             if self._slots or self._free:
+                metrics.inc("evolu_winner_cache_foreign_write_drops_total")
                 self.reset()
 
     # -- slot management --
@@ -188,6 +190,8 @@ class DeviceWinnerCache:
                 self._w1 = _grow_kernel(self._w1, new_cap=new_cap)
                 self._w2 = _grow_kernel(self._w2, new_cap=new_cap)
             self.capacity = new_cap
+            metrics.inc("evolu_winner_cache_grows_total")
+            metrics.set_gauge("evolu_winner_cache_capacity_slots", new_cap)
 
     def _seed_new_cells(self, new_cells: List[Cell]) -> bool:
         """Assign slots to first-seen cells (reusing invalidated slots
@@ -207,7 +211,9 @@ class DeviceWinnerCache:
             # A stored non-canonical winner cannot live in the
             # numeric cache. Keep every cell of this batch
             # uncached; the caller falls back to the host planner.
+            metrics.inc("evolu_winner_cache_noncanonical_seeds_total")
             return False
+        metrics.inc("evolu_winner_cache_seeded_cells_total", n)
         reused = min(len(self._free), n)
         self._grow_to(self._next_slot + n - reused)
         idx = np.empty(n, np.int32)
@@ -227,12 +233,16 @@ class DeviceWinnerCache:
         return True
 
     def invalidate(self, cells) -> None:
+        dropped = 0
         for c in cells:
             slot = self._slots.pop(c, None)
             if slot is not None:
                 self._free.append(slot)
+                dropped += 1
+        metrics.inc("evolu_winner_cache_invalidated_cells_total", dropped)
 
     def reset(self) -> None:
+        metrics.inc("evolu_winner_cache_resets_total")
         self._slots.clear()
         self._free.clear()
         self._next_slot = 0
@@ -262,7 +272,10 @@ class DeviceWinnerCache:
         lives here. Updates the EWMA and mode, returns
         (mode, new_cells): "stream" = plan with SQLite-streamed winners
         (cache dropped on entry); "cached" = seed `new_cells` then plan
-        from HBM."""
+        from HBM. Every return routes through `_gate_result` (mode
+        gauge + streamed-cell counting); cached-mode hit/miss counting
+        lives in `_count_cached`, fired by the callers only after
+        seeding succeeds — see both docstrings."""
         if not self.adaptive and self._streaming:
             # The gate was disabled while streaming (tests / ops
             # pinning the static path): leave streaming mode so the
@@ -284,7 +297,7 @@ class DeviceWinnerCache:
             )
             self._ewma_suppressed = False
         if not self.adaptive:
-            return "cached", new_cells
+            return self._gate_result("cached", cells, new_cells)
         if self._streaming:
             # Bound the membership estimator: sustained churn (the
             # very workload streaming targets) would otherwise grow
@@ -295,14 +308,17 @@ class DeviceWinnerCache:
             else:
                 self._known.update(cells)
             if self._seed_ewma > self.seed_lo:
-                return "stream", new_cells
+                return self._gate_result("stream", cells, new_cells)
             # Churn subsided: warm the cache back up this batch
             # (known was _known while streaming; recompute vs slots,
             # and release the estimator — cached mode never reads
             # it, and a later burst rebuilds it from _slots).
             self._streaming = False
             self._known = set()
-            return "cached", [c for c in cells if c not in self._slots]
+            metrics.inc("evolu_winner_cache_mode_switches_total", to="cached")
+            return self._gate_result(
+                "cached", cells, [c for c in cells if c not in self._slots]
+            )
         if self._seed_ewma > self.seed_hi:
             # Seeding dominates: drop the cache (it stops being
             # maintained, so it must not survive) and stream until
@@ -311,8 +327,33 @@ class DeviceWinnerCache:
             self._known = set(self._slots)
             self._known.update(cells)
             self.reset()  # arms no EWMA skip: _streaming is set
-            return "stream", new_cells
-        return "cached", new_cells
+            metrics.inc("evolu_winner_cache_mode_switches_total", to="stream")
+            return self._gate_result("stream", cells, new_cells)
+        return self._gate_result("cached", cells, new_cells)
+
+    def _gate_result(self, mode, cells, new_cells):
+        """Record the gate's mode decision (gauge only). Cell counting
+        is DEFERRED to `_count_cached`/`_count_streamed`, fired by the
+        callers only once a route is committed — a batch that bounces
+        onward (non-canonical stored winner → host fallback or object
+        path, which may re-enter this gate) must not be counted twice
+        or on the wrong route."""
+        metrics.set_gauge("evolu_winner_cache_streaming", 1 if self._streaming else 0)
+        return mode, new_cells
+
+    @staticmethod
+    def _count_cached(cells, new_cells):
+        """Unique cells served from HBM slots (hits) vs seeded from
+        SQLite (misses) — counted at the point of no return on the
+        cached route (seeding succeeded, the HBM kernel will plan)."""
+        metrics.inc("evolu_winner_cache_hits_total", len(cells) - len(new_cells))
+        metrics.inc("evolu_winner_cache_misses_total", len(new_cells))
+
+    @staticmethod
+    def _count_streamed(cells):
+        """Unique cells planned with SQLite-streamed winners — counted
+        only once the streamed plan is actually produced."""
+        metrics.inc("evolu_winner_cache_streamed_cells_total", len(cells))
 
     @with_x64
     def plan_batch(self, messages: Sequence[CrdtMessage], existing_winners=None):
@@ -342,6 +383,7 @@ class DeviceWinnerCache:
                 )
             if new_cells and not self._seed_new_cells(new_cells):
                 return self._host_fallback(messages, cells)
+            self._count_cached(cells, new_cells)
 
             slot_of = np.fromiter(
                 (self._slots[c] for c in cells), np.int32, len(cells)
@@ -417,6 +459,7 @@ class DeviceWinnerCache:
                 )
             if new_cells and not self._seed_new_cells(new_cells):
                 return None  # non-canonical stored winner → object path
+            self._count_cached(cells, new_cells)
 
             slot_arr = np.zeros(len(pb.cells), np.int32)
             for i in touched_ids:
@@ -428,12 +471,17 @@ class DeviceWinnerCache:
 
     def _plan_packed_streamed(self, pb, cells, touched_ids, millis, counter, node):
         """Streaming-mode packed plan: winners from SQLite, no cache
-        state. None on a non-canonical stored winner (object path)."""
+        state. None on a non-canonical stored winner (object path —
+        where the re-entered gate counts the route actually taken, so
+        streamed cells count only on a produced plan)."""
         from evolu_tpu.ops.merge import plan_packed_streamed
 
-        return plan_packed_streamed(
+        plan = plan_packed_streamed(
             self._db, pb, millis, counter, node, cells, touched_ids
         )
+        if plan is not None:
+            self._count_streamed(cells)
+        return plan
 
     def _plan_streamed(self, messages, cells, cell_ids, millis, counter, node):
         """High-churn mode: winners streamed from SQLite per batch, no
@@ -455,6 +503,7 @@ class DeviceWinnerCache:
             # order / verbatim hashing), same as the cached route.
             return self._host_fallback(messages, cells)
         k1 = pack_ts_key_host(millis, counter)
+        self._count_streamed(cells)
         cols = (
             cell_ids, k1, node, ex1_u[cell_ids], ex2_u[cell_ids],
             millis, counter, node, True,
@@ -470,6 +519,7 @@ class DeviceWinnerCache:
         from evolu_tpu.ops.merge import _host_fallback
         from evolu_tpu.storage.apply import fetch_existing_winners
 
+        metrics.inc("evolu_winner_cache_host_fallbacks_total")
         self.invalidate(cells)
         existing = fetch_existing_winners(self._db, cells)
         return _host_fallback(messages, existing, len(messages), with_deltas=True)

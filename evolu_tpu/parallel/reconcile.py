@@ -26,9 +26,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from evolu_tpu.ops import shard_map
 
 from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.obs import metrics
 from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
 from evolu_tpu.ops.merge import (
@@ -167,7 +168,9 @@ def shard_kernel_for(cols: Dict[str, np.ndarray]):
     cell_max = int(cols["cell_id"].max(initial=0, where=real))
     owner_max = int(cols["owner_ix"].max(initial=0))
     if cell_max < (1 << _CELL_BITS) and owner_max < _PAD_OWNER:
+        metrics.inc("evolu_reconcile_kernel_total", variant="packed")
         return _shard_kernel
+    metrics.inc("evolu_reconcile_kernel_total", variant="wide")
     return _shard_kernel_wide
 
 
@@ -239,8 +242,15 @@ def build_owner_columns(
     owner_ix = {o: i for i, o in enumerate(owners)}
 
     shards = assign_owners_to_shards({o: len(owner_batches[o]) for o in owners}, n_shards)
-    shard_len = max((sum(len(owner_batches[o]) for o in s) for s in shards), default=0)
-    shard_size = bucket_size(max(shard_len, 1))
+    # Shard balance telemetry: the LPT assignment's per-shard row loads
+    # (host ints already in hand — arXiv:2004.00107's point that
+    # anti-entropy behavior is only debuggable with per-round telemetry
+    # applies doubly to a load imbalance that serializes the mesh).
+    loads = [sum(len(owner_batches[o]) for o in s) for s in shards]
+    for load in loads:
+        metrics.observe("evolu_reconcile_shard_rows", load,
+                        buckets=metrics.COUNT_BUCKETS)
+    shard_size = bucket_size(max(max(loads, default=0), 1))
 
     # Timestamp columns are NOT laid out: the kernels recover
     # millis/counter/node from the sorted HLC keys, so transferring
@@ -286,6 +296,10 @@ def reconcile_owner_batches(
         return {}, 0
     require_single_process("reconcile_owner_batches")
     n_msgs = sum(len(v) for v in owner_batches.values())
+    metrics.observe("evolu_reconcile_batch_rows", n_msgs,
+                    buckets=metrics.COUNT_BUCKETS)
+    metrics.observe("evolu_reconcile_batch_owners", len(owner_batches),
+                    buckets=metrics.COUNT_BUCKETS)
     with span("kernel:reconcile", "reconcile_owner_batches",
               owners=len(owner_batches), n=n_msgs):
         return _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners)
@@ -315,6 +329,7 @@ def _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners):
                 select_messages(messages, o_mask),
                 deltas_by_ix.get(o_ix, {}),
             )
+    metrics.inc("evolu_reconcile_host_owner_fallbacks_total", len(host_owners))
     for owner in host_owners:
         log("kernel:reconcile", "non-canonical hex case: host-planner fallback",
             owner=owner, n=len(owner_batches[owner]))

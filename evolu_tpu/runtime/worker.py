@@ -33,6 +33,7 @@ from evolu_tpu.core.timestamp import (
     timestamp_to_string,
 )
 from evolu_tpu.core.types import CrdtClock, CrdtMessage, Owner, SyncError
+from evolu_tpu.obs import flight, metrics
 from evolu_tpu.runtime import messages as msg
 from evolu_tpu.runtime.jsonpatch import create_patch
 from evolu_tpu.runtime.synclock import SyncLock, get_sync_lock
@@ -292,6 +293,7 @@ class DbWorker:
         self._staged_effects = []
         self._staged_cache: Dict[str, List[dict]] = {}
         self._staged_raw: Dict[str, tuple] = {}
+        metrics.inc("evolu_worker_commands_total", command=type(command).__name__)
         try:
             from contextlib import nullcontext
 
@@ -322,6 +324,12 @@ class DbWorker:
                 else:
                     raise ValueError(f"unknown command: {command!r}")
         except Exception as e:  # noqa: BLE001 - the Either-left channel
+            # The flight recorder's dump rides the exception across the
+            # worker boundary: OnError subscribers (and test failures)
+            # see the last N structured events, not a bare traceback.
+            flight.attach(e)
+            metrics.inc("evolu_worker_errors_total",
+                        command=type(command).__name__)
             if isinstance(command, (msg.Send, msg.Receive, msg.ResetOwner, msg.RestoreOwner)):
                 # A planner-touching command's transaction rolled back,
                 # but a stateful planner (the HBM winner cache) may have

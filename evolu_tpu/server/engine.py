@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from evolu_tpu.ops import shard_map
 
 from evolu_tpu.core.merkle import apply_prefix_xors, merkle_tree_to_string
 from evolu_tpu.ops import bucket_size, start_host_transfer, to_host_many, with_x64

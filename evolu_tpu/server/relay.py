@@ -6,6 +6,10 @@ pipeline (index.ts:204-216), same own-message exclusion
 (`timestamp NOT LIKE '%' || nodeId`, index.ts:100), same 20 MB body
 limit (index.ts:222), `GET /ping` health check (index.ts:250-252).
 The server is E2EE-blind: rows are (timestamp, userId, ciphertext).
+Observability extensions (no reference equivalent): `GET /metrics`
+(Prometheus v0.0.4 text from the process registry) and `GET /stats`
+(JSON: per-shard row counts + request counters + latency percentile
+estimates) — see docs/OBSERVABILITY.md.
 
 `add_messages` keeps the reference's per-row insert (it needs per-row
 rowcount for the changes==1 Merkle gate) but aggregates tree updates
@@ -19,8 +23,12 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
+
+from evolu_tpu.obs import flight, metrics
+from evolu_tpu.utils.log import log
 
 from evolu_tpu.core.merkle import (
     apply_prefix_xors,
@@ -219,6 +227,15 @@ class RelayStore:
     def user_ids(self) -> List[str]:
         return [r["userId"] for r in self.db.exec_sql_query('SELECT "userId" FROM "merkleTree"')]
 
+    def stats(self) -> List[dict]:
+        """Per-shard row counts for GET /stats (one-element list here;
+        ShardedRelayStore returns one entry per shard). Read from the
+        store itself, so in a MultiprocessRelay every worker reports
+        the same shared-file truth regardless of which worker answers."""
+        messages = self.db.exec_sql_query('SELECT COUNT(*) AS n FROM "message"')
+        users = self.db.exec_sql_query('SELECT COUNT(*) AS n FROM "merkleTree"')
+        return [{"index": 0, "messages": messages[0]["n"], "users": users[0]["n"]}]
+
     def close(self) -> None:
         self.db.close()
 
@@ -271,48 +288,138 @@ class ShardedRelayStore:
     def user_ids(self) -> List[str]:
         return [u for s in self.shards for u in s.user_ids()]
 
+    def stats(self) -> List[dict]:
+        return [
+            {**s.stats()[0], "index": i} for i, s in enumerate(self.shards)
+        ]
+
     def close(self) -> None:
         for s in self.shards:
             s.close()
 
 
+def relay_stats_payload(store) -> dict:
+    """The GET /stats JSON: store-derived row counts per shard (shared
+    truth in a MultiprocessRelay — every worker reads the same files)
+    plus this process's request counters from the metrics registry
+    (per-process by nature; a multiprocess deploy scrapes each worker's
+    /metrics or sums /stats over workers)."""
+    shards = store.stats() if hasattr(store, "stats") else []
+    for s in shards:
+        s["requests"] = metrics.get_counter(
+            "evolu_relay_shard_requests_total", shard=str(s["index"])
+        )
+    return {
+        "shards": shards,
+        "messages": sum(s["messages"] for s in shards),
+        "users": sum(s["users"] for s in shards),
+        "requests_total": metrics.get_counter(
+            "evolu_relay_requests_total", endpoint="/"
+        ),
+        "errors_total": metrics.get_counter("evolu_relay_errors_total"),
+        "latency_ms": {
+            "count": (metrics.registry.get_histogram("evolu_relay_request_ms") or
+                      (None, None, 0.0, 0))[3],
+            "p50": metrics.quantile("evolu_relay_request_ms", 0.50),
+            "p99": metrics.quantile("evolu_relay_request_ms", 0.99),
+        },
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     store: RelayStore  # injected by RelayServer
 
-    def log_message(self, *args) -> None:  # quiet by default, like config.log
-        pass
+    def log_message(self, format: str, *args) -> None:
+        # Target-gated like every other runtime signal (config.log):
+        # quiet by default, switchable via the `dev` target instead of
+        # unconditionally discarded. The is_enabled pre-check keeps the
+        # disabled-default path allocation-free (this fires per
+        # request); _flight=False because per-request access lines
+        # would evict the sparse events the flight ring is for.
+        from evolu_tpu.utils.log import logger
 
-    def do_GET(self) -> None:  # /ping (index.ts:250-252)
+        if logger.is_enabled("dev"):
+            log("dev", f"relay {self.address_string()} {format % args}",
+                _flight=False)
+
+    def _respond(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # /ping (index.ts:250-252) + observability
         if self.path == "/ping":
             body = b"ok"
             self.send_response(200)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path == "/metrics":
+            metrics.inc("evolu_relay_requests_total", endpoint="/metrics")
+            try:
+                body = metrics.render_prometheus().encode("utf-8")
+            except Exception as e:  # noqa: BLE001 - scraper gets a clean 500
+                metrics.inc("evolu_relay_errors_total")
+                self.send_error(500, str(e))
+                return
+            self._respond(200, body, metrics.PROMETHEUS_CONTENT_TYPE)
+        elif self.path == "/stats":
+            metrics.inc("evolu_relay_requests_total", endpoint="/stats")
+            try:
+                # store.stats() runs SQL: a shard closing mid-scrape
+                # must surface as an HTTP 500, not a dropped connection.
+                body = json.dumps(relay_stats_payload(self.store)).encode("utf-8")
+            except Exception as e:  # noqa: BLE001
+                metrics.inc("evolu_relay_errors_total")
+                self.send_error(500, str(e))
+                return
+            self._respond(200, body, "application/json")
         else:
             self.send_error(404)
 
     def do_POST(self) -> None:  # POST / (index.ts:224-248)
+        t0 = time.perf_counter()
+        # Count the request BEFORE any reject so errors_total can never
+        # exceed requests_total (error-rate = errors/requests must stay
+        # a fraction).
+        metrics.inc("evolu_relay_requests_total", endpoint="/")
         length = int(self.headers.get("Content-Length", 0))
         if length > MAX_BODY_BYTES:
+            metrics.inc("evolu_relay_errors_total")
             self.send_error(413)
             return
         body = self.rfile.read(length)
+        metrics.observe("evolu_relay_request_bytes", len(body),
+                        buckets=metrics.SIZE_BUCKETS)
         try:
             request = protocol.decode_sync_request(body)
+            shard = (
+                self.store.shard_index(request.user_id)
+                if hasattr(self.store, "shard_index") else 0
+            )
+            metrics.inc("evolu_relay_shard_requests_total", shard=str(shard))
             out = self.store.sync_wire(request) if hasattr(
                 self.store, "sync_wire"
             ) else None
             if out is None:
                 out = protocol.encode_sync_response(self.store.sync(request))
         except Exception as e:  # noqa: BLE001 - index.ts:231-233
+            # The flight dump rides the exception (server-side only —
+            # the wire response stays a bare 500, no event leakage).
+            flight.attach(e)
+            metrics.inc("evolu_relay_errors_total")
+            log("dev", "relay sync request failed", error=repr(e))
             self.send_error(500, str(e))
             return
-        self.send_response(200)
-        self.send_header("Content-Type", "application/octet-stream")
-        self.send_header("Content-Length", str(len(out)))
-        self.end_headers()
-        self.wfile.write(out)
+        finally:
+            metrics.observe(
+                "evolu_relay_request_ms", (time.perf_counter() - t0) * 1e3
+            )
+        metrics.observe("evolu_relay_response_bytes", len(out),
+                        buckets=metrics.SIZE_BUCKETS)
+        self._respond(200, out, "application/octet-stream")
 
 
 class _RelayHTTPServer(ThreadingHTTPServer):

@@ -49,9 +49,12 @@ def _upsert_sql(table: str, column: str) -> str:
     """Hostile table/column names from the wire must not splice SQL:
     identifiers are quote-doubled (same as the C++ layer)."""
     t, c = quote_ident(table), quote_ident(column)
+    # Explicit conflict target: targetless DO UPDATE needs SQLite >=
+    # 3.35, the "id" PK spelling works on every 3.24+ (this container
+    # runs 3.34). Same text in native/evolu_host.cpp::upsert_sql.
     return (
         f"INSERT INTO {t} (\"id\", {c}) VALUES (?, ?) "
-        f"ON CONFLICT DO UPDATE SET {c} = ?"
+        f"ON CONFLICT(\"id\") DO UPDATE SET {c} = ?"
     )
 
 
@@ -199,16 +202,23 @@ def _apply_in_txn(db, merkle_tree, messages, planner):
     runs the standard path, so behavior and error surfaces are
     identical either way (test-pinned)."""
     from evolu_tpu.core.packed import PackedReceive
+    from evolu_tpu.obs import metrics
 
     if isinstance(messages, PackedReceive):
         plan_packed = getattr(planner, "plan_packed", None)
         if plan_packed is not None and hasattr(db, "apply_planned_cells"):
             plan = plan_packed(messages)
             if plan is not None:
+                metrics.inc("evolu_apply_batches_total", route="packed")
                 _xor_mask, upsert_mask, deltas = plan
                 db.apply_planned_cells(messages, upsert_mask)
                 return apply_prefix_xors(merkle_tree, deltas)
+        # The packed batch bounced (non-canonical shape, small batch,
+        # hot-owner route, or a backend without the cell apply):
+        # materialize and take the object path below.
+        metrics.inc("evolu_apply_packed_bounces_total")
         messages = messages.to_messages()
+    metrics.inc("evolu_apply_batches_total", route="object")
     return _apply_messages_in_txn(db, merkle_tree, messages, planner)
 
 

@@ -22,6 +22,7 @@ from typing import Callable, Optional
 
 from evolu_tpu.core.timestamp import timestamp_from_string
 from evolu_tpu.core.types import CrdtMessage, UnknownError
+from evolu_tpu.obs import metrics
 from evolu_tpu.runtime.messages import OnError, SyncRequestInput
 from evolu_tpu.runtime.synclock import SyncLock
 from evolu_tpu.sync import protocol
@@ -263,6 +264,10 @@ class SyncTransport:
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))
             return None
+        metrics.inc("evolu_sync_requests_total")
+        metrics.inc("evolu_sync_request_messages_total", len(request.messages))
+        metrics.observe("evolu_sync_request_bytes", len(body),
+                        buckets=metrics.SIZE_BUCKETS)
         log("sync:request", url=self.config.sync_url,
             messages=len(request.messages), bytes=len(body))
         try:
@@ -271,12 +276,14 @@ class SyncTransport:
             # The server answered: that's a real error (4xx/5xx), not
             # offline — surface it so divergence isn't silent. The
             # transport is demonstrably UP, so clear any offline state.
+            metrics.inc("evolu_sync_http_errors_total")
             self._note_online()
             self.on_error(UnknownError(e))
             return None
         except (urllib.error.URLError, OSError):
             # Offline is not an error (sync.worker.ts:217-227) — but it
             # arms the reconnect probe.
+            metrics.inc("evolu_sync_offline_rounds_total")
             self._note_offline()
             return None
         self._note_online()
@@ -304,6 +311,10 @@ class SyncTransport:
                     response = protocol.decode_sync_response(response_bytes)
                     messages = decrypt_messages(response.messages, request.owner.mnemonic)
                     merkle_tree = response.merkle_tree
+            metrics.inc("evolu_sync_responses_total")
+            metrics.inc("evolu_sync_response_messages_total", len(messages))
+            metrics.observe("evolu_sync_response_bytes", len(response_bytes),
+                            buckets=metrics.SIZE_BUCKETS)
             log("sync:response", messages=len(messages), bytes=len(response_bytes))
             return (messages, merkle_tree, request.previous_diff)
         except Exception as e:  # noqa: BLE001

@@ -29,7 +29,13 @@ import struct
 import zlib
 from typing import List, Optional, Tuple
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+except ModuleNotFoundError:
+    # No `cryptography` wheel in this environment: the only primitive
+    # used here is AES-CFB128, served equally by OpenSSL libcrypto over
+    # ctypes (same ValueError size-check semantics — see _evp_cfb).
+    from evolu_tpu.sync._evp_cfb import Cipher, algorithms, modes
 
 SYM_AES256 = 9
 HASH_SHA256 = 8

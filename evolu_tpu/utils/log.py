@@ -45,6 +45,21 @@ class LogEvent:
     fields: Dict[str, object] = field(default_factory=dict)
 
 
+def _obs():
+    """Lazy (obs.flight, obs.metrics) pair — obs imports LogEvent from
+    this module, so the reverse edge must resolve at call time. Cached
+    after the first call; one tuple check per event afterwards."""
+    global _obs_pair
+    if _obs_pair is None:
+        from evolu_tpu.obs import flight, metrics
+
+        _obs_pair = (flight, metrics)
+    return _obs_pair
+
+
+_obs_pair = None
+
+
 class Logger:
     """Target-gated logger with a bounded event ring.
 
@@ -72,11 +87,27 @@ class Logger:
             return False
         return target in self._enabled
 
-    def log(self, target: str, message: str = "", **fields) -> None:
-        """log(target)(message) analog (log.ts:5-14): console + ring."""
-        if not self.is_enabled(target):
+    def log(self, target: str, message: str = "", *, _flight: bool = True,
+            **fields) -> None:
+        """log(target)(message) analog (log.ts:5-14): console + ring.
+        The flight recorder (obs.flight) mirrors the event even when the
+        target's console output is disabled — post-mortems need exactly
+        the events nobody was watching (host-fallback warnings, sync
+        rounds); the console gating stays ring/print-only. High-volume
+        chatter (per-request HTTP access lines) passes `_flight=False`
+        so it cannot evict the sparse events the bounded ring exists to
+        preserve. The event is built only if some consumer is active —
+        a fully-disabled call stays allocation-free."""
+        recorder = _obs()[0].recorder
+        flight_on = _flight and recorder.enabled
+        console_on = self.is_enabled(target)
+        if not (flight_on or console_on):
             return
         ev = LogEvent(target=target, message=message, t=time.time(), fields=fields)
+        if flight_on:
+            recorder.record_event(ev)
+        if not console_on:
+            return
         with self._lock:
             self._ring.append(ev)
         extra = (" " + " ".join(f"{k}={v}" for k, v in fields.items())) if fields else ""
@@ -93,13 +124,20 @@ class Logger:
             yield
         finally:
             ms = (time.perf_counter() - t0) * 1e3
+            ev = LogEvent(target=target, message=message, t=time.time(),
+                          duration_ms=ms, fields=fields)
             with self._lock:
                 cnt, tot, mx = self._durations.get(target, (0, 0.0, 0.0))
                 self._durations[target] = (cnt + 1, tot + ms, max(mx, ms))
-                self._ring.append(
-                    LogEvent(target=target, message=message, t=time.time(),
-                             duration_ms=ms, fields=fields)
-                )
+                self._ring.append(ev)
+            # Span aggregates feed observability: the duration lands in
+            # the per-target latency histogram (percentiles via
+            # `duration_summary` / the relay's /metrics) and the event
+            # in the flight ring. Host-side values only — the span
+            # wraps dispatch+pull, it never adds one.
+            flight, metrics = _obs()
+            metrics.observe("evolu_kernel_span_ms", ms, target=target)
+            flight.recorder.record_event(ev)
             if self.is_enabled(target):
                 extra = (" " + " ".join(f"{k}={v}" for k, v in fields.items())) if fields else ""
                 print(f"[{target}] {message} {ms:.3f}ms{extra}")
@@ -116,10 +154,48 @@ class Logger:
         with self._lock:
             return self._durations.get(target)
 
+    def duration_summary(
+        self, target: str, percentiles: Tuple[int, ...] = (50, 90, 99)
+    ) -> Optional[Dict[str, float]]:
+        """Mean/max/percentile summary for a span target, or None if it
+        never fired. count/mean/max come from the exact O(1) aggregates;
+        percentiles are estimated from the log-bucketed span histogram
+        (obs.metrics), so they carry bucket-resolution error. The
+        histogram is process-global, so percentiles are attached only
+        on the module singleton — a scoped Logger's aggregates would
+        otherwise be paired with percentiles that include every OTHER
+        logger's spans for the target (internally inconsistent)."""
+        with self._lock:
+            stats = self._durations.get(target)
+        if stats is None:
+            return None
+        cnt, tot, mx = stats
+        out: Dict[str, float] = {
+            "count": cnt, "total_ms": tot, "mean_ms": tot / cnt, "max_ms": mx,
+        }
+        if globals().get("logger") is self:
+            metrics = _obs()[1]
+            for p in percentiles:
+                q = metrics.quantile("evolu_kernel_span_ms", p / 100.0, target=target)
+                if q is not None:
+                    out[f"p{p}_ms"] = q
+        return out
+
     def clear(self) -> None:
+        """Reset the ring + duration aggregates. On the MODULE SINGLETON
+        (`logger`) this also resets the process metrics registry and
+        flight recorder — one call returns the whole observability
+        surface to a clean slate (test isolation). Scoped Logger
+        instances clear only their own state: an embedder emptying a
+        private ring must not zero the counters the relay is serving
+        at GET /metrics (Prometheus counters are monotonic)."""
         with self._lock:
             self._ring.clear()
             self._durations.clear()
+        if globals().get("logger") is self:
+            flight, metrics = _obs()
+            metrics.reset()
+            flight.recorder.clear()
 
 
 # Module-level default, mirroring the reference's module singleton. The

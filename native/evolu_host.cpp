@@ -151,8 +151,11 @@ std::string quote_ident(const char *name) {
 std::string upsert_sql(const char *table, const char *column) {
   // applyMessages.ts:92-103
   std::string t = quote_ident(table), c = quote_ident(column);
+  // Explicit conflict target: targetless DO UPDATE needs SQLite >=
+  // 3.35; ON CONFLICT("id") works on every 3.24+. Same text in
+  // storage/apply.py::_upsert_sql.
   return "INSERT INTO " + t + " (\"id\", " + c + ") VALUES (?, ?) "
-         "ON CONFLICT DO UPDATE SET " + c + " = ?";
+         "ON CONFLICT(\"id\") DO UPDATE SET " + c + " = ?";
 }
 
 constexpr const char *kSelectWinner =

@@ -79,6 +79,33 @@ def test_every_kernel_output_is_live_in_the_checksum(tiny_setup):
     )
 
 
+def test_metrics_do_not_touch_the_bench_graph(tiny_setup):
+    """Instrumentation is host-side by contract: flipping the metrics
+    registry on/off must leave the bench checksum bit-identical AND
+    cause zero additional jit compilations (a recompile would mean an
+    instrumentation value leaked into a traced graph as a constant, or
+    an op was inserted into the fused pipeline)."""
+    from evolu_tpu.obs import metrics
+
+    mesh, args = tiny_setup
+    loop = bench.make_loop(mesh, 1)
+    with jax.enable_x64(True):
+        metrics.set_enabled(False)
+        try:
+            base = int(loop(*args))
+            cache_size = loop._cache_size()
+            metrics.set_enabled(True)
+            with_metrics = int(loop(*args))
+            cache_size_after = loop._cache_size()
+        finally:
+            metrics.set_enabled(True)
+    assert with_metrics == base, "metrics changed the bench checksum"
+    assert cache_size_after == cache_size, (
+        "enabling metrics added jit cache misses (recompiles) to the "
+        "timed pipeline"
+    )
+
+
 def test_checksum_depends_on_the_data():
     """Same loop, different input data → different checksum (guards a
     degenerate fold that collapses to a constant)."""

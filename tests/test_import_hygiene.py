@@ -1,0 +1,75 @@
+"""Mechanical guard for the module-level-jnp-constant invariant.
+
+CLAUDE.md: a concrete jnp array created at import time initializes the
+XLA backend and breaks `jax.distributed.initialize` (the multi-host
+join must run before any backend touch). Until now the rule lived in
+comments; this test enforces it for EVERY `evolu_tpu` module — current
+and future (including the jax-free `obs/` package) — by importing each
+one in a subprocess whose jax backend is stubbed out: `JAX_PLATFORMS`
+names a platform that does not exist, so the import itself succeeds
+(jax import never touches a backend) but ANY import-time concrete
+array / device lookup raises. A module that imports cleanly there is
+proven backend-free at import.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import importlib, json, pkgutil
+import evolu_tpu
+
+names = sorted(
+    {"evolu_tpu"}
+    | {m.name for m in pkgutil.walk_packages(evolu_tpu.__path__, "evolu_tpu.")}
+)
+bad = {}
+for name in names:
+    try:
+        importlib.import_module(name)
+    except Exception as e:  # noqa: BLE001 - report every offender at once
+        bad[name] = f"{type(e).__name__}: {e}"
+print("RESULT:" + json.dumps(bad))
+"""
+
+
+def test_no_module_initializes_the_xla_backend_at_import():
+    env = dict(os.environ)
+    # A platform that cannot exist: backend init raises, import machinery
+    # does not. Strip the axon tunnel vars like conftest does.
+    env["JAX_PLATFORMS"] = "evolu_import_guard_no_such_platform"
+    for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+        env.pop(var, None)
+    env["PYTHONPATH"] = _REPO + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, f"guard subprocess died:\n{proc.stderr[-3000:]}"
+    line = [l for l in proc.stdout.splitlines() if l.startswith("RESULT:")][-1]
+    bad = json.loads(line[len("RESULT:"):])
+    assert bad == {}, (
+        "modules touch the XLA backend at import time (module-level jnp "
+        f"constant or device lookup — breaks jax.distributed.initialize): {bad}"
+    )
+
+
+def test_obs_package_never_imports_jax():
+    """The observability package records host-side Python values only;
+    the cheap mechanical proxy is that importing it (alone) must not
+    pull jax into the process at all."""
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; import evolu_tpu.obs; "
+         "print('JAX_LOADED' if 'jax' in sys.modules else 'CLEAN')"],
+        env={**os.environ, "PYTHONPATH": _REPO},
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "CLEAN" in proc.stdout, "evolu_tpu.obs transitively imported jax"

@@ -1,0 +1,432 @@
+"""Observability subsystem (evolu_tpu/obs) — registry semantics, the
+relay's /metrics + /stats endpoints against driven traffic (single
+process and MultiprocessRelay), winner-cache hit/miss counters under a
+scripted access pattern, host-fallback counter exactness on a
+non-canonical batch, sync wire counters, the flight recorder riding
+worker-boundary exceptions, and Logger integration (span histograms,
+duration_summary, one-call clear)."""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.obs import flight, metrics
+from evolu_tpu.server.relay import (
+    MultiprocessRelay,
+    RelayServer,
+    RelayStore,
+    ShardedRelayStore,
+)
+from evolu_tpu.sync import protocol
+from evolu_tpu.utils.log import logger
+
+BASE = 1_700_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    logger.clear()  # resets ring + durations + metrics registry + flight
+    yield
+    logger.configure(False)
+    logger.clear()
+
+
+# --- registry semantics ---
+
+
+def test_counter_gauge_histogram_roundtrip():
+    metrics.inc("t_total", 3, kind="a")
+    metrics.inc("t_total", kind="a")
+    metrics.inc("t_total", kind="b")
+    assert metrics.get_counter("t_total", kind="a") == 4
+    assert metrics.get_counter("t_total", kind="b") == 1
+    assert metrics.get_counter("t_total", kind="missing") == 0
+    metrics.set_gauge("t_gauge", 7.5)
+    assert metrics.registry.get_gauge("t_gauge") == 7.5
+    for v in (0.1, 1.0, 100.0):
+        metrics.observe("t_ms", v)
+    edges, cum, total, count = metrics.registry.get_histogram("t_ms")
+    assert count == 3 and total == pytest.approx(101.1)
+    assert cum[-1] == 3  # +Inf cumulative = count
+    assert all(b <= a for b, a in zip(cum, cum[1:]))  # monotone
+
+
+def test_histogram_quantile_estimates_within_buckets():
+    for _ in range(100):
+        metrics.observe("q_ms", 1.0)
+    q = metrics.quantile("q_ms", 0.5)
+    # 1.0 lands in the (0.5, 1.0] bucket of the x2 latency family.
+    assert 0.5 <= q <= 1.0
+
+
+def test_reset_keeps_bucket_shape():
+    metrics.observe("r_ms", 5.0, buckets=(1.0, 10.0))
+    metrics.reset()
+    metrics.observe("r_ms", 5.0)
+    edges, _, _, count = metrics.registry.get_histogram("r_ms")
+    assert edges == (1.0, 10.0) and count == 1
+
+
+def test_prometheus_exposition_is_valid_and_escaped():
+    metrics.inc("e_total", 2, path='we"ird\\x', note="a\nb")
+    metrics.observe("e_ms", 3.0)
+    text = metrics.render_prometheus()
+    line_re = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+;inf]+$'
+    )
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ", line)
+        else:
+            assert line_re.match(line), line
+    assert 'path="we\\"ird\\\\x"' in text
+    assert 'note="a\\nb"' in text
+    assert "e_ms_bucket" in text and 'le="+Inf"' in text
+    assert "e_ms_sum 3" in text and "e_ms_count 1" in text
+    # snapshot carries the same data, JSON-serializably
+    snap = json.loads(metrics.registry.snapshot_json())
+    assert snap["counters"]["e_total"][0]["value"] == 2
+
+
+def test_disabled_registry_records_nothing():
+    metrics.set_enabled(False)
+    try:
+        metrics.inc("d_total")
+        metrics.observe("d_ms", 1.0)
+    finally:
+        metrics.set_enabled(True)
+    assert metrics.get_counter("d_total") == 0
+    assert metrics.registry.get_histogram("d_ms") is None
+
+
+# --- Logger integration ---
+
+
+def test_span_feeds_histogram_and_duration_summary():
+    for _ in range(4):
+        with logger.span("kernel:merge", "unit"):
+            pass
+    summary = logger.duration_summary("kernel:merge")
+    assert summary["count"] == 4
+    assert summary["mean_ms"] == pytest.approx(summary["total_ms"] / 4)
+    assert summary["max_ms"] >= summary["mean_ms"]
+    assert "p50_ms" in summary and summary["p50_ms"] > 0
+    _, _, _, count = metrics.registry.get_histogram(
+        "evolu_kernel_span_ms", target="kernel:merge"
+    )
+    assert count == 4
+
+
+def test_logger_clear_resets_registry_and_flight():
+    metrics.inc("c_total")
+    flight.record("dev", "before clear")
+    logger.clear()
+    assert metrics.get_counter("c_total") == 0
+    assert flight.dump() == []
+
+
+def test_flight_records_disabled_log_targets():
+    """The recorder exists for events nobody was watching: a log() on a
+    console-disabled target must still land in the flight ring."""
+    logger.configure(False)
+    logger.log("sync:request", "invisible", n=1)
+    assert logger.recent_events() == []  # console ring stays gated
+    evs = flight.dump()
+    assert any(e.target == "sync:request" and e.message == "invisible" for e in evs)
+
+
+def test_flight_attach_is_idempotent_and_noted():
+    flight.record("dev", "breadcrumb", step=1)
+    e = ValueError("boom")
+    flight.attach(e)
+    first = e.flight_records
+    assert any(ev.message == "breadcrumb" for ev in first)
+    flight.record("dev", "later")
+    flight.attach(e)  # nested boundary: keeps the innermost dump
+    assert e.flight_records is first
+
+
+# --- winner-cache counters (scripted access pattern) ---
+
+
+def _cache_db():
+    from evolu_tpu.storage.native import open_database
+    from evolu_tpu.storage.schema import init_db_model
+
+    db = open_database(":memory:", "auto")
+    init_db_model(db, mnemonic=None)
+    db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title" BLOB)')
+    return db
+
+
+def _msg(i, row):
+    return CrdtMessage(
+        timestamp_to_string(Timestamp(BASE + i * 1000, 0, "a1b2c3d4e5f60718")),
+        "todo", row, "title", f"v{i}",
+    )
+
+
+def test_winner_cache_hit_miss_counters_match_scripted_pattern():
+    from evolu_tpu.ops.winner_cache import DeviceWinnerCache
+    from evolu_tpu.storage.apply import apply_messages
+
+    db = _cache_db()
+    cache = DeviceWinnerCache(db, adaptive=False)  # pin the cached path
+    tree = {}
+    try:
+        # Batch 1: 5 fresh cells -> 5 misses, 0 hits, 5 seeds.
+        batch1 = [_msg(i, f"r{i}") for i in range(5)]
+        tree = apply_messages(db, tree, batch1, planner=cache.plan_batch)
+        assert metrics.get_counter("evolu_winner_cache_misses_total") == 5
+        assert metrics.get_counter("evolu_winner_cache_hits_total") == 0
+        assert metrics.get_counter("evolu_winner_cache_seeded_cells_total") == 5
+        # Batch 2: the same 5 cells -> 5 hits, no new misses or seeds.
+        batch2 = [_msg(10 + i, f"r{i}") for i in range(5)]
+        tree = apply_messages(db, tree, batch2, planner=cache.plan_batch)
+        assert metrics.get_counter("evolu_winner_cache_hits_total") == 5
+        assert metrics.get_counter("evolu_winner_cache_misses_total") == 5
+        assert metrics.get_counter("evolu_winner_cache_seeded_cells_total") == 5
+        # Batch 3: 3 known + 2 fresh -> hits 5+3, misses 5+2.
+        batch3 = [_msg(20 + i, f"r{i}") for i in range(3)] + [
+            _msg(30 + i, f"new{i}") for i in range(2)
+        ]
+        tree = apply_messages(db, tree, batch3, planner=cache.plan_batch)
+        assert metrics.get_counter("evolu_winner_cache_hits_total") == 8
+        assert metrics.get_counter("evolu_winner_cache_misses_total") == 7
+        # Invalidation accounting.
+        cache.invalidate([("todo", "r0", "title"), ("todo", "absent", "title")])
+        assert metrics.get_counter("evolu_winner_cache_invalidated_cells_total") == 1
+    finally:
+        db.close()
+
+
+def test_host_fallback_counter_increments_exactly_on_noncanonical_batch():
+    from evolu_tpu.ops.merge import plan_batch_device
+
+    canonical = [_msg(0, "r0"), _msg(1, "r1")]
+    plan_batch_device(canonical, {})
+    assert metrics.get_counter("evolu_merge_host_fallbacks_total") == 0
+    bad = [
+        CrdtMessage("2023-09-01T10:00:00.000Z-0000-ABCDEF0123456789",
+                    "todo", "rw", "title", "U"),
+        _msg(2, "r2"),
+    ]
+    plan_batch_device(bad, {})
+    assert metrics.get_counter("evolu_merge_host_fallbacks_total") == 1
+    assert metrics.get_counter("evolu_merge_host_fallback_messages_total") == 2
+    plan_batch_device(canonical, {})
+    assert metrics.get_counter("evolu_merge_host_fallbacks_total") == 1
+
+
+# --- sync transport wire counters ---
+
+
+def test_sync_transport_counts_requests_and_bytes():
+    from evolu_tpu.core.types import Owner
+    from evolu_tpu.runtime.messages import SyncRequestInput
+    from evolu_tpu.sync.client import SyncTransport
+    from evolu_tpu.utils.config import Config
+
+    ts = timestamp_to_string(Timestamp(BASE, 0, "89e3b4f11a2c5d70"))
+    response = protocol.encode_sync_response(protocol.SyncResponse((), "{}"))
+    posted = []
+
+    def fake_post(url, body):
+        posted.append(len(body))
+        return response
+
+    t = SyncTransport(Config(), on_receive=lambda *a: None, http_post=fake_post)
+    try:
+        t.request_sync(SyncRequestInput((), ts, "{}", Owner("o", "m")))
+        t.flush()
+    finally:
+        t.stop()
+    assert metrics.get_counter("evolu_sync_requests_total") == 1
+    assert metrics.get_counter("evolu_sync_responses_total") == 1
+    _, _, byte_sum, count = metrics.registry.get_histogram("evolu_sync_request_bytes")
+    assert count == 1 and byte_sum == posted[0]
+    _, _, resp_sum, _ = metrics.registry.get_histogram("evolu_sync_response_bytes")
+    assert resp_sum == len(response)
+
+
+# --- worker boundary: flight dump rides OnError ---
+
+
+def test_worker_error_carries_flight_records():
+    from evolu_tpu.runtime.client import create_evolu
+
+    evolu = create_evolu({"todo": ("title",)})
+    try:
+        errors = []
+        evolu.subscribe_error(errors.append)
+        evolu.create("todo", {"title": "x"})  # leaves clock events in the ring
+        evolu.worker.flush()
+        evolu.worker.post(object())  # unknown command -> OnError(ValueError)
+        evolu.worker.flush()
+        assert errors, "unknown command must surface OnError"
+        err = errors[0].error if hasattr(errors[0], "error") else errors[0]
+        records = getattr(err, "flight_records", None)
+        assert isinstance(records, list) and records, (
+            "worker-boundary exceptions must carry the flight dump"
+        )
+        assert metrics.get_counter("evolu_worker_errors_total", command="object") == 1
+    finally:
+        evolu.dispose()
+
+
+# --- relay endpoints ---
+
+
+def _post(url, req):
+    body = protocol.encode_sync_request(req)
+    r = urllib.request.urlopen(
+        urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/octet-stream"}
+        ),
+        timeout=30,
+    )
+    return protocol.decode_sync_response(r.read())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.read().decode("utf-8")
+
+
+def _sync_req(user, node, n_msgs, start=0):
+    msgs = tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(Timestamp(BASE + (start + i) * 1000, 0, node)),
+            b"ct-%d" % (start + i),
+        )
+        for i in range(n_msgs)
+    )
+    return protocol.SyncRequest(msgs, user, node, "{}")
+
+
+def _parse_prometheus(text):
+    """name{labels} value -> {(name, frozenset(label items)): float}."""
+    out = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (.+)$", line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = frozenset(
+            tuple(kv.split("=", 1)) for kv in re.findall(r'[^,{]+="[^"]*"', m.group(3) or "")
+        )
+        out[(m.group(1), labels)] = float(m.group(4).replace("+Inf", "inf"))
+    return out
+
+
+def _counter_sum(parsed, name):
+    return sum(v for (n, _), v in parsed.items() if n == name)
+
+
+def test_relay_metrics_and_stats_agree_with_driven_traffic():
+    store = ShardedRelayStore(":memory:", shards=4)
+    server = RelayServer(store).start()
+    try:
+        users = [f"user-{i}" for i in range(6)]
+        for i, u in enumerate(users):
+            _post(server.url, _sync_req(u, f"{i:016x}", n_msgs=3, start=i * 10))
+        _post(server.url, _sync_req(users[0], "0" * 16, n_msgs=0))  # pull round
+
+        parsed = _parse_prometheus(_get(server.url + "/metrics"))
+        key = ("evolu_relay_requests_total", frozenset({("endpoint", '"/"')}))
+        assert parsed[key] == 7
+        # latency histogram: one observation per sync POST
+        assert _counter_sum(
+            {k: v for k, v in parsed.items() if k[0] == "evolu_relay_request_ms_count"},
+            "evolu_relay_request_ms_count",
+        ) == 7
+        # per-shard counters cover every request exactly once
+        shard_counts = {
+            k[1]: v for k, v in parsed.items()
+            if k[0] == "evolu_relay_shard_requests_total"
+        }
+        assert sum(shard_counts.values()) == 7
+        expected_shards = {store.shard_index(u) for u in users} | {
+            store.shard_index(users[0])
+        }
+        assert {
+            int(dict(k)["shard"].strip('"')) for k in shard_counts
+        } == expected_shards
+
+        stats = json.loads(_get(server.url + "/stats"))
+        assert stats["messages"] == 6 * 3  # every pushed row landed
+        assert stats["users"] == 6
+        assert stats["requests_total"] == 7
+        assert len(stats["shards"]) == 4
+        assert sum(s["messages"] for s in stats["shards"]) == 18
+        assert sum(s["requests"] for s in stats["shards"]) == 7
+        assert stats["latency_ms"]["count"] == 7
+        # /metrics and /stats must agree with each other too
+        assert _counter_sum(parsed, "evolu_relay_shard_requests_total") == (
+            stats["requests_total"]
+        )
+    finally:
+        server.stop()
+
+
+def test_relay_metrics_include_client_side_counters_in_process():
+    """The registry is process-global: a relay serving /metrics in the
+    same process as kernel work exposes winner-cache hit/miss and
+    host-fallback counts alongside its own — one scrape shows the whole
+    pipeline's decisions, all driven by REAL traffic here (cache plans
+    + a non-canonical batch + relay sync posts)."""
+    from evolu_tpu.ops.merge import plan_batch_device
+    from evolu_tpu.ops.winner_cache import DeviceWinnerCache
+    from evolu_tpu.storage.apply import apply_messages
+
+    db = _cache_db()
+    cache = DeviceWinnerCache(db, adaptive=False)
+    tree = apply_messages(
+        db, {}, [_msg(i, f"r{i}") for i in range(4)], planner=cache.plan_batch
+    )
+    apply_messages(
+        db, tree, [_msg(10 + i, f"r{i}") for i in range(4)],
+        planner=cache.plan_batch,
+    )
+    plan_batch_device(
+        [CrdtMessage("2023-09-01T10:00:00.000Z-0000-ABCDEF0123456789",
+                     "todo", "rw", "title", "U")], {},
+    )
+    server = RelayServer(RelayStore()).start()
+    try:
+        _post(server.url, _sync_req("u1", "a" * 16, n_msgs=2))
+        parsed = _parse_prometheus(_get(server.url + "/metrics"))
+        assert parsed[("evolu_winner_cache_hits_total", frozenset())] == 4
+        assert parsed[("evolu_winner_cache_misses_total", frozenset())] == 4
+        assert parsed[("evolu_merge_host_fallbacks_total", frozenset())] == 1
+        key = ("evolu_relay_requests_total", frozenset({("endpoint", '"/"')}))
+        assert parsed[key] == 1
+        assert parsed[("evolu_relay_request_ms_count", frozenset())] == 1
+    finally:
+        server.stop()
+        db.close()
+
+
+def test_multiprocess_relay_metrics_and_stats(tmp_path):
+    relay = MultiprocessRelay(
+        str(tmp_path / "relay.db"), workers=2, shards=4
+    ).start()
+    try:
+        for i in range(8):
+            _post(relay.url, _sync_req(f"mp-user-{i}", f"{i:016x}", n_msgs=2))
+        # /metrics: any worker's exposition must parse as valid text.
+        parsed = _parse_prometheus(_get(relay.url + "/metrics"))
+        assert any(k[0] == "evolu_relay_requests_total" for k in parsed) or parsed == {}
+        # /stats row counts come from the SHARED store: exact no matter
+        # which worker answers (request counters are per-process and
+        # are asserted only in the single-process test).
+        stats = json.loads(_get(relay.url + "/stats"))
+        assert stats["messages"] == 16
+        assert stats["users"] == 8
+        assert len(stats["shards"]) == 4
+    finally:
+        relay.stop()
