@@ -9,5 +9,8 @@ one device pass, sharded over the mesh (SURVEY.md §2.15).
 """
 
 from evolu_tpu.server.relay import RelayStore, RelayServer, serve
+from evolu_tpu.server.scheduler import SchedulerQueueFull, SyncScheduler
 
-__all__ = ["RelayStore", "RelayServer", "serve"]
+__all__ = [
+    "RelayStore", "RelayServer", "serve", "SyncScheduler", "SchedulerQueueFull",
+]

@@ -44,10 +44,24 @@ from evolu_tpu.parallel.mesh import (
     require_single_process,
     sharding,
 )
+from evolu_tpu.obs import metrics
 from evolu_tpu.parallel.reconcile import xor_allreduce
 from evolu_tpu.server.relay import RelayStore
 from evolu_tpu.utils.log import log, span
 from evolu_tpu.sync import protocol
+
+# Every compiled Merkle kernel, for the recompile fence: the scheduler
+# pins `merkle_jit_cache_size()` flat across varying micro-batch sizes
+# (bucket-stable shapes mean jit compiles per BUCKET, never per batch).
+_JIT_KERNELS: List = []
+
+
+def merkle_jit_cache_size() -> int:
+    """Total jit-cache entries across the engine's compiled kernels.
+    `_cache_size` is a private jax surface (the same one bench.py's
+    liveness fence uses); if a jax upgrade drops it, degrade to 0 so
+    only the fence test fails loudly, not production callers."""
+    return sum(getattr(k, "_cache_size", lambda: 0)() for k in _JIT_KERNELS)
 
 
 def _merkle_shard_kernel(millis, counter, node, valid, owner_ix):
@@ -63,7 +77,7 @@ def _merkle_shard_kernel(millis, counter, node, valid, owner_ix):
 @functools.lru_cache(maxsize=None)
 def _compiled_merkle_kernel(mesh: Mesh):
     spec = P(OWNERS_AXIS)
-    return jax.jit(
+    fn = jax.jit(
         shard_map(
             _merkle_shard_kernel,
             mesh=mesh,
@@ -72,6 +86,8 @@ def _compiled_merkle_kernel(mesh: Mesh):
             check_vma=False,
         )
     )
+    _JIT_KERNELS.append(fn)
+    return fn
 
 
 def _merkle_shard_kernel_compact(k1, node, owner_ix, cap):
@@ -113,7 +129,7 @@ def _merkle_shard_kernel_compact(k1, node, owner_ix, cap):
 @functools.lru_cache(maxsize=None)
 def _compiled_merkle_kernel_compact(mesh: Mesh, cap: int):
     spec = P(OWNERS_AXIS)
-    return jax.jit(
+    fn = jax.jit(
         shard_map(
             functools.partial(_merkle_shard_kernel_compact, cap=cap),
             mesh=mesh,
@@ -122,6 +138,8 @@ def _compiled_merkle_kernel_compact(mesh: Mesh, cap: int):
             check_vma=False,
         )
     )
+    _JIT_KERNELS.append(fn)
+    return fn
 
 
 @with_x64
@@ -420,6 +438,7 @@ class BatchReconciler:
         ONE copy shared by `reconcile` and `reconcile_wire`."""
         from evolu_tpu.server.relay import ShardedRelayStore
 
+        metrics.inc("evolu_engine_store_passes_total", path="oneshot")
         strings: Dict[str, str] = {}
         if isinstance(self.store, ShardedRelayStore):
             if all(hasattr(s.db, "relay_insert_packed") for s in self.store.shards):
@@ -705,16 +724,22 @@ class BatchReconciler:
             "shard_offsets": shard_offsets,
         }
 
-    def finish_batch(self, st) -> List[protocol.SyncResponse]:
+    def finish_batch(self, st, wire: bool = False) -> List:
         """Land batch k: per-shard C inserts (parallel, GIL-free),
         duplicate-owner delta recompute, tree updates, one atomic
-        commit per shard — while batch k+1 flies on the device."""
+        commit per shard — while batch k+1 flies on the device.
+        `wire=True` answers in BYTES mode (`_respond_wire`) for
+        consumers that only forward protobuf — the live scheduler path,
+        byte-identical to encoding the object responses (test-pinned
+        via `_respond_wire`'s own fence)."""
         stores, shard_index = self._shards()
+        metrics.inc("evolu_engine_store_passes_total", path="stream")
+        respond = self._respond_wire if wire else self._respond
         live, shard_data = st["live"], st["shard_data"]
         trees: Dict[str, dict] = {}
         strings: Dict[str, str] = {}
         if not live:
-            return self._respond(st["requests"], trees, strings)
+            return respond(st["requests"], trees, strings)
 
         def ingest_shard(si: int):
             gu, gc, ts_packed, content_packed, lens = shard_data[si]
@@ -751,7 +776,7 @@ class BatchReconciler:
                             "VALUES (?, ?)",
                             tree_rows[si],
                         )
-        return self._respond(st["requests"], trees, strings)
+        return respond(st["requests"], trees, strings)
 
     def _recompute_duplicate_owners(self, st, was_new_by_shard, deltas_by_owner) -> None:
         """The device hashed every row; owners where some rows were
@@ -924,6 +949,20 @@ class BatchReconciler:
         entry is missing or a stored row is non-canonical."""
         trees, strings = self._ingest(requests)
         return self._respond_wire(requests, trees, strings)
+
+    def run_batch_wire(self, requests: Sequence[protocol.SyncRequest]) -> List[bytes]:
+        """ONE engine/store pass for a live micro-batch → wire bytes per
+        request (the scheduler's entry point). Packed-capable stores
+        take `start_batch`/`finish_batch` (in-batch dedup in request
+        order, optimistic device hash, atomic per-shard insert+tree
+        commit); anything else routes through `reconcile_wire`, whose
+        `_ingest` picks the store-appropriate batched path. Either way
+        a failure rolls every shard transaction back before raising —
+        the scheduler's singleton retry depends on that."""
+        stores, _ = self._shards()
+        if all(hasattr(s.db, "relay_insert_packed") for s in stores):
+            return self.finish_batch(self.start_batch(requests), wire=True)
+        return self.reconcile_wire(requests)
 
     def _respond_wire(
         self, requests, trees: Dict[str, dict],

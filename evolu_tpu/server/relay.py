@@ -67,6 +67,19 @@ def fetch_response_stream(db, user_id, node_id, server_tree, client_tree) -> byt
     stream, _n = db.fetch_relay_messages_wire(user_id, since, node_id)
     return stream
 
+def serve_single_request(store, request: "protocol.SyncRequest") -> bytes:
+    """ONE copy of the per-request serve recipe: fused C wire path,
+    object-path fallback (where non-canonical shapes reach the host
+    oracle before any side effect). Shared by the non-batching do_POST
+    branch and the scheduler's non-batchable/poison-retry fallbacks —
+    the recipes must never drift (the scheduler's responses are pinned
+    byte-identical to this path)."""
+    out = store.sync_wire(request) if hasattr(store, "sync_wire") else None
+    if out is None:
+        out = protocol.encode_sync_response(store.sync(request))
+    return out
+
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -328,6 +341,7 @@ def relay_stats_payload(store) -> dict:
 
 class _Handler(BaseHTTPRequestHandler):
     store: RelayStore  # injected by RelayServer
+    scheduler = None  # SyncScheduler when continuous batching is on
 
     def log_message(self, format: str, *args) -> None:
         # Target-gated like every other runtime signal (config.log):
@@ -400,11 +414,27 @@ class _Handler(BaseHTTPRequestHandler):
                 if hasattr(self.store, "shard_index") else 0
             )
             metrics.inc("evolu_relay_shard_requests_total", shard=str(shard))
-            out = self.store.sync_wire(request) if hasattr(
-                self.store, "sync_wire"
-            ) else None
-            if out is None:
-                out = protocol.encode_sync_response(self.store.sync(request))
+            if self.scheduler is not None:
+                from evolu_tpu.server.scheduler import (
+                    SchedulerQueueFull,
+                    format_retry_after,
+                )
+
+                try:
+                    out = self.scheduler.submit(request)
+                except SchedulerQueueFull as e:
+                    # Backpressure is flow control, not a pipeline
+                    # error (errors_total stays an error-rate): tell
+                    # the client when to come back instead of letting
+                    # handler threads pile up unboundedly.
+                    metrics.inc("evolu_relay_backpressure_total")
+                    self.send_response(503)
+                    self.send_header("Retry-After", format_retry_after(e.retry_after))
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+            else:
+                out = serve_single_request(self.store, request)
         except Exception as e:  # noqa: BLE001 - index.ts:231-233
             # The flight dump rides the exception (server-side only —
             # the wire response stays a bare 500, no event leakage).
@@ -430,11 +460,28 @@ class _RelayHTTPServer(ThreadingHTTPServer):
 
 
 class RelayServer:
-    """ThreadingHTTPServer wrapper; `url` once started."""
+    """ThreadingHTTPServer wrapper; `url` once started.
 
-    def __init__(self, store: Optional[RelayStore] = None, host: str = "127.0.0.1", port: int = 0):
+    `batching=True` (or an explicit `scheduler`) routes sync POSTs
+    through the continuous-batching scheduler
+    (`evolu_tpu.server.scheduler.SyncScheduler`): handler threads
+    coalesce into single `BatchReconciler` passes, queue-full answers
+    503 + Retry-After, and `stop()` drains in-flight batches before
+    the store closes. Default off — the per-request path is the
+    reference relay's shape and stays the baseline."""
+
+    def __init__(self, store: Optional[RelayStore] = None, host: str = "127.0.0.1",
+                 port: int = 0, batching: bool = False, scheduler=None):
         self.store = store or RelayStore()
-        handler = type("BoundHandler", (_Handler,), {"store": self.store})
+        self.scheduler = scheduler
+        if batching and scheduler is None:
+            from evolu_tpu.server.scheduler import SyncScheduler
+
+            self.scheduler = SyncScheduler(self.store)
+        handler = type(
+            "BoundHandler", (_Handler,),
+            {"store": self.store, "scheduler": self.scheduler},
+        )
         self._httpd = _RelayHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
 
@@ -452,6 +499,13 @@ class RelayServer:
         self._httpd.shutdown()
         if self._thread:
             self._thread.join()
+        if self.scheduler is not None:
+            # Drain BEFORE the store closes — injected or owned alike
+            # (stop() is idempotent): every queued request is served
+            # through full-size batches, handler threads blocked in
+            # submit() get their responses, and only then does the
+            # storage go away. Post-drain submits answer 503.
+            self.scheduler.stop()
         self._httpd.server_close()
         self.store.close()
 

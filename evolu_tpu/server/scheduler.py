@@ -1,0 +1,346 @@
+"""Continuous-batching sync scheduler: fuse live relay traffic into
+single engine passes.
+
+The reference relay services each sync request individually
+(apps/server/src/index.ts:148-159), and so did our HTTP relay — one
+`RelayStore.sync_wire` store pass per handler thread. The offline
+`BatchReconciler` already reconciles a whole batch of SyncRequests in
+one fused pass (bulk SQL set-diff + one sharded device Merkle
+dispatch), but nothing fed it live traffic. This module is the
+admission/dispatch layer between the two: handler threads enqueue
+decoded `SyncRequest`s onto a bounded queue and block on per-request
+futures; a dispatcher thread closes a micro-batch on whichever comes
+first of max-batch-size / max-wait-deadline and runs ONE engine pass
+(`BatchReconciler.start_batch`/`finish_batch` on packed stores) whose
+wire responses resolve the futures.
+
+Why coalescing is sound (Merkle-CRDTs, arXiv 2004.00107): anti-entropy
+is pure set reconciliation — a response depends only on store state
+plus that one request, and owners are independent, so a batch of
+DISTINCT-owner requests served in one pass is byte-identical to any
+sequential order of the same requests. Same-owner requests are NOT
+independent (the second's response must see the first's inserts the
+way a sequential server would), so a batch never contains two
+requests for one owner — the later one stays queued, FIFO order
+preserved, and rides the next pass.
+
+Robustness contract:
+- queue full → `SchedulerQueueFull` (the relay maps it to 503 +
+  `Retry-After`): backpressure instead of unbounded handler threads.
+- non-canonical timestamp widths never enter a batch: the engine's
+  packed path rejects them batch-wide (`_pack_rows`), so they dispatch
+  as singletons through the per-request `sync_wire`/`sync` path, which
+  routes them to the host oracle BEFORE any side effect — the r5
+  packed-receive contract, kept. Singletons still run ON the
+  dispatcher thread: all store writes serialize there, so a fallback
+  can never join an engine transaction left open on the shared
+  connection.
+- a poisoned batch (any engine-pass failure: every shard transaction
+  rolled back, nothing committed) is retried ONCE as singletons, so
+  one bad request can't fail its batchmates.
+- `stop()` drains every queued request through full-size batches
+  before the dispatcher exits; post-stop submits are rejected with
+  `SchedulerQueueFull` (clients back off and retry elsewhere/later).
+
+Shape stability: the engine pads every device batch to power-of-two
+row buckets (`ops.bucket_size`), so varying micro-batch sizes inside a
+bucket NEVER recompile the fused jit pipeline — pinned by
+`tests/test_scheduler.py` via `engine.merkle_jit_cache_size()`.
+
+Instrumented through `evolu_tpu.obs` (host-side only, no jax at import
+time here — the engine, which does import jax, loads lazily on the
+first batch): queue depth gauge, batch-size and batch-latency
+histograms, coalesce/fallback/poison/reject counters
+(docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from evolu_tpu.obs import metrics
+from evolu_tpu.sync import protocol
+from evolu_tpu.utils.log import log
+
+
+class SchedulerQueueFull(Exception):
+    """Admission queue at capacity (or scheduler stopping): the caller
+    should answer 503 with `retry_after` seconds."""
+
+    def __init__(self, retry_after: float):
+        super().__init__(f"sync scheduler queue full; retry after {retry_after}s")
+        self.retry_after = retry_after
+
+
+class _Pending:
+    """One enqueued request + its future. `single=True` marks a
+    request the engine can't batch: it dispatches alone, still ON the
+    dispatcher thread — every store write flows through one thread, so
+    a fallback can never join an open engine transaction on the shared
+    connection (NativeDatabase.transaction() JOINS when one is already
+    open; a handler-thread write acked mid-batch would be rolled back
+    with a poisoned batch)."""
+
+    __slots__ = ("request", "single", "t_enqueue", "done", "response", "error")
+
+    def __init__(self, request: protocol.SyncRequest, single: bool = False):
+        self.request = request
+        self.single = single
+        self.t_enqueue = time.monotonic()
+        self.done = threading.Event()
+        self.response: Optional[bytes] = None
+        self.error: Optional[BaseException] = None
+
+    def resolve(self, response: bytes) -> None:
+        self.response = response
+        self.done.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self.done.set()
+
+
+def _batchable(request: protocol.SyncRequest) -> bool:
+    """Only canonical 46-char timestamps may enter a packed engine
+    batch (`engine._pack_rows` rejects batch-wide otherwise); anything
+    else takes the per-request path, whose host oracle is the error
+    surface. Hex-CASE anomalies at canonical width stay batchable —
+    the engine quarantines those owners to the host fold internally."""
+    return all(len(m.timestamp) == 46 for m in request.messages)
+
+
+class SyncScheduler:
+    """Admission + dispatch between relay handler threads and one
+    `BatchReconciler`.
+
+    `submit(request)` blocks the calling handler thread until its wire
+    response (encoded SyncResponse bytes, byte-identical to the
+    per-request `sync_wire` path — test-pinned) is ready, and raises
+    `SchedulerQueueFull` when the bounded queue is at capacity.
+    """
+
+    def __init__(
+        self,
+        store,
+        engine=None,
+        mesh=None,
+        max_batch: int = 32,
+        max_wait_s: float = 0.005,
+        max_queue: int = 256,
+        retry_after_s: float = 1.0,
+        submit_timeout_s: float = 120.0,
+    ):
+        self.store = store
+        self.max_batch = max(1, int(max_batch))
+        self.max_wait_s = float(max_wait_s)
+        self.max_queue = int(max_queue)
+        self.retry_after_s = float(retry_after_s)
+        self.submit_timeout_s = float(submit_timeout_s)
+        self._mesh = mesh
+        self._engine = engine
+        self._own_engine = engine is None
+        self._engine_broken: Optional[BaseException] = None
+        self._cv = threading.Condition()
+        self._queue: List[_Pending] = []
+        self._stopping = False
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name="evolu-sched"
+        )
+        self._thread.start()
+
+    # -- admission (handler threads) --
+
+    def submit(self, request: protocol.SyncRequest) -> bytes:
+        """Serve one request: coalesced through the next engine pass,
+        or as a singleton dispatch for shapes the engine can't batch —
+        either way serialized on the dispatcher thread (see _Pending)."""
+        p = _Pending(request, single=not _batchable(request))
+        with self._cv:
+            if self._stopping or len(self._queue) >= self.max_queue:
+                metrics.inc("evolu_sched_rejected_total")
+                raise SchedulerQueueFull(self.retry_after_s)
+            self._queue.append(p)
+            metrics.set_gauge("evolu_sched_queue_depth", len(self._queue))
+            self._cv.notify()
+        if not p.done.wait(self.submit_timeout_s):
+            raise TimeoutError(
+                f"sync scheduler did not serve the request within "
+                f"{self.submit_timeout_s}s"
+            )
+        if p.error is not None:
+            raise p.error
+        return p.response  # type: ignore[return-value]
+
+    # -- dispatch (one background thread) --
+
+    def _dispatch_loop(self) -> None:
+        try:
+            while True:
+                with self._cv:
+                    while not self._queue and not self._stopping:
+                        self._cv.wait()
+                    if not self._queue:
+                        return  # stopping + drained
+                    # Deadline from the OLDEST pending's enqueue time:
+                    # requests that piled up during the previous engine
+                    # pass close a batch immediately — the pass itself
+                    # is the coalescing window under load; max_wait_s
+                    # only delays a lone request on an idle queue.
+                    # stop() waives the wait so the drain runs at full
+                    # batch size without deadline stalls.
+                    deadline = self._queue[0].t_enqueue + self.max_wait_s
+                    while len(self._queue) < self.max_batch and not self._stopping:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(remaining)
+                    batch = self._close_batch()
+                    metrics.set_gauge("evolu_sched_queue_depth", len(self._queue))
+                try:
+                    self._run_batch(batch)
+                except BaseException:
+                    for p in batch:  # already popped — fail, don't hang
+                        if not p.done.is_set():
+                            p.fail(RuntimeError("sync scheduler dispatcher exited"))
+                    raise
+        finally:
+            # If the loop died abnormally (BaseException out of
+            # _run_batch — e.g. KeyboardInterrupt mid-pass), blocked
+            # submitters must not hang until their timeout.
+            with self._cv:
+                dead, self._queue = self._queue, []
+                self._stopping = True
+            for p in dead:
+                p.fail(RuntimeError("sync scheduler dispatcher exited"))
+            self._stopped.set()
+
+    def _close_batch(self) -> List[_Pending]:
+        """Pop the next dispatch, FIFO, called under the lock. A
+        `single` at the queue head dispatches alone; otherwise up to
+        max_batch DISTINCT-owner batchable requests. A second request
+        for an owner already in the batch stays queued (its response
+        must observe the first request's inserts exactly as a
+        sequential server's would), and once anything of an owner is
+        kept back (same-owner duplicate, single, or capacity), every
+        later request of that owner is kept too — per-owner FIFO is
+        never reordered."""
+        if self._queue[0].single:
+            return [self._queue.pop(0)]
+        batch: List[_Pending] = []
+        owners: set = set()
+        keep: List[_Pending] = []
+        blocked: set = set()
+        for p in self._queue:
+            uid = p.request.user_id
+            if (p.single or uid in owners or uid in blocked
+                    or len(batch) >= self.max_batch):
+                blocked.add(uid)
+                keep.append(p)
+            else:
+                owners.add(uid)
+                batch.append(p)
+        # Anything kept is seen by the next loop iteration's queue
+        # check — no new arrival needed to wake the dispatcher.
+        self._queue = keep
+        return batch
+
+    def _run_batch(self, batch: List[_Pending]) -> None:
+        if not batch:
+            return
+        if batch[0].single:
+            p = batch[0]
+            metrics.inc("evolu_sched_fallback_total", reason="non_canonical")
+            try:
+                p.resolve(self._serve_single(p.request))
+            except Exception as e:  # noqa: BLE001 - per-request error
+                p.fail(e)
+            return
+        t0 = time.perf_counter()
+        metrics.inc("evolu_sched_batches_total")
+        metrics.observe(
+            "evolu_sched_batch_requests", len(batch), buckets=metrics.COUNT_BUCKETS
+        )
+        try:
+            engine = self._ensure_engine()
+            outs = engine.run_batch_wire([p.request for p in batch])
+        except Exception as e:  # noqa: BLE001 - poison isolation
+            # (BaseException — KeyboardInterrupt/SystemExit — is NOT
+            # poison: it propagates, and the loop's finally fails any
+            # still-queued futures.) Every shard transaction rolled
+            # back (engine contract): nothing committed, so the
+            # singleton retry is exact — and it isolates the poison to
+            # the one request that carries it; batchmates succeed.
+            metrics.inc("evolu_sched_poisoned_batches_total")
+            log("server", "scheduler batch poisoned; retrying as singletons",
+                error=repr(e), requests=len(batch))
+            for p in batch:
+                try:
+                    response = self._serve_single(p.request)
+                except Exception as pe:  # noqa: BLE001
+                    p.fail(pe)
+                else:
+                    metrics.inc("evolu_sched_fallback_total", reason="poison_retry")
+                    p.resolve(response)
+            metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3)
+            return
+        metrics.inc("evolu_sched_coalesced_requests_total", len(batch))
+        for p, out in zip(batch, outs):
+            p.resolve(out)
+        metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3)
+
+    def _ensure_engine(self):
+        """The BatchReconciler, created lazily on the dispatcher thread
+        (its import pulls jax — nothing here touches a backend until
+        the first batch). A broken engine (e.g. no usable jax backend)
+        is remembered so every batch degrades to singletons without
+        re-paying the failed construction."""
+        if self._engine_broken is not None:
+            raise self._engine_broken
+        if self._engine is None:
+            try:
+                from evolu_tpu.server.engine import BatchReconciler
+
+                self._engine = BatchReconciler(self.store, self._mesh)
+            except Exception as e:  # noqa: BLE001
+                self._engine_broken = e
+                raise
+        return self._engine
+
+    def _serve_single(self, request: protocol.SyncRequest) -> bytes:
+        """The per-request path — exactly what the relay ran before the
+        scheduler existed (ONE recipe, shared with the non-batching
+        do_POST branch): fused C wire serve, object-path fallback
+        (which is where non-canonical shapes reach the host oracle
+        before any side effect). Only ever called on the dispatcher
+        thread, so it can never interleave with an open engine
+        transaction on the shared store connection."""
+        from evolu_tpu.server.relay import serve_single_request
+
+        return serve_single_request(self.store, request)
+
+    def stop(self) -> None:
+        """Drain then shut down (idempotent — the relay and an
+        embedding caller may both stop a shared scheduler): everything
+        already queued is served (full-size batches, no deadline
+        waits); new submits are rejected with `SchedulerQueueFull`."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        self._stopped.wait(timeout=max(30.0, self.submit_timeout_s))
+        self._thread.join(timeout=5.0)
+        if self._own_engine:
+            with self._cv:
+                engine, self._engine = self._engine, None
+            if engine is not None:
+                engine.close()
+
+
+def format_retry_after(seconds: float) -> str:
+    """RFC 7231 Retry-After is integer delay-seconds; emit the integer
+    form when integral and the bare float otherwise (our client parses
+    either — sub-second values matter for tests and local deploys)."""
+    f = float(seconds)
+    return str(int(f)) if f.is_integer() else repr(f)

@@ -322,12 +322,72 @@ class SyncTransport:
             return None
 
 
-def _http_post(url: str, body: bytes) -> bytes:
-    req = urllib.request.Request(
-        url, data=body, headers={"Content-Type": "application/octet-stream"}, method="POST"
-    )
-    with urllib.request.urlopen(req, timeout=30) as resp:
-        return resp.read()
+# Transport backoff policy. A sync POST is idempotent (INSERT OR
+# IGNORE + pure diff), so retrying a 429/503 or a connection failure is
+# always safe. Bounded: after the retries are spent, the original
+# error surfaces — a 4xx/5xx to on_error (divergence must not be
+# silent), a connection error to the offline/probe machinery (offline
+# remains a normal state, not an error). Before this, one queue-full
+# 503 from the relay's continuous-batching scheduler surfaced straight
+# as UnknownError with no retry.
+BACKOFF_RETRIES = 3
+BACKOFF_BASE_S = 0.05
+BACKOFF_MAX_S = 5.0
+RETRYABLE_HTTP = (429, 503)
+
+
+def _retry_after_seconds(error: urllib.error.HTTPError) -> Optional[float]:
+    """Parse a Retry-After header: RFC 7231 delay-seconds (we also
+    accept a float — our relay emits sub-second values for local
+    deploys). HTTP-date form and garbage fall back to our own backoff
+    schedule (None)."""
+    raw = error.headers.get("Retry-After") if error.headers else None
+    if raw is None:
+        return None
+    try:
+        value = float(raw.strip())
+    except ValueError:
+        return None
+    return value if value >= 0 else None
+
+
+def _http_post(url: str, body: bytes, *, retries: int = BACKOFF_RETRIES,
+               base_delay: float = BACKOFF_BASE_S, max_delay: float = BACKOFF_MAX_S,
+               sleep=None, rng=None) -> bytes:
+    """POST with bounded exponential backoff + full jitter on 429/503
+    (honoring Retry-After — the relay's backpressure contract) and on
+    connection errors. `sleep`/`rng` are injectable for tests."""
+    import random
+    import time
+
+    sleep = sleep or time.sleep
+    rng = rng or random.random
+    attempt = 0
+    while True:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/octet-stream"}, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            if e.code not in RETRYABLE_HTTP or attempt >= retries:
+                raise
+            delay = _retry_after_seconds(e)
+            if delay is None:
+                # Full jitter: delay ∈ [0, base * 2^attempt] — the
+                # standard de-synchronizer for a fleet of clients all
+                # bounced by the same overloaded relay.
+                delay = min(max_delay, base_delay * (2 ** attempt)) * rng()
+            metrics.inc("evolu_sync_backoff_retries_total", reason=str(e.code))
+            log("sync:request", "backoff retry", code=e.code, delay_s=round(delay, 4))
+        except (urllib.error.URLError, OSError):
+            if attempt >= retries:
+                raise
+            delay = min(max_delay, base_delay * (2 ** attempt)) * rng()
+            metrics.inc("evolu_sync_backoff_retries_total", reason="connection")
+        sleep(min(delay, max_delay))
+        attempt += 1
 
 
 def _ping_url(sync_url: str) -> str:
