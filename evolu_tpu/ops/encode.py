@@ -77,16 +77,20 @@ def u32_divmod_hi_lo(m_i64, divisor: int):
     m ≡ hi·r32 + lo (mod divisor) and
     m // divisor = hi·q32 + (hi·r32 + lo) // divisor.
     Exact for 0 ≤ m < U32_MILLIS_BOUND (hi ≤ 999) PROVIDED the
-    intermediate t = hi·r32 + (divisor-1) fits u32 — asserted below at
-    trace time, since it depends on the divisor's REMAINDER, not its
+    intermediate t = hi·r32 + (divisor-1) fits u32 — checked below at
+    trace time (ValueError, `python -O`-proof), since it depends on the divisor's REMAINDER, not its
     size (86_400_000 would overflow: r32 = 61_367_296). ONE copy of
     this overflow-sensitive chain, shared by the hash render and the
     minute stage. → (quotient u32, remainder u32)."""
     q32, r32 = divmod(1 << 32, divisor)
-    assert 999 * r32 + (divisor - 1) < (1 << 32), (
-        f"u32_divmod_hi_lo: divisor {divisor} overflows the u32 chain "
-        f"(999*{r32} + {divisor - 1} >= 2**32)"
-    )
+    if 999 * r32 + (divisor - 1) >= (1 << 32):
+        # A hard error, not an assert: the guard must survive
+        # `python -O` — a divisor that overflows the intermediate would
+        # silently corrupt every quotient in range.
+        raise ValueError(
+            f"u32_divmod_hi_lo: divisor {divisor} overflows the u32 chain "
+            f"(999*{r32} + {divisor - 1} >= 2**32)"
+        )
     mu = m_i64.astype(jnp.uint64)
     hi = (mu >> jnp.uint64(32)).astype(jnp.uint32)  # < 1000 in range
     lo = mu.astype(jnp.uint32)

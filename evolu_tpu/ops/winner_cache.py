@@ -438,8 +438,9 @@ class DeviceWinnerCache:
         cache mutation, so the re-route through `plan_batch` keeps
         adaptive-gate parity with a pure-object flow) or a
         non-canonical stored winner seed (the re-route's own
-        `_host_fallback` owns invalidation; its only side effect here
-        is one extra EWMA sample on this adversarial shape)."""
+        `_host_fallback` owns invalidation; `_skip_ewma_once` is armed
+        before that bounce so the re-entered gate does not sample the
+        EWMA a second time for the same batch)."""
         n = pb.n
         if n == 0:
             return np.zeros(0, bool), np.zeros(0, bool), {}
@@ -458,6 +459,11 @@ class DeviceWinnerCache:
                     pb, cells, touched_ids, millis, counter, node
                 )
             if new_cells and not self._seed_new_cells(new_cells):
+                # The gate above already took this batch's EWMA sample;
+                # the object-path re-route will re-enter the gate (via
+                # `plan_batch`) for the SAME batch — arm the one-shot
+                # skip so a non-canonical bounce never samples twice.
+                self._skip_ewma_once = True
                 return None  # non-canonical stored winner → object path
             self._count_cached(cells, new_cells)
 

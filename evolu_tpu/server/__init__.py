@@ -9,8 +9,10 @@ one device pass, sharded over the mesh (SURVEY.md §2.15).
 """
 
 from evolu_tpu.server.relay import RelayStore, RelayServer, serve
+from evolu_tpu.server.replicate import ReplicationManager
 from evolu_tpu.server.scheduler import SchedulerQueueFull, SyncScheduler
 
 __all__ = [
     "RelayStore", "RelayServer", "serve", "SyncScheduler", "SchedulerQueueFull",
+    "ReplicationManager",
 ]

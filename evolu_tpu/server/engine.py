@@ -440,6 +440,7 @@ class BatchReconciler:
 
         metrics.inc("evolu_engine_store_passes_total", path="oneshot")
         strings: Dict[str, str] = {}
+        db = getattr(self.store, "db", None)
         if isinstance(self.store, ShardedRelayStore):
             if all(hasattr(s.db, "relay_insert_packed") for s in self.store.shards):
                 trees = self._ingest_packed(requests, strings)
@@ -449,10 +450,18 @@ class BatchReconciler:
                     r.user_id: self.store.add_messages(r.user_id, r.messages)
                     for r in requests
                 }
-        elif hasattr(self.store.db, "relay_insert_packed"):
+        elif db is not None and hasattr(db, "relay_insert_packed"):
             trees = self._ingest_packed(requests, strings)
-        else:
+        elif db is not None:
             trees = self._ingest_generic(requests, strings)
+        else:
+            # Generic store (RelayStore surface, no `.db` SQL handle):
+            # per-request ingest; the respond side degrades likewise
+            # (`_respond_wire`'s object fallback).
+            trees = {
+                r.user_id: self.store.add_messages(r.user_id, r.messages)
+                for r in requests
+            }
         return trees, strings
 
     def _shards(self):
@@ -960,7 +969,9 @@ class BatchReconciler:
         a failure rolls every shard transaction back before raising —
         the scheduler's singleton retry depends on that."""
         stores, _ = self._shards()
-        if all(hasattr(s.db, "relay_insert_packed") for s in stores):
+        if all(
+            hasattr(getattr(s, "db", None), "relay_insert_packed") for s in stores
+        ):
             return self.finish_batch(self.start_batch(requests), wire=True)
         return self.reconcile_wire(requests)
 
@@ -985,8 +996,10 @@ class BatchReconciler:
         fallback: List[Tuple[int, protocol.SyncRequest]] = []
         for i, r in enumerate(requests):
             tree, raw = self._resolve_tree(r.user_id, trees, tree_strings)
-            db = shards[shard_ix(r.user_id)].db
-            if not hasattr(db, "fetch_relay_messages_wire"):
+            # A generic store (no `.db` attribute at all) must degrade
+            # to the object-respond fallback, not AttributeError.
+            db = getattr(shards[shard_ix(r.user_id)], "db", None)
+            if db is None or not hasattr(db, "fetch_relay_messages_wire"):
                 fallback.append((i, r))
                 out.append(None)
                 continue

@@ -9,7 +9,10 @@ The server is E2EE-blind: rows are (timestamp, userId, ciphertext).
 Observability extensions (no reference equivalent): `GET /metrics`
 (Prometheus v0.0.4 text from the process registry) and `GET /stats`
 (JSON: per-shard row counts + request counters + latency percentile
-estimates) — see docs/OBSERVABILITY.md.
+estimates) — see docs/OBSERVABILITY.md. Replication extension (no
+reference equivalent): `POST /replicate/summary` + `POST
+/replicate/pull`, the Merkle anti-entropy gossip surface between relay
+peers (`server/replicate.py`; `RelayServer(peers=[...])`).
 
 `add_messages` keeps the reference's per-row insert (it needs per-row
 rowcount for the changes==1 Merkle gate) but aggregates tree updates
@@ -204,6 +207,33 @@ class RelayStore:
         )
         return rows[0]["merkleTree"] if rows else "{}"
 
+    def owner_trees(self) -> List[Tuple[str, str]]:
+        """Every (owner, stored tree TEXT) pair in ONE query — the
+        replication summary map (server/replicate.py). Per-owner
+        `get_merkle_tree_string` calls would be N+1 SELECTs per gossip
+        round."""
+        rows = self.db.exec_sql_query('SELECT "userId", "merkleTree" FROM "merkleTree"')
+        return [(r["userId"], r["merkleTree"]) for r in rows]
+
+    def replica_messages(
+        self, user_id: str, since: str, limit: Optional[int] = None
+    ) -> Tuple[protocol.EncryptedCrdtMessage, ...]:
+        """Ranged replication read for a PEER RELAY: stored messages
+        strictly after `since` in timestamp order — the EARLIEST
+        `limit` of them when capped — WITHOUT the own-node exclusion of
+        `get_messages` (a relay is not a message author, it needs all
+        rows; server/replicate.py). Plain SQL on purpose: the C reader
+        bakes in the `NOT LIKE` node filter, and replication volume is
+        divergence-bounded, not the per-message hot path."""
+        rows = self.db.exec_sql_query(
+            'SELECT "timestamp", "content" FROM "message" '
+            'WHERE "userId" = ? AND "timestamp" > ? ORDER BY "timestamp" LIMIT ?',
+            (user_id, since, -1 if limit is None else int(limit)),
+        )
+        return tuple(
+            protocol.EncryptedCrdtMessage(r["timestamp"], r["content"]) for r in rows
+        )
+
     def sync(self, request: protocol.SyncRequest) -> protocol.SyncResponse:
         """The pure pipeline (index.ts:204-216)."""
         tree = self.add_messages(request.user_id, request.messages)
@@ -298,6 +328,12 @@ class ShardedRelayStore:
     def sync_wire(self, request: protocol.SyncRequest) -> Optional[bytes]:
         return self.shard_of(request.user_id).sync_wire(request)
 
+    def owner_trees(self) -> List[Tuple[str, str]]:
+        return [p for s in self.shards for p in s.owner_trees()]
+
+    def replica_messages(self, user_id: str, since: str, limit: Optional[int] = None):
+        return self.shard_of(user_id).replica_messages(user_id, since, limit)
+
     def user_ids(self) -> List[str]:
         return [u for s in self.shards for u in s.user_ids()]
 
@@ -311,18 +347,20 @@ class ShardedRelayStore:
             s.close()
 
 
-def relay_stats_payload(store) -> dict:
+def relay_stats_payload(store, replication=None) -> dict:
     """The GET /stats JSON: store-derived row counts per shard (shared
     truth in a MultiprocessRelay — every worker reads the same files)
     plus this process's request counters from the metrics registry
     (per-process by nature; a multiprocess deploy scrapes each worker's
-    /metrics or sums /stats over workers)."""
+    /metrics or sums /stats over workers). With a ReplicationManager
+    attached, a `replication` section reports per-peer gossip health
+    (docs/OBSERVABILITY.md)."""
     shards = store.stats() if hasattr(store, "stats") else []
     for s in shards:
         s["requests"] = metrics.get_counter(
             "evolu_relay_shard_requests_total", shard=str(s["index"])
         )
-    return {
+    payload = {
         "shards": shards,
         "messages": sum(s["messages"] for s in shards),
         "users": sum(s["users"] for s in shards),
@@ -337,11 +375,15 @@ def relay_stats_payload(store) -> dict:
             "p99": metrics.quantile("evolu_relay_request_ms", 0.99),
         },
     }
+    if replication is not None:
+        payload["replication"] = replication.stats_payload()
+    return payload
 
 
 class _Handler(BaseHTTPRequestHandler):
     store: RelayStore  # injected by RelayServer
     scheduler = None  # SyncScheduler when continuous batching is on
+    replication = None  # ReplicationManager when the relay has peers
 
     def log_message(self, format: str, *args) -> None:
         # Target-gated like every other runtime signal (config.log):
@@ -384,7 +426,9 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 # store.stats() runs SQL: a shard closing mid-scrape
                 # must surface as an HTTP 500, not a dropped connection.
-                body = json.dumps(relay_stats_payload(self.store)).encode("utf-8")
+                body = json.dumps(
+                    relay_stats_payload(self.store, self.replication)
+                ).encode("utf-8")
             except Exception as e:  # noqa: BLE001
                 metrics.inc("evolu_relay_errors_total")
                 self.send_error(500, str(e))
@@ -394,6 +438,16 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(404)
 
     def do_POST(self) -> None:  # POST / (index.ts:224-248)
+        if self.path in ("/replicate/summary", "/replicate/pull"):
+            if self.replication is None:
+                # Only a relay CONFIGURED for replication exposes the
+                # gossip surface: /replicate/summary enumerates owner
+                # ids, which the sync path treats as capabilities — a
+                # plain client-facing relay must not disclose them.
+                self.send_error(404)
+                return
+            self._do_replicate()
+            return
         t0 = time.perf_counter()
         # Count the request BEFORE any reject so errors_total can never
         # exceed requests_total (error-rate = errors/requests must stay
@@ -447,8 +501,43 @@ class _Handler(BaseHTTPRequestHandler):
             metrics.observe(
                 "evolu_relay_request_ms", (time.perf_counter() - t0) * 1e3
             )
+        if self.replication is not None and request.messages:
+            # Debounced write hint: fresh rows should reach peer relays
+            # at gossip-debounce latency, not interval latency.
+            self.replication.hint()
         metrics.observe("evolu_relay_response_bytes", len(out),
                         buckets=metrics.SIZE_BUCKETS)
+        self._respond(200, out, "application/octet-stream")
+
+    def _do_replicate(self) -> None:
+        """POST /replicate/summary and /replicate/pull — the peer
+        gossip surface (server/replicate.py). Malformed bodies answer
+        400 (the wire decoders raise ValueError only); anything else is
+        a 500 like the sync path."""
+        from evolu_tpu.server import replicate
+
+        metrics.inc("evolu_relay_requests_total", endpoint=self.path)
+        length = int(self.headers.get("Content-Length", 0))
+        if length > MAX_BODY_BYTES:
+            metrics.inc("evolu_relay_errors_total")
+            self.send_error(413)
+            return
+        body = self.rfile.read(length)
+        try:
+            if self.path == "/replicate/summary":
+                out = replicate.serve_summary(self.store, body, self.replication)
+            else:
+                out = replicate.serve_pull(self.store, body)
+        except ValueError as e:
+            metrics.inc("evolu_relay_errors_total")
+            self.send_error(400, str(e))
+            return
+        except Exception as e:  # noqa: BLE001 - peer gets a clean 500
+            flight.attach(e)
+            metrics.inc("evolu_relay_errors_total")
+            log("dev", "relay replicate request failed", error=repr(e))
+            self.send_error(500, str(e))
+            return
         self._respond(200, out, "application/octet-stream")
 
 
@@ -468,19 +557,43 @@ class RelayServer:
     coalesce into single `BatchReconciler` passes, queue-full answers
     503 + Retry-After, and `stop()` drains in-flight batches before
     the store closes. Default off — the per-request path is the
-    reference relay's shape and stays the baseline."""
+    reference relay's shape and stays the baseline.
+
+    `peers=[url, ...]` (or an explicit `replication` manager) turns on
+    relay↔relay Merkle anti-entropy (`server/replicate.py`): the
+    manager gossips per-owner tree summaries with each peer, pulls only
+    diverged ranges, and — when this relay also batches — submits the
+    pulled messages through the scheduler so replication traffic
+    coalesces with live client traffic into the same fused engine
+    passes. `peers=[]` (non-None) makes a pure LISTENER: it serves the
+    gossip endpoints without polling anyone. Relays NOT configured for
+    replication answer 404 on `/replicate/*` — the summary endpoint
+    enumerates owner ids (capabilities on the sync path), so the
+    surface is for peer meshes on trusted networks, not for clients.
+    `start()`/`stop()` own its lifecycle."""
 
     def __init__(self, store: Optional[RelayStore] = None, host: str = "127.0.0.1",
-                 port: int = 0, batching: bool = False, scheduler=None):
+                 port: int = 0, batching: bool = False, scheduler=None,
+                 peers: Optional[Sequence[str]] = None, replication=None,
+                 replication_interval_s: float = 30.0):
         self.store = store or RelayStore()
         self.scheduler = scheduler
         if batching and scheduler is None:
             from evolu_tpu.server.scheduler import SyncScheduler
 
             self.scheduler = SyncScheduler(self.store)
+        self.replication = replication
+        if peers is not None and replication is None:
+            from evolu_tpu.server.replicate import ReplicationManager
+
+            self.replication = ReplicationManager(
+                self.store, peers, scheduler=self.scheduler,
+                interval_s=replication_interval_s,
+            )
         handler = type(
             "BoundHandler", (_Handler,),
-            {"store": self.store, "scheduler": self.scheduler},
+            {"store": self.store, "scheduler": self.scheduler,
+             "replication": self.replication},
         )
         self._httpd = _RelayHTTPServer((host, port), handler)
         self._thread: Optional[threading.Thread] = None
@@ -493,12 +606,19 @@ class RelayServer:
     def start(self) -> "RelayServer":
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True, name="evolu-relay")
         self._thread.start()
+        if self.replication is not None:
+            self.replication.start()
         return self
 
     def stop(self) -> None:
         self._httpd.shutdown()
         if self._thread:
             self._thread.join()
+        if self.replication is not None:
+            # Before the scheduler drains and WELL before the store
+            # closes: an in-flight gossip round may still be submitting
+            # pulled messages (stop() joins the loop thread).
+            self.replication.stop()
         if self.scheduler is not None:
             # Drain BEFORE the store closes — injected or owned alike
             # (stop() is idempotent): every queued request is served

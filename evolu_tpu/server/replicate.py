@@ -1,0 +1,518 @@
+"""Relay↔relay replication: Merkle anti-entropy between relay peers.
+
+No reference equivalent — the reference relay (apps/server, 258 LoC)
+is a single node whose one SQLite file is the whole fleet. This module
+turns N relays into a converging cluster using the primitive the
+framework already owns: per-owner Merkle trees with base-3 minute keys
+(`core/merkle.py`). Merkle-CRDTs (Sanjuán et al., arXiv:2004.00107)
+and the anti-entropy literature make this the standard construction:
+gossip tree digests, pull only from the diverged minute, and bandwidth
+is proportional to DIVERGENCE, not to database size.
+
+One gossip round against one peer:
+
+1. `POST /replicate/summary` carrying MY owner→tree map; the response
+   is the PEER's map. (The peer's handler also compares the incoming
+   map against its own store and arms its manager's debounced hint on
+   divergence, so healing propagates from both directions of a
+   partition without waiting out either side's interval.)
+2. Host-side `diff_merkle_trees` per owner whose serialized trees
+   differ → the earliest diverged minute → a 46-char sync timestamp
+   (`create_sync_timestamp`, the same range cursor the client sync
+   path uses).
+3. `POST /replicate/pull` with the (owner, since) list (chunked at
+   `PULL_OWNERS_PER_REQUEST`); the peer answers every stored message
+   after `since` per owner — NO node exclusion (a relay is not a
+   message author) — plus its tree string at fetch time.
+4. Ingest as ordinary `SyncRequest`s: through the PR-2 continuous-
+   batching scheduler when the relay runs one (submitted concurrently
+   so replication traffic COALESCES with live client traffic into the
+   same fused `BatchReconciler.run_batch_wire` passes — one device
+   pass covers a whole peer's diverged owner set via the engine's
+   `deltas_dispatch`/`owner_minute_deltas` kernels), else through the
+   per-request `serve_single_request` path. Either way the request's
+   `merkle_tree` field carries the PEER's tree, so a fully-healed
+   owner's response is empty — the serve leg stays divergence-bounded
+   too. Idempotence is the store's own INSERT OR IGNORE + changes==1
+   XOR gate: re-pulling an overlapping range can never double-XOR a
+   tree.
+
+Failure handling: offline peers get bounded exponential backoff with
+jitter (the PR-2 client backoff shape — `sync/client.py` constants;
+`_http_post` itself already retries 429/503/connection blips inside a
+round), a per-peer health gauge, and automatic recovery on the first
+successful round. The relay stays E2EE-blind throughout: rows are
+(timestamp, userId, ciphertext), trees are digests of timestamps.
+
+Observability (docs/OBSERVABILITY.md): rounds/failures/owners-diffed/
+messages-pulled counters per (replica, peer), messages-served on the
+answering side, a convergence-lag histogram (first divergence
+observation → first fully-converged round), and a health gauge —
+surfaced by `GET /metrics` and the `replication` section of
+`GET /stats`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from evolu_tpu.core.merkle import diff_merkle_trees, merkle_tree_from_string
+from evolu_tpu.core.timestamp import (
+    SYNC_NODE_ID,
+    create_sync_timestamp,
+    timestamp_to_string,
+)
+from evolu_tpu.obs import metrics
+from evolu_tpu.sync import protocol
+from evolu_tpu.utils.log import log
+
+# One pull POST covers at most this many owners — bounds request bodies
+# (the relay's 20 MB cap applies to peers too) without bounding a
+# round's total coverage.
+PULL_OWNERS_PER_REQUEST = 256
+
+# serve_pull bounds what one response materializes: at most this many
+# messages per owner (the EARLIEST of the range — ingesting them
+# advances the diff minute, so the next round's pull resumes exactly
+# where this one stopped) and per response in total (owners past the
+# budget are omitted entirely). A truncated pull leaves the puller's
+# tree differing from the peer's, which re-arms the post-pull hint —
+# deep catch-ups proceed incrementally at debounce cadence instead of
+# livelocking on one response too large to build or ship inside a
+# socket timeout. The engine's batch-bucket shapes stay bounded too.
+PULL_MESSAGES_PER_OWNER = 8192
+PULL_MESSAGES_PER_RESPONSE = 65536
+
+
+def owner_tree_map(store) -> List[Tuple[str, str]]:
+    """Every owner the store knows, with its STORED tree text verbatim
+    (no parse→re-dump; both sides write trees via
+    `merkle_tree_to_string`, so string equality IS tree equality). ONE
+    bulk query where the store offers it — per-owner reads are N+1
+    SELECTs per round; the fallback serves generic stores."""
+    if hasattr(store, "owner_trees"):
+        return store.owner_trees()
+    return [(u, store.get_merkle_tree_string(u)) for u in store.user_ids()]
+
+
+def serve_summary(store, body: bytes, manager: Optional["ReplicationManager"]) -> bytes:
+    """Handler body for `POST /replicate/summary`: decode the caller's
+    summary, arm the local manager's debounced hint if the caller
+    advertises anything we diverge from (heal flows both ways), and
+    answer with OUR summary. ONE store scan serves both the divergence
+    check and the response. Raises ValueError only on malformed input
+    (the wire-decoder contract — the handler maps it to 400)."""
+    incoming = protocol.decode_replica_summary(body)
+    mine = owner_tree_map(store)
+    if manager is not None:
+        by_owner = dict(mine)
+        # "{}" is what get_merkle_tree_string answers for an unseen
+        # owner — an owner we lack entirely is divergence too.
+        if any(by_owner.get(uid, "{}") != tree for uid, tree in incoming.trees):
+            manager.hint()
+    return protocol.encode_replica_summary(
+        protocol.ReplicaSummary(
+            tuple(mine), manager.replica_id if manager is not None else ""
+        )
+    )
+
+
+def serve_pull(store, body: bytes) -> bytes:
+    """Handler body for `POST /replicate/pull`: ranged per-owner reads
+    (strictly after `since`, every node's messages, earliest-first and
+    capped — see PULL_MESSAGES_PER_OWNER) + the tree string at fetch
+    time. Owners past the response budget are omitted; the puller's
+    convergence check treats them as still-diverged and the next round
+    resumes. ValueError only on malformed input."""
+    req = protocol.decode_replica_pull(body)
+    chunks = []
+    served = 0
+    for uid, since in req.pulls:
+        if served >= PULL_MESSAGES_PER_RESPONSE:
+            break
+        msgs = store.replica_messages(
+            uid, since,
+            min(PULL_MESSAGES_PER_OWNER, PULL_MESSAGES_PER_RESPONSE - served),
+        )
+        served += len(msgs)
+        chunks.append(
+            protocol.OwnerMessages(uid, msgs, store.get_merkle_tree_string(uid))
+        )
+    # Unlabeled on purpose: the wire `replica_id` is untrusted input —
+    # minting a metric label per distinct value would let any caller
+    # grow the registry without bound. Per-peer breakdowns live on the
+    # PULLING side's counters, whose labels come from configuration.
+    metrics.inc("evolu_repl_messages_served_total", served)
+    return protocol.encode_replica_pull_response(protocol.ReplicaPullResponse(tuple(chunks)))
+
+
+class _ManagerStopping(Exception):
+    """Raised between a round's HTTP legs once stop() is underway: the
+    round aborts promptly (idempotence makes a half-ingested round
+    safe) instead of holding the loop thread through more socket
+    timeouts while the server tears down."""
+
+
+class _Peer:
+    """Per-peer gossip state machine: due time, consecutive-failure
+    count driving the bounded backoff, and the first-divergence mark
+    feeding the convergence-lag histogram."""
+
+    __slots__ = ("url", "failures", "next_due", "diverged_since")
+
+    def __init__(self, url: str, now: float):
+        self.url = url.rstrip("/")
+        self.failures = 0
+        self.next_due = now  # gossip immediately on start
+        self.diverged_since: Optional[float] = None
+
+
+class ReplicationManager:
+    """Owns the gossip loop for one relay: a background thread runs a
+    round against each peer when due (periodic `interval_s`, pulled
+    earlier by `hint()` after local writes, pushed later by backoff
+    after failures). `run_once()` runs one synchronous round against
+    every peer on the calling thread — the unit-test / bench surface.
+
+    `http_post` is injectable (fault-injection tests partition the
+    cluster by raising from it); the default is the PR-2 client
+    transport `sync.client._http_post` with `retries=0`: the
+    round-level peer backoff owns ALL retry pacing — inner transport
+    retries would multiply a black-holed peer's socket timeout on the
+    single loop thread, head-of-line-blocking gossip to every healthy
+    peer."""
+
+    def __init__(
+        self,
+        store,
+        peers: Sequence[str],
+        replica_id: Optional[str] = None,
+        scheduler=None,
+        interval_s: float = 30.0,
+        debounce_s: float = 0.05,
+        backoff_base_s: Optional[float] = None,
+        backoff_max_s: float = 30.0,
+        http_post: Optional[Callable[[str, bytes], bytes]] = None,
+        rng=None,
+        pull_chunk: int = PULL_OWNERS_PER_REQUEST,
+    ):
+        import functools
+        import random
+
+        from evolu_tpu.sync.client import BACKOFF_BASE_S, _http_post
+
+        self.store = store
+        self.scheduler = scheduler
+        self.replica_id = replica_id or f"relay-{random.getrandbits(48):012x}"
+        self.interval_s = float(interval_s)
+        self.debounce_s = float(debounce_s)
+        self.backoff_base_s = (
+            BACKOFF_BASE_S if backoff_base_s is None else float(backoff_base_s)
+        )
+        self.backoff_max_s = float(backoff_max_s)
+        self.pull_chunk = int(pull_chunk)
+        self._post = http_post or functools.partial(_http_post, retries=0)
+        self._rng = rng or random.random
+        now = time.monotonic()
+        self._peers = [_Peer(u, now) for u in peers]
+        self._cv = threading.Condition()
+        self._hint_at: Optional[float] = None
+        self._stopping = False
+        self._thread: Optional[threading.Thread] = None
+        self._pool = None
+        metrics.set_gauge("evolu_repl_peers", len(self._peers), replica=self.replica_id)
+        for p in self._peers:
+            metrics.set_gauge(
+                "evolu_repl_peer_healthy", 1, replica=self.replica_id, peer=p.url
+            )
+
+    # -- lifecycle --
+
+    def start(self) -> "ReplicationManager":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="evolu-replicate"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Idempotent; joins the loop thread. `_post_checked` aborts an
+        in-flight round at its next HTTP leg, so the join normally
+        returns within one socket timeout. If a leg is still blocked
+        past the timeout, the daemon thread is left to finish on its
+        own — the pool is NOT torn from under it (`_ingest_pool`
+        refuses new work while stopping), and a subsequent store close
+        surfaces as a clean closed-database error inside `_round`'s
+        failure handling, never a crash."""
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=35.0)
+            if self._thread.is_alive():
+                log("server", "replication loop still blocked at stop; "
+                    "leaving the daemon thread", replica=self.replica_id)
+                return
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def add_peer(self, url: str) -> None:
+        """Register a peer after construction (mutual peering needs
+        both relays' URLs, which only exist once both servers bind —
+        tests and dynamic topologies use this). Gossips immediately."""
+        with self._cv:
+            p = _Peer(url, time.monotonic())
+            self._peers.append(p)
+            metrics.set_gauge(
+                "evolu_repl_peers", len(self._peers), replica=self.replica_id
+            )
+            metrics.set_gauge(
+                "evolu_repl_peer_healthy", 1, replica=self.replica_id, peer=p.url
+            )
+            self._cv.notify()
+
+    def hint(self) -> None:
+        """Debounced write hint: a burst of local writes (or a peer's
+        summary showing divergence) coalesces into ONE early gossip
+        sweep `debounce_s` after the first hint. Peers in failure
+        backoff are NOT pulled forward — hints must not defeat the
+        bounded backoff."""
+        with self._cv:
+            if self._stopping:
+                return
+            if self._hint_at is None:
+                self._hint_at = time.monotonic() + self.debounce_s
+                metrics.inc("evolu_repl_hints_total", replica=self.replica_id)
+                self._cv.notify()
+
+    # -- the loop --
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                due: List[_Peer] = []
+                while not self._stopping:
+                    now = time.monotonic()
+                    if self._hint_at is not None and now >= self._hint_at:
+                        self._hint_at = None
+                        for p in self._peers:
+                            if p.failures == 0:
+                                p.next_due = now
+                    due = [p for p in self._peers if p.next_due <= now]
+                    if due:
+                        break
+                    wakes = [p.next_due for p in self._peers]
+                    if self._hint_at is not None:
+                        wakes.append(self._hint_at)
+                    # Cap the sleep so a long interval (or an empty
+                    # peer set — peers may be added later) still
+                    # notices stop() promptly even without a notify.
+                    wake_in = (min(wakes) - now) if wakes else 5.0
+                    self._cv.wait(timeout=max(0.0, min(wake_in, 5.0)))
+                if self._stopping:
+                    return
+            for p in due:
+                with self._cv:
+                    if self._stopping:
+                        return
+                self._round(p)
+
+    def run_once(self) -> None:
+        """One synchronous gossip round against every peer, on the
+        calling thread (ignores due times; respects nothing else of the
+        loop's pacing). Unit-test / bench / embedding surface."""
+        for p in self._peers:
+            self._round(p)
+
+    def _post_checked(self, url: str, body: bytes) -> bytes:
+        """The round's transport, with a stop check before each leg —
+        a multi-leg round against a black-holing peer must not hold
+        stop() through every remaining socket timeout."""
+        if self._stopping:
+            raise _ManagerStopping()
+        return self._post(url, body)
+
+    def _round(self, peer: _Peer) -> None:
+        labels = {"replica": self.replica_id, "peer": peer.url}
+        try:
+            converged, pulled = self._gossip(peer)
+        except _ManagerStopping:
+            return  # tearing down — not a peer failure
+        except Exception as e:  # noqa: BLE001 - a peer failure must
+            # never kill the loop: count, mark unhealthy, back off.
+            peer.failures += 1
+            metrics.inc("evolu_repl_peer_failures_total", **labels)
+            metrics.inc("evolu_repl_rounds_total", result="error", **labels)
+            metrics.set_gauge("evolu_repl_peer_healthy", 0, **labels)
+            # Bounded exponential backoff + jitter (the PR-2 shape):
+            # delay ∈ [0.5, 1.0] × min(max, base·2^failures) — never
+            # zero, so a dead peer cannot be hammered in a hot loop.
+            delay = min(
+                self.backoff_max_s, self.backoff_base_s * (2 ** min(peer.failures, 20))
+            ) * (0.5 + 0.5 * self._rng())
+            peer.next_due = time.monotonic() + delay
+            log("server", "replication round failed", peer=peer.url,
+                error=repr(e), failures=peer.failures, retry_s=round(delay, 3))
+            return
+        peer.failures = 0
+        metrics.inc("evolu_repl_rounds_total", result="ok", **labels)
+        metrics.set_gauge("evolu_repl_peer_healthy", 1, **labels)
+        if converged and peer.diverged_since is not None:
+            metrics.observe(
+                "evolu_repl_convergence_lag_ms",
+                (time.monotonic() - peer.diverged_since) * 1e3,
+                **labels,
+            )
+            peer.diverged_since = None
+        peer.next_due = time.monotonic() + self.interval_s
+        if pulled:
+            # Freshly pulled rows may need to travel further (chain
+            # topologies — A↔B↔C with no A↔C edge): arm the debounced
+            # hint so the next hop leaves at debounce latency, not
+            # interval latency. A converged mesh pulls nothing, so the
+            # hint chain terminates.
+            self.hint()
+
+    # -- one gossip round --
+
+    def _gossip(self, peer: _Peer) -> Tuple[bool, int]:
+        """Summary exchange → per-owner diff → ranged pull → ingest.
+        → (converged, messages_pulled): converged is True when this
+        round ends with every advertised owner byte-identical to the
+        peer's snapshot (convergence for lag accounting; the peer may
+        of course write more afterwards)."""
+        labels = {"replica": self.replica_id, "peer": peer.url}
+        local = dict(owner_tree_map(self.store))  # ONE bulk read
+        mine = protocol.ReplicaSummary(tuple(local.items()), self.replica_id)
+        resp = protocol.decode_replica_summary(
+            self._post_checked(peer.url + "/replicate/summary", protocol.encode_replica_summary(mine))
+        )
+        diverged: List[Tuple[str, str]] = []  # (owner, since)
+        for uid, peer_tree_s in resp.trees:
+            # Compare and diff the SAME bulk snapshot — no per-owner
+            # re-reads (N+1 on a converged mesh), and no chance of
+            # diffing a different tree than the one compared. A local
+            # write landing mid-round at worst re-pulls rows the
+            # ingest's INSERT OR IGNORE already holds — idempotent.
+            local_s = local.get(uid, "{}")
+            if local_s == peer_tree_s:
+                continue
+            diff = diff_merkle_trees(
+                merkle_tree_from_string(local_s),
+                merkle_tree_from_string(peer_tree_s),
+            )
+            if diff is None:
+                continue  # hash-equal roots; nothing to pull
+            diverged.append((uid, timestamp_to_string(create_sync_timestamp(diff))))
+        if not diverged:
+            return True, 0
+        if peer.diverged_since is None:
+            peer.diverged_since = time.monotonic()
+        metrics.inc("evolu_repl_owners_diffed_total", len(diverged), **labels)
+        log("server", "replication divergence", peer=peer.url, owners=len(diverged))
+
+        peer_tree_at_pull = {}
+        requests: List[protocol.SyncRequest] = []
+        pulled = 0
+        for i in range(0, len(diverged), self.pull_chunk):
+            chunk = diverged[i : i + self.pull_chunk]
+            pull = protocol.ReplicaPull(tuple(chunk), self.replica_id)
+            pr = protocol.decode_replica_pull_response(
+                self._post_checked(peer.url + "/replicate/pull", protocol.encode_replica_pull(pull))
+            )
+            for om in pr.chunks:
+                peer_tree_at_pull[om.user_id] = om.merkle_tree
+                pulled += len(om.messages)
+                if om.messages:
+                    # The peer's tree rides as the request's client
+                    # tree: once ingest makes our tree equal it, the
+                    # serve diff is None and the (discarded) response
+                    # is empty — the serve leg stays divergence-bounded.
+                    requests.append(
+                        protocol.SyncRequest(
+                            om.messages, om.user_id, SYNC_NODE_ID, om.merkle_tree
+                        )
+                    )
+        metrics.inc("evolu_repl_messages_pulled_total", pulled, **labels)
+        self._ingest(requests)
+        converged = all(
+            self.store.get_merkle_tree_string(uid)
+            == peer_tree_at_pull.get(uid, object())
+            for uid, _since in diverged
+        )
+        return converged, pulled
+
+    def _ingest(self, requests: List[protocol.SyncRequest]) -> None:
+        """Apply pulled messages through the relay's OWN serving paths
+        (never a raw insert — the changes==1 Merkle gate and the
+        non-canonical host-oracle routing must apply to replication
+        exactly as to clients). With a scheduler the requests are
+        submitted CONCURRENTLY so the dispatcher coalesces them — with
+        each other and with live client traffic — into fused
+        `run_batch_wire` engine passes; without one they take the
+        per-request path handler threads use."""
+        if not requests:
+            return
+        if self.scheduler is not None:
+            futures = [
+                self._ingest_pool().submit(self.scheduler.submit, r) for r in requests
+            ]
+            first_err: Optional[BaseException] = None
+            for f in futures:
+                e = f.exception()
+                first_err = first_err or e
+            if first_err is not None:
+                raise first_err
+            return
+        from evolu_tpu.server.relay import serve_single_request
+
+        for r in requests:
+            serve_single_request(self.store, r)
+
+    def _ingest_pool(self):
+        if self._stopping:
+            # Never mint a fresh executor during teardown: stop() has
+            # (or will have) shut the pool down, and a new one here
+            # would leak.
+            raise _ManagerStopping()
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._pool = ThreadPoolExecutor(
+                max_workers=8, thread_name_prefix="evolu-repl-ingest"
+            )
+        return self._pool
+
+    # -- observability --
+
+    def stats_payload(self) -> dict:
+        """The `replication` section of GET /stats: per-peer health +
+        the per-(replica, peer) counters from the process registry."""
+        peers = []
+        for p in self._peers:
+            labels = {"replica": self.replica_id, "peer": p.url}
+            peers.append({
+                "url": p.url,
+                "healthy": p.failures == 0,
+                "failures": p.failures,
+                "rounds_ok": metrics.get_counter(
+                    "evolu_repl_rounds_total", result="ok", **labels
+                ),
+                "rounds_error": metrics.get_counter(
+                    "evolu_repl_rounds_total", result="error", **labels
+                ),
+                "owners_diffed": metrics.get_counter(
+                    "evolu_repl_owners_diffed_total", **labels
+                ),
+                "messages_pulled": metrics.get_counter(
+                    "evolu_repl_messages_pulled_total", **labels
+                ),
+                "convergence_lag_p99_ms": metrics.quantile(
+                    "evolu_repl_convergence_lag_ms", 0.99, **labels
+                ),
+            })
+        return {"replica_id": self.replica_id, "peers": peers}

@@ -293,6 +293,155 @@ def encode_sync_response(r: SyncResponse) -> bytes:
     return out + _string(2, r.merkle_tree)
 
 
+# --- relay↔relay replication messages (extension — no reference
+# equivalent; the reference relay is a single node). Same hand-rolled
+# proto3 subset, same decoder error contract (ValueError only), and the
+# same E2EE-blindness: nothing here ever carries plaintext — owners are
+# ids, trees are JSON digests of timestamps, messages stay
+# (timestamp, ciphertext). See evolu_tpu/server/replicate.py. ---
+#
+#     OwnerTree           { userId=1 merkleTree=2 }
+#     ReplicaSummary      { owners=1 (repeated OwnerTree) replicaId=2 }
+#     OwnerPull           { userId=1 since=2 }
+#     ReplicaPull         { pulls=1 (repeated OwnerPull) replicaId=2 }
+#     OwnerMessages       { userId=1 messages=2 (repeated
+#                           EncryptedCrdtMessage) merkleTree=3 }
+#     ReplicaPullResponse { chunks=1 (repeated OwnerMessages) }
+
+
+@dataclass(frozen=True)
+class ReplicaSummary:
+    """One side of a gossip exchange: every owner this relay stores,
+    with its serialized Merkle tree. Sent as the `/replicate/summary`
+    request body (the caller's summary) AND returned as its response
+    (the callee's) — divergence is computable from either side."""
+
+    trees: Tuple[Tuple[str, str], ...]  # (owner id, merkle tree string)
+    replica_id: str
+
+
+@dataclass(frozen=True)
+class ReplicaPull:
+    """Ranged fetch: per owner, every message strictly after `since`
+    (a 46-char sync timestamp at the diverged minute). No node
+    exclusion — a relay is not a message author; it needs all rows."""
+
+    pulls: Tuple[Tuple[str, str], ...]  # (owner id, since timestamp string)
+    replica_id: str
+
+
+@dataclass(frozen=True)
+class OwnerMessages:
+    user_id: str
+    messages: Tuple[EncryptedCrdtMessage, ...]
+    merkle_tree: str  # the serving relay's tree at fetch time
+
+
+@dataclass(frozen=True)
+class ReplicaPullResponse:
+    chunks: Tuple[OwnerMessages, ...]
+
+
+def encode_replica_summary(s: ReplicaSummary) -> bytes:
+    out = b"".join(
+        _len_delimited(1, _string(1, uid) + _string(2, tree)) for uid, tree in s.trees
+    )
+    return out + _string(2, s.replica_id)
+
+
+@_wire_decoder
+def _decode_owner_tree(data: bytes) -> Tuple[str, str]:
+    uid = tree = ""
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            uid = v.decode("utf-8")
+        elif num == 2:
+            tree = v.decode("utf-8")
+    return uid, tree
+
+
+@_wire_decoder
+def decode_replica_summary(data: bytes) -> ReplicaSummary:
+    trees: List[Tuple[str, str]] = []
+    replica_id = ""
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            if wt != 2:
+                raise ValueError(f"owner tree field has wire type {wt}")
+            trees.append(_decode_owner_tree(v))
+        elif num == 2:
+            replica_id = v.decode("utf-8")
+    return ReplicaSummary(tuple(trees), replica_id)
+
+
+def encode_replica_pull(p: ReplicaPull) -> bytes:
+    out = b"".join(
+        _len_delimited(1, _string(1, uid) + _string(2, since)) for uid, since in p.pulls
+    )
+    return out + _string(2, p.replica_id)
+
+
+@_wire_decoder
+def decode_replica_pull(data: bytes) -> ReplicaPull:
+    pulls: List[Tuple[str, str]] = []
+    replica_id = ""
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            if wt != 2:
+                raise ValueError(f"owner pull field has wire type {wt}")
+            pulls.append(_decode_owner_tree(v))  # same (string=1, string=2) shape
+        elif num == 2:
+            replica_id = v.decode("utf-8")
+    return ReplicaPull(tuple(pulls), replica_id)
+
+
+def encode_owner_messages(om: OwnerMessages) -> bytes:
+    out = _string(1, om.user_id)
+    out += b"".join(_len_delimited(2, encode_encrypted_message(m)) for m in om.messages)
+    return out + _string(3, om.merkle_tree)
+
+
+@_wire_decoder
+def decode_owner_messages(data: bytes) -> OwnerMessages:
+    uid = tree = ""
+    messages: List[EncryptedCrdtMessage] = []
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            uid = v.decode("utf-8")
+        elif num == 2:
+            if wt != 2:
+                raise ValueError(f"messages field has wire type {wt}")
+            messages.append(decode_encrypted_message(v))
+        elif num == 3:
+            tree = v.decode("utf-8")
+    return OwnerMessages(uid, tuple(messages), tree)
+
+
+def encode_replica_pull_response(r: ReplicaPullResponse) -> bytes:
+    return b"".join(_len_delimited(1, encode_owner_messages(c)) for c in r.chunks)
+
+
+@_wire_decoder
+def decode_replica_pull_response(data: bytes) -> ReplicaPullResponse:
+    chunks: List[OwnerMessages] = []
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            if wt != 2:
+                raise ValueError(f"owner messages field has wire type {wt}")
+            chunks.append(decode_owner_messages(v))
+    return ReplicaPullResponse(tuple(chunks))
+
+
 @_wire_decoder
 def decode_sync_response(data: bytes) -> SyncResponse:
     messages: List[EncryptedCrdtMessage] = []

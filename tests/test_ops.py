@@ -616,3 +616,17 @@ def test_millis_u32_fast_path_matches_i64_at_boundaries():
                               u64_to_node_hex(int(node[i])))
                 ) & 0xFFFFFFFF
                 assert int(got[i]) == want, (name, i, int(millis[i]))
+
+
+def test_u32_divmod_overflow_guard_is_a_real_exception():
+    """The intermediate-overflow precondition of `u32_divmod_hi_lo`
+    must raise ValueError — not assert — so the guard survives
+    `python -O` (ADVICE r5). 86_400_000 is the canonical offender:
+    r32 = 61_367_296, and 999·r32 + (d-1) overflows u32."""
+    import numpy as np
+    import pytest
+
+    from evolu_tpu.ops.encode import u32_divmod_hi_lo
+
+    with pytest.raises(ValueError, match="overflows the u32 chain"):
+        u32_divmod_hi_lo(np.zeros(4, np.int64), 86_400_000)

@@ -686,3 +686,55 @@ def test_packed_owner_kernel_matches_wide_kernel():
     big_owner = {"cell_id": np.array([1], np.int32),
                  "owner_ix": np.array([4095], np.int64)}
     assert shard_kernel_for(big_owner) is _shard_kernel_wide
+
+
+def test_run_batch_wire_on_generic_store_without_db_handle():
+    """A store exposing only the RelayStore METHOD surface (no `.db`
+    SQL handle at all) must route through the object-respond fallback
+    instead of raising AttributeError (ADVICE r5) — byte-identical to
+    a real RelayStore served the same batch."""
+    from evolu_tpu.server.engine import BatchReconciler
+    from evolu_tpu.server.relay import RelayStore
+    from evolu_tpu.sync import protocol
+
+    class GenericStore:
+        """Method-only facade over a private RelayStore."""
+
+        def __init__(self):
+            self._inner = RelayStore()
+
+        def add_messages(self, user_id, messages):
+            return self._inner.add_messages(user_id, messages)
+
+        def get_messages(self, user_id, node_id, server_tree, client_tree):
+            return self._inner.get_messages(user_id, node_id, server_tree, client_tree)
+
+        def get_merkle_tree(self, user_id):
+            return self._inner.get_merkle_tree(user_id)
+
+        def close(self):
+            self._inner.close()
+
+    def enc(msgs):
+        return tuple(
+            protocol.EncryptedCrdtMessage(m.timestamp, b"ct-" + m.timestamp.encode())
+            for m in msgs
+        )
+
+    owners = {f"g{i}": _mk_messages(f"{i + 3:016x}", 15 + i) for i in range(4)}
+    push = [
+        _sync_req(o, msgs[0].timestamp[30:46], enc(msgs)) for o, msgs in owners.items()
+    ]
+    cold = [_sync_req(o, "e" * 16) for o in owners]
+
+    ref_store, gen_store = RelayStore(), GenericStore()
+    ref_eng = BatchReconciler(ref_store, create_mesh())
+    gen_eng = BatchReconciler(gen_store, create_mesh())
+    try:
+        for batch in (push, cold):
+            want = ref_eng.run_batch_wire(batch)
+            got = gen_eng.run_batch_wire(batch)
+            assert got == want
+    finally:
+        ref_eng.close(), gen_eng.close()
+        ref_store.close(), gen_store.close()

@@ -511,3 +511,59 @@ def test_disable_adaptive_while_streaming_reseeds_safely():
         assert db.exec_sql_query('SELECT COUNT(*) AS n FROM "__message"') == [{"n": 12}]
     finally:
         db.close()
+
+
+class _PbStub:
+    """The minimal PackedReceive surface `plan_packed` touches before
+    the seed branch (n, parse_timestamps, touched_cells, cells,
+    cell_id) — enough to drive the adaptive gate without native
+    crypto."""
+
+    def __init__(self, messages):
+        from evolu_tpu.ops.host_parse import intern_cells
+
+        self.n = len(messages)
+        self._ts = [m.timestamp for m in messages]
+        self.cell_id, self.cells = intern_cells(
+            [m.table for m in messages], [m.row for m in messages],
+            [m.column for m in messages],
+        )
+
+    def parse_timestamps(self):
+        from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+        return parse_timestamp_strings(self._ts, with_case=True)
+
+    def touched_cells(self):
+        ids = np.unique(self.cell_id)
+        return ids, [self.cells[int(i)] for i in ids]
+
+
+def test_plan_packed_seed_failure_samples_ewma_once(monkeypatch):
+    """A non-canonical stored-winner seed bounces `plan_packed` to the
+    object path, which re-enters the adaptive gate via `plan_batch` for
+    the SAME batch — the bounce must arm `_skip_ewma_once` so the gate
+    samples the EWMA exactly once per batch (ADVICE r5)."""
+    db = _db()
+    # adaptive=False pins the gate to the cached route (a fresh
+    # adaptive cache's first all-new batch would stream instead of
+    # seeding); the EWMA is still sampled on every gate entry, which is
+    # exactly the behavior under test.
+    cache = DeviceWinnerCache(db, capacity=64, adaptive=False)
+    msgs = tuple(_mk(i) for i in range(40))
+    try:
+        monkeypatch.setattr(
+            DeviceWinnerCache, "_seed_new_cells", lambda self, cells: False
+        )
+        assert cache.plan_packed(_PbStub(msgs)) is None
+        assert cache._skip_ewma_once, "bounce did not arm the one-shot skip"
+        ewma_after_packed = cache._seed_ewma
+        monkeypatch.setattr(
+            DeviceWinnerCache, "_host_fallback", lambda self, m, c: "HOST"
+        )
+        assert cache.plan_batch(msgs) == "HOST"
+        assert cache._seed_ewma == ewma_after_packed, (
+            "object-path re-route sampled the EWMA a second time"
+        )
+    finally:
+        db.close()
