@@ -1,0 +1,99 @@
+"""Capture a jax.profiler trace of the merge kernel pipeline (ISSUE 4,
+VERDICT #7): one warm + N profiled reconcile dispatches through BOTH
+plan formulations (sort and scatter shard kernels), with the span
+trace-annotations enabled so host-side phases appear in the timeline
+under the same `kernel:*` target names the log/metrics surfaces use.
+
+Usage: python benchmarks/kernel_trace.py [outdir]
+Default outdir: docs/traces/kernel_pipeline (the checked-in evidence
+base for the BENCHMARKS.md anatomy claims — the device rows show the
+sort/scan vs scatter/gather op mix directly).
+
+Prints one JSON line: {outdir, platform, iters, per-variant wall ms}.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+
+jax.config.update("jax_enable_x64", True)
+
+from evolu_tpu.utils.log import enable_trace_annotations, span
+
+N = int(os.environ.get("TRACE_N", 1 << 14))
+ITERS = int(os.environ.get("TRACE_ITERS", 2))
+# Checked-in evidence: keep the perfetto .trace.json.gz (human-viewable
+# at ui.perfetto.dev) and DROP the raw .xplane.pb, which is 20-25× the
+# size (TensorBoard's source form; regenerate locally when needed).
+KEEP_XPLANE = os.environ.get("TRACE_KEEP_XPLANE") == "1"
+
+
+def main():
+    import bench
+    from evolu_tpu.ops import to_host_many
+    from evolu_tpu.ops.merge import _PAD_CELL
+    from evolu_tpu.ops.scatter_merge import table_size_for
+    from evolu_tpu.parallel.mesh import create_mesh, sharding
+    from evolu_tpu.parallel.reconcile import (
+        _compiled_kernel,
+        _shard_kernel,
+        scatter_shard_kernel,
+    )
+
+    outdir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "traces", "kernel_pipeline",
+    )
+    enable_trace_annotations(True)
+    mesh = create_mesh()
+    n_dev = mesh.devices.size
+    cols, _ = bench.shard_layout(
+        bench.build_columns(n=N, owners=256, stored_winners=True), n_dev
+    )
+    real = cols["cell_id"] != int(_PAD_CELL)
+    table = table_size_for(int(cols["cell_id"].max(initial=0, where=real)))
+    shd = sharding(mesh)
+    names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
+    variants = {
+        "sort": _compiled_kernel(mesh, _shard_kernel),
+        "scatter": _compiled_kernel(mesh, scatter_shard_kernel(table)),
+    }
+    walls = {}
+    with jax.enable_x64(True):
+        args = [jax.device_put(cols[k], shd) for k in names]
+        for label, fn in variants.items():
+            to_host_many(*fn(*args))  # compile + warm outside the trace
+        with jax.profiler.trace(outdir):
+            for label, fn in variants.items():
+                t0 = time.perf_counter()
+                for _ in range(ITERS):
+                    with span("kernel:reconcile", f"trace:{label}", n=N):
+                        to_host_many(*fn(*args))
+                walls[label] = round((time.perf_counter() - t0) / ITERS * 1e3, 2)
+    if not KEEP_XPLANE:
+        for root, _dirs, files in os.walk(outdir):
+            for f in files:
+                if f.endswith(".xplane.pb"):
+                    os.unlink(os.path.join(root, f))
+    print(json.dumps({
+        "outdir": outdir,
+        "platform": jax.devices()[0].platform,
+        "n": N,
+        "iters": ITERS,
+        # NOTE: walls here are measured UNDER the profiler and are
+        # heavily inflated for op-dense graphs (the CPU scatter
+        # lowering emits orders of magnitude more trace events than
+        # the sort) — the honest wall numbers are the slope method in
+        # benchmarks/scatter_vs_sort.py; this tool is for ANATOMY.
+        "wall_ms_per_dispatch_under_profiler": walls,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,7 @@ Everything here is shape-static and jit-compiled once per bucket size;
 
 from __future__ import annotations
 
+import functools
 from typing import Dict, List, Sequence, Tuple
 
 import jax
@@ -37,6 +38,7 @@ import operator
 
 from evolu_tpu.core.timestamp import timestamp_from_string
 from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.obs import metrics
 from evolu_tpu.ops import bucket_size, to_host_many, with_x64
 from evolu_tpu.ops.encode import node_hex_to_u64, pack_ts_key_host
 from evolu_tpu.utils.log import span
@@ -550,15 +552,26 @@ def _host_fallback(messages, existing_winners, n, with_deltas=False):
 
 
 def _plan_batch_device_timed(messages, existing_winners):
+    from evolu_tpu.ops.scatter_merge import plan_masks_scatter, scatter_table_for
+
     n = len(messages)
     cell_ids, k1, k2, ex_k1, ex_k2, *rest = messages_to_columns(messages, existing_winners)
     if not rest[-1]:  # canonical flag
         return None
+    table_size = scatter_table_for(cell_ids, k1, k2)
     (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns([cell_ids, k1, k2, ex_k1, ex_k2], n)
-    xor_mask, upsert_mask = to_host_many(*plan_merge(
-        jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
-        jnp.asarray(ex_k1), jnp.asarray(ex_k2), num_segments=size,
-    ))
+    if table_size is not None:
+        metrics.inc("evolu_merge_plan_total", path="scatter")
+        xor_mask, upsert_mask = to_host_many(*plan_masks_scatter(
+            jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
+            jnp.asarray(ex_k1), jnp.asarray(ex_k2), table_size=table_size,
+        ))
+    else:
+        metrics.inc("evolu_merge_plan_total", path="sort")
+        xor_mask, upsert_mask = to_host_many(*plan_merge(
+            jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
+            jnp.asarray(ex_k1), jnp.asarray(ex_k2), num_segments=size,
+        ))
     return xor_mask[:n].tolist(), select_messages(messages, upsert_mask[:n])
 
 
@@ -579,6 +592,31 @@ def _plan_full_kernel(cell_id, k1, k2, ex_k1, ex_k2):
         zero_owner, millis_s, hashes, xor_s
     )
     return xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid_sorted
+
+
+@functools.partial(jax.jit, static_argnames=("table_size",))
+def _plan_full_kernel_scatter(cell_id, k1, k2, ex_k1, ex_k2, table_size):
+    """Sort-free twin of `_plan_full_kernel` (ops/scatter_merge.py):
+    the LWW masks come from the dense scatter-argmax plan in ORIGINAL
+    batch order (i_s is the identity — `unpermute_masks` degenerates
+    to a copy), and the minute segmentation consumes the original-
+    order columns directly (its own tile-local grouping sort is
+    order-free — every decoder XOR-merges per key). Same 7-output
+    contract; host-level results are bit-identical to the sorted
+    kernel wherever the router admits a batch (property-pinned)."""
+    from evolu_tpu.ops.encode import timestamp_hashes, unpack_ts_keys
+    from evolu_tpu.ops.merkle_ops import owner_minute_segments
+    from evolu_tpu.ops.scatter_merge import scatter_plan_masks
+
+    xor_m, upsert_m = scatter_plan_masks(cell_id, k1, k2, ex_k1, ex_k2, table_size)
+    i_s = jnp.arange(cell_id.shape[0], dtype=jnp.int32)
+    millis, counter = unpack_ts_keys(k1)
+    hashes = jnp.where(xor_m, timestamp_hashes(millis, counter, k2), jnp.uint32(0))
+    zero_owner = jnp.zeros((), jnp.int32)
+    _, minute_sorted, seg_end, seg_xor, valid_sorted = owner_minute_segments(
+        zero_owner, millis, hashes, xor_m
+    )
+    return xor_m, upsert_m, i_s, minute_sorted, seg_end, seg_xor, valid_sorted
 
 
 def plan_packed_streamed(db, pb, millis, counter, node, cells, touched_ids):
@@ -614,14 +652,26 @@ def _run_full_plan(cell_ids, k1, k2, ex_k1, ex_k2, n: int):
     batch order, length n. Callers hold the x64 scope and have already
     verified the canonical-case invariant."""
     from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas
+    from evolu_tpu.ops.scatter_merge import scatter_table_for
 
+    # Admission + table sizing in one pre-pad pass (pad rows use the
+    # dump slot, never the table).
+    table_size = scatter_table_for(cell_ids, k1, k2)
     (cell_ids, k1, k2, ex_k1, ex_k2), size = pad_columns(
         [cell_ids, k1, k2, ex_k1, ex_k2], n
     )
-    outs = _plan_full_kernel(
-        jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
-        jnp.asarray(ex_k1), jnp.asarray(ex_k2),
-    )
+    if table_size is not None:
+        metrics.inc("evolu_merge_plan_total", path="scatter")
+        outs = _plan_full_kernel_scatter(
+            jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
+            jnp.asarray(ex_k1), jnp.asarray(ex_k2), table_size=table_size,
+        )
+    else:
+        metrics.inc("evolu_merge_plan_total", path="sort")
+        outs = _plan_full_kernel(
+            jnp.asarray(cell_ids), jnp.asarray(k1), jnp.asarray(k2),
+            jnp.asarray(ex_k1), jnp.asarray(ex_k2),
+        )
     xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = to_host_many(*outs)
     xor_mask, upsert_mask = unpermute_masks(xor_s, upsert_s, i_s)
     deltas = decode_owner_minute_deltas(

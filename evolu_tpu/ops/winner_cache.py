@@ -133,11 +133,27 @@ class DeviceWinnerCache:
     _EWMA_NEW_WEIGHT = 0.8
     _KNOWN_CAP = 1 << 20  # bound the streaming-mode membership estimator
 
-    def __init__(self, db, capacity: int = 1 << 15, adaptive: bool = True):
+    def __init__(
+        self,
+        db,
+        capacity: int = 1 << 15,
+        adaptive: bool = True,
+        max_slots: "int | None" = 1 << 22,
+    ):
         self._db = db
         self._slots: Dict[Cell, int] = {}
         self._free: List[int] = []  # invalidated slots, reused first
         self._next_slot = 0
+        # HBM bound (VERDICT #3): the cache may never grow past
+        # `max_slots` (default 2^22 cells = 64 MiB of winner keys —
+        # an unbounded workload writing ever-new cells previously grew
+        # it without limit). Overflow evicts by DROP-AND-RESEED:
+        # eviction IS invalidation, which the coherence protocol
+        # already supports (a dropped slot just re-seeds from SQLite on
+        # next touch), so capping can never produce a stale winner.
+        self.max_slots = max_slots
+        if max_slots is not None:
+            capacity = min(capacity, bucket_size(max_slots))
         self.capacity = capacity
         self.adaptive = adaptive  # False = always-cached (static path)
         self._seed_ewma = 0.0
@@ -231,6 +247,27 @@ class DeviceWinnerCache:
                 jnp.asarray(v1_p), jnp.asarray(v2_p),
             )
         return True
+
+    def _enforce_capacity(self, cells, new_cells):
+        """The `max_slots` cap (VERDICT #3), applied between the gate
+        and seeding: if this batch's seeds would push the live slot
+        count past the cap, evict by DROPPING the whole cache and
+        reseeding just this batch's cells — eviction is exactly the
+        invalidation the coherence protocol already supports, so a
+        capped cache can never serve a stale winner; the cost is one
+        re-seed wave for cells that were live. Returns the (possibly
+        replaced) new_cells list, or None when this batch ALONE
+        exceeds the cap — the caller plans it with SQLite-streamed
+        winners (exact, no cache state) instead of thrashing."""
+        if self.max_slots is None or not new_cells:
+            return new_cells
+        if len(self._slots) + len(new_cells) <= self.max_slots:
+            return new_cells
+        metrics.inc("evolu_winner_cache_evictions_total")
+        self.reset()
+        if len(cells) > self.max_slots:
+            return None
+        return list(cells)
 
     def invalidate(self, cells) -> None:
         dropped = 0
@@ -377,7 +414,9 @@ class DeviceWinnerCache:
                 return self._host_fallback(messages, cells)
 
             mode, new_cells = self._adaptive_gate(cells)
-            if mode == "stream":
+            if mode == "cached":
+                new_cells = self._enforce_capacity(cells, new_cells)
+            if mode == "stream" or new_cells is None:
                 return self._plan_streamed(
                     messages, cells, cell_ids, millis, counter, node
                 )
@@ -454,7 +493,9 @@ class DeviceWinnerCache:
             touched_ids, cells = pb.touched_cells()
 
             mode, new_cells = self._adaptive_gate(cells)
-            if mode == "stream":
+            if mode == "cached":
+                new_cells = self._enforce_capacity(cells, new_cells)
+            if mode == "stream" or new_cells is None:
                 return self._plan_packed_streamed(
                     pb, cells, touched_ids, millis, counter, node
                 )

@@ -158,15 +158,62 @@ def _shard_kernel_wide(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
     )
 
 
+def _shard_kernel_scatter(cell_id, k1, k2, ex_k1, ex_k2, owner_ix, table_size):
+    """Sort-free per-shard reconcile (ops/scatter_merge.py): the LWW
+    masks come from the dense scatter-argmax plan in ORIGINAL shard
+    order (i_s is the identity), and the (owner, minute) segmentation
+    consumes the original-order columns — its own tile-local grouping
+    sort is order-free (decoders XOR-merge per key), so host-level
+    plans, deltas, and the digest are bit-identical to the sort
+    kernels wherever the router admits a batch. Segmentation-by-cell
+    assumption matches `_shard_kernel_wide`'s: cell ids are globally
+    interned (unique per owner). Same 9-output contract as
+    `_shard_kernel`; must be traced under enable_x64(True)."""
+    from evolu_tpu.ops.scatter_merge import scatter_plan_masks
+
+    xor_m, upsert_m = scatter_plan_masks(cell_id, k1, k2, ex_k1, ex_k2, table_size)
+    i_s = jnp.arange(cell_id.shape[0], dtype=jnp.int32)
+    millis, counter = unpack_ts_keys(k1)
+    hashes = jnp.where(xor_m, timestamp_hashes(millis, counter, k2), jnp.uint32(0))
+    owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted = owner_minute_segments(
+        owner_ix, millis, hashes, xor_m
+    )
+    digest = xor_allreduce(jax.lax.reduce(hashes, jnp.uint32(0), jnp.bitwise_xor, (0,)))
+    return (
+        xor_m, upsert_m, i_s,
+        owner_sorted, minute_sorted, seg_end, seg_xor, valid_sorted, digest,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def scatter_shard_kernel(table_size: int):
+    """The scatter shard kernel bound to one static table bucket.
+    Cached so repeated batches in the same bucket hand `_compiled_kernel`
+    the SAME callable (its lru_cache keys on identity — a fresh partial
+    per batch would recompile the mesh kernel every call)."""
+    kernel = functools.partial(_shard_kernel_scatter, table_size=table_size)
+    kernel.__name__ = f"_shard_kernel_scatter_{table_size}"
+    return kernel
+
+
 def shard_kernel_for(cols: Dict[str, np.ndarray]):
-    """Static host-side routing between the packed-owner kernel and the
-    wide fallback: the packed key needs every real cell id < 2^25 and
-    every owner index < 4095 (the padding sentinel). `cols` are the
-    HOST numpy columns, so the choice is made before tracing — no
-    device cond, two separately compiled kernels."""
+    """Static host-side routing between the scatter plan (when
+    configured and admissible — ops/scatter_merge.py), the packed-owner
+    sort kernel, and the wide fallback: the packed key needs every real
+    cell id < 2^25 and every owner index < 4095 (the padding sentinel);
+    the scatter plan needs cell ids < 2^25 and a duplicate-free batch.
+    `cols` are the HOST numpy columns, so the choice is made before
+    tracing — no device cond, separately compiled kernels."""
+    from evolu_tpu.ops.scatter_merge import table_size_for, use_scatter_plan
+
     real = cols["cell_id"] != int(_PAD_CELL)
     cell_max = int(cols["cell_id"].max(initial=0, where=real))
     owner_max = int(cols["owner_ix"].max(initial=0))
+    if "k1" in cols and use_scatter_plan(
+        cols["cell_id"], cols["k1"], cols["k2"], cell_max=cell_max
+    ):
+        metrics.inc("evolu_reconcile_kernel_total", variant="scatter")
+        return scatter_shard_kernel(table_size_for(cell_max))
     if cell_max < (1 << _CELL_BITS) and owner_max < _PAD_OWNER:
         metrics.inc("evolu_reconcile_kernel_total", variant="packed")
         return _shard_kernel

@@ -71,6 +71,9 @@ def select_planner(config: Config, db: Optional[PySqliteDatabase] = None) -> Cal
         return plan_batch
 
     from evolu_tpu.ops.merge import plan_batch_device_full
+    from evolu_tpu.ops.scatter_merge import set_plan_path
+
+    set_plan_path(config.merge_plan)
 
     threshold = 0 if config.backend == "tpu" else config.min_device_batch
     hot_min = config.hot_owner_min_batch

@@ -34,6 +34,13 @@ class Config:
     # one device — the "hot owner" path (SURVEY.md §5). Only engages
     # when >1 device is visible. None disables.
     hot_owner_min_batch: "int | None" = 1 << 18
+    # LWW plan formulation (ops/scatter_merge.py): "sort" = the r5
+    # sort+scan pipeline, "scatter" = the dense scatter-argmax plan,
+    # "auto" = by backend (scatter on CPU where it measured up to ~13×
+    # faster at 1M rows; sort on TPU where the recorded cost model
+    # prices serialized scatters/gathers far above one sort —
+    # docs/BENCHMARKS.md r6). EVOLU_MERGE_PLAN overrides.
+    merge_plan: str = "auto"
     # Keep per-cell stored winners HBM-resident across batches
     # (ops/winner_cache.py) instead of streaming them from SQLite per
     # batch — measured +19% (tunneled TPU) / ~+30% (CPU) steady-state

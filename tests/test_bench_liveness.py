@@ -19,20 +19,28 @@ import pytest
 
 import bench
 from evolu_tpu.parallel.mesh import create_mesh, sharding
-from evolu_tpu.parallel.reconcile import _shard_kernel
+from evolu_tpu.parallel.reconcile import _shard_kernel, scatter_shard_kernel
 
 N_OUTPUTS = 9  # xor_s, upsert_s, i_s, owner/minute/seg_end/seg_xor/valid, digest
 
+# The scatter plan kernel (ISSUE 4) shares the 9-output contract; its
+# table covers the fence's perturbed cell range (cells < 128, one
+# fence iteration XORs bit 18 at most — i=0 only, so no relabel).
+_KERNELS = {
+    "sort": _shard_kernel,
+    "scatter": scatter_shard_kernel(1 << 19),
+}
 
-def _perturbing_kernel(j):
+
+def _perturbing_kernel(base_kernel, j):
     """The real kernel with output j nudged by one unit/flip — the
     minimal observable change a live fold must propagate."""
 
     def kernel(*args):
-        outs = list(_shard_kernel(*args))
+        outs = list(base_kernel(*args))
         # Fail loudly on arity drift: a 10th output would silently
         # escape the fence otherwise.
-        assert len(outs) == N_OUTPUTS, f"_shard_kernel grew to {len(outs)} outputs"
+        assert len(outs) == N_OUTPUTS, f"kernel grew to {len(outs)} outputs"
         o = outs[j]
         if o.ndim == 0:
             outs[j] = o + jnp.ones((), o.dtype) if o.dtype != jnp.bool_ else ~o
@@ -59,23 +67,25 @@ def tiny_setup():
     return mesh, args
 
 
-def test_every_kernel_output_is_live_in_the_checksum(tiny_setup):
+@pytest.mark.parametrize("variant", list(_KERNELS))
+def test_every_kernel_output_is_live_in_the_checksum(tiny_setup, variant):
     mesh, args = tiny_setup
+    base_kernel = _KERNELS[variant]
     # iters=1: with more fused iterations a bool-flip perturbation's
     # ±1 checksum delta could cancel across iterations (flipped element
     # True in one, False in the next) and falsely report a live output
     # as dead; a single iteration makes every perturbation's delta
     # nonzero by construction.
     with jax.enable_x64(True):
-        base = int(bench.make_loop(mesh, 1)(*args))
+        base = int(bench.make_loop(mesh, 1, kernel=base_kernel)(*args))
         dead = []
         for j in range(N_OUTPUTS):
-            loop = bench.make_loop(mesh, 1, kernel=_perturbing_kernel(j))
+            loop = bench.make_loop(mesh, 1, kernel=_perturbing_kernel(base_kernel, j))
             if int(loop(*args)) == base:
                 dead.append(j)
     assert dead == [], (
-        f"outputs {dead} do not feed the bench checksum — XLA is free to "
-        f"DCE their producing stages out of the timed graph"
+        f"[{variant}] outputs {dead} do not feed the bench checksum — XLA is "
+        f"free to DCE their producing stages out of the timed graph"
     )
 
 

@@ -52,3 +52,61 @@ def test_ring_is_bounded():
         lg.log("dev", str(i))
     msgs = [e.message for e in lg.recent_events()]
     assert msgs == ["6", "7", "8", "9"]
+
+
+def test_span_trace_annotations_fire_under_the_target_name():
+    """With trace annotations enabled (VERDICT #7), every span opens a
+    jax.profiler.TraceAnnotation named by the SAME target the
+    log/metrics surfaces use; disabled spans touch nothing."""
+    import evolu_tpu.utils.log as log_mod
+
+    entered = []
+
+    class FakeAnnotation:
+        def __init__(self, name):
+            self.name = name
+
+        def __enter__(self):
+            entered.append(("enter", self.name))
+            return self
+
+        def __exit__(self, *exc):
+            entered.append(("exit", self.name))
+
+    lg = Logger()
+    orig = log_mod._trace_annotation_cls
+    try:
+        log_mod._trace_annotation_cls = FakeAnnotation
+        with lg.span("kernel:merkle", "reconcile_ingest", n=3):
+            pass
+        with lg.span("kernel:merge"):
+            pass
+    finally:
+        log_mod._trace_annotation_cls = orig
+    assert entered == [
+        ("enter", "kernel:merkle|reconcile_ingest"),
+        ("exit", "kernel:merkle|reconcile_ingest"),
+        ("enter", "kernel:merge"),
+        ("exit", "kernel:merge"),
+    ]
+    # Disabled (the default): spans never construct an annotation.
+    entered.clear()
+    with lg.span("kernel:merge"):
+        pass
+    assert entered == []
+
+
+def test_enable_trace_annotations_real_jax_class():
+    """The real jax.profiler.TraceAnnotation binds and runs (smoke —
+    actual trace capture is benchmarks/kernel_trace.py)."""
+    from evolu_tpu.utils.log import enable_trace_annotations
+    import evolu_tpu.utils.log as log_mod
+
+    try:
+        enable_trace_annotations(True)
+        assert log_mod._trace_annotation_cls is not None
+        with Logger().span("kernel:merge", "smoke"):
+            pass
+    finally:
+        enable_trace_annotations(False)
+    assert log_mod._trace_annotation_cls is None

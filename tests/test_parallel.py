@@ -676,16 +676,24 @@ def test_packed_owner_kernel_matches_wide_kernel():
             db_ = decode_owner_minute_deltas(*(np.asarray(o) for o in b[3:8]))
             assert da == db_, (trial, "minute deltas")
 
-    # Router: in-bounds → packed; oversized cell ids or owners → wide.
-    small = {"cell_id": np.array([1, int(_PAD_CELL)], np.int32),
-             "owner_ix": np.array([3, 0], np.int64)}
-    assert shard_kernel_for(small) is _shard_kernel
-    big_cell = {"cell_id": np.array([1 << 25], np.int32),
-                "owner_ix": np.array([0], np.int64)}
-    assert shard_kernel_for(big_cell) is _shard_kernel_wide
-    big_owner = {"cell_id": np.array([1], np.int32),
-                 "owner_ix": np.array([4095], np.int64)}
-    assert shard_kernel_for(big_owner) is _shard_kernel_wide
+    # Router: in-bounds → packed; oversized cell ids or owners → wide
+    # (plan path pinned to "sort" — the scatter route has its own
+    # router pins in tests/test_scatter_merge.py).
+    from evolu_tpu.ops.scatter_merge import set_plan_path
+
+    set_plan_path("sort")
+    try:
+        small = {"cell_id": np.array([1, int(_PAD_CELL)], np.int32),
+                 "owner_ix": np.array([3, 0], np.int64)}
+        assert shard_kernel_for(small) is _shard_kernel
+        big_cell = {"cell_id": np.array([1 << 25], np.int32),
+                    "owner_ix": np.array([0], np.int64)}
+        assert shard_kernel_for(big_cell) is _shard_kernel_wide
+        big_owner = {"cell_id": np.array([1], np.int32),
+                     "owner_ix": np.array([4095], np.int64)}
+        assert shard_kernel_for(big_owner) is _shard_kernel_wide
+    finally:
+        set_plan_path("auto")
 
 
 def test_run_batch_wire_on_generic_store_without_db_handle():
@@ -738,3 +746,53 @@ def test_run_batch_wire_on_generic_store_without_db_handle():
     finally:
         ref_eng.close(), gen_eng.close()
         ref_store.close(), gen_store.close()
+
+
+def test_delta_compact_transfer_matches_full_key_kernel(monkeypatch):
+    """The 16 B/row delta-encoded compact upload (VERDICT #9) must
+    produce identical deltas + digest to the 20 B/row packed-HLC-key
+    kernel, and batches outside its admission bounds (millis span
+    ≥ 2^32 ms) must silently keep the full-key kernel — same results
+    either way."""
+    from evolu_tpu.core.merkle import minute_deltas_host
+    from evolu_tpu.core.timestamp import Timestamp
+    from evolu_tpu.server.engine import deltas_from_columns
+    from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+    base = 1_700_000_000_000
+    mesh = create_mesh()
+
+    def run(spread):
+        owners, ts_all = {}, []
+        for o in range(5):
+            msgs = [
+                timestamp_to_string(
+                    Timestamp(base + o * 60_000 + i * spread, i % 3, f"{o + 1:016x}")
+                )
+                for i in range(40)
+            ]
+            owners[f"u{o}"] = msgs
+            ts_all.extend(msgs)
+        all_m, all_c, all_n, case_ok = parse_timestamp_strings(ts_all, with_case=True)
+        owner_index, pos = {}, 0
+        for o, msgs in owners.items():
+            owner_index[o] = np.arange(pos, pos + len(msgs))
+            pos += len(msgs)
+        out = {}
+        for flag in ("1", "0"):
+            monkeypatch.setenv("EVOLU_COMPACT_DELTA", flag)
+            out[flag] = deltas_from_columns(
+                mesh, owner_index, all_m, all_c, all_n, case_ok, ts_all
+            )
+        monkeypatch.delenv("EVOLU_COMPACT_DELTA")
+        # Host oracle cross-check, not just self-consistency.
+        expect_digest = 0
+        for o, msgs in owners.items():
+            exp, d = minute_deltas_host(msgs)
+            assert out["1"][0][o] == exp, o
+            expect_digest ^= d
+        assert out["1"] == out["0"]
+        assert out["1"][1] == expect_digest
+
+    run(spread=977)            # in-bounds: the delta kernel serves it
+    run(spread=120_000_000_00)  # 1.2e10 ms × 40 rows ≫ 2^32: full-key fallback

@@ -1,0 +1,313 @@
+"""Sort-vs-scatter LWW plan bit-identity (ISSUE 4 tentpole).
+
+The dense scatter-argmax plan (ops/scatter_merge.py) must produce
+bit-identical host-level results to the r5 sort+scan pipeline wherever
+the router admits a batch: masks in batch order, upsert selection,
+minute deltas, and the XOR digest — including HLC (counter, node)
+tie-breaks, stored-winner equality (the re-XOR quirk), and the
+wide/dup fallback routes. The router itself is pinned: duplicate
+(cell, k1, k2) batches and over-bound cell ids must keep the sort
+path, and EVOLU_MERGE_PLAN must override the config default.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from evolu_tpu.ops import to_host_many
+from evolu_tpu.ops.merge import (
+    _PAD_CELL,
+    _plan_full_kernel,
+    _plan_full_kernel_scatter,
+    plan_merge_sorted_flags,
+    unpermute_masks,
+)
+from evolu_tpu.ops.scatter_merge import (
+    MAX_TABLE_BITS,
+    batch_has_duplicate_keys,
+    merge_plan_path,
+    scatter_plan_masks,
+    set_plan_path,
+    table_size_for,
+    use_scatter_plan,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_plan_path():
+    yield
+    set_plan_path("auto")
+
+
+def _random_columns(rng, n, cells, stored=0.6, tie_heavy=False):
+    """Adversarial plan columns: heavy cell contention, HLC ties at
+    every level (equal millis, equal (millis, counter) resolved by
+    node, stored-winner equality), zero-key rows."""
+    cell_id = rng.integers(0, cells, n).astype(np.int32)
+    if tie_heavy:
+        millis = 1_700_000_000_000 + rng.integers(0, 3, n).astype(np.int64)
+        counter = rng.integers(0, 2, n).astype(np.int32)
+        node = rng.integers(1, 5, n).astype(np.uint64)
+    else:
+        millis = 1_700_000_000_000 + rng.integers(0, 86_400_000, n).astype(np.int64)
+        counter = rng.integers(0, 256, n).astype(np.int32)
+        node = rng.integers(1, 2**63, n).astype(np.uint64)
+    k1 = (millis.astype(np.uint64) << np.uint64(16)) | counter.astype(np.uint64)
+    has = rng.random(cells) < stored
+    w_k1 = (
+        (1_700_000_000_000 + rng.integers(0, 86_400_000, cells).astype(np.uint64))
+        << np.uint64(16)
+    ) | rng.integers(0, 256, cells).astype(np.uint64)
+    w_k2 = rng.integers(1, 2**63, cells).astype(np.uint64)
+    ex_k1 = np.where(has, w_k1, 0)[cell_id].astype(np.uint64)
+    ex_k2 = np.where(has, w_k2, 0)[cell_id].astype(np.uint64)
+    # Make some rows EQUAL their stored winner (the b-flag re-XOR
+    # quirk) — the scatter xor rule's only order-sensitive case.
+    dup_of_winner = (rng.random(n) < 0.1) & has[cell_id]
+    k1 = np.where(dup_of_winner, ex_k1, k1)
+    node = np.where(dup_of_winner, ex_k2, node)
+    return cell_id, k1, node, ex_k1, ex_k2
+
+
+def _dedupe(cell_id, k1, k2, ex_k1, ex_k2):
+    """Drop later duplicate (cell, k1, k2) rows so the batch satisfies
+    the scatter precondition while keeping the b-row ties."""
+    seen = set()
+    keep = np.ones(len(cell_id), bool)
+    for i, key in enumerate(zip(cell_id.tolist(), k1.tolist(), k2.tolist())):
+        if key in seen:
+            keep[i] = False
+        else:
+            seen.add(key)
+    return tuple(a[keep] for a in (cell_id, k1, k2, ex_k1, ex_k2))
+
+
+def _pad(cols, size):
+    cell_id, k1, k2, ex_k1, ex_k2 = cols
+    n = len(cell_id)
+    pad = size - n
+    return (
+        np.concatenate([cell_id, np.full(pad, int(_PAD_CELL), np.int32)]),
+        np.concatenate([k1, np.zeros(pad, np.uint64)]),
+        np.concatenate([k2, np.zeros(pad, np.uint64)]),
+        np.concatenate([ex_k1, np.zeros(pad, np.uint64)]),
+        np.concatenate([ex_k2, np.zeros(pad, np.uint64)]),
+    )
+
+
+def _sort_plan_masks(cols):
+    """Oracle: the r5 sorted-flags plan, unpermuted to batch order."""
+    xor_s, upsert_s, i_s, _s1, _s2, _ = jax.jit(plan_merge_sorted_flags)(
+        *(jnp.asarray(c) for c in cols)
+    )
+    return unpermute_masks(np.asarray(xor_s), np.asarray(upsert_s), np.asarray(i_s))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("tie_heavy", [False, True])
+def test_scatter_masks_bit_identical_to_sort_plan(seed, tie_heavy):
+    rng = np.random.default_rng(seed)
+    n = 1536 if tie_heavy else 4000
+    cols = _random_columns(rng, n, cells=max(n // 8, 4), tie_heavy=tie_heavy)
+    cols = _dedupe(*cols)
+    cols = _pad(cols, 4096)
+    table = table_size_for(int(cols[0][cols[0] != int(_PAD_CELL)].max()))
+    with jax.enable_x64(True):
+        xor_o, upsert_o = _sort_plan_masks(cols)
+        xor_s, upsert_s = to_host_many(
+            *jax.jit(scatter_plan_masks, static_argnames=("table_size",))(
+                *(jnp.asarray(c) for c in cols), table_size=table
+            )
+        )
+    np.testing.assert_array_equal(xor_s, xor_o)
+    np.testing.assert_array_equal(upsert_s, upsert_o)
+
+
+def test_scatter_full_kernel_matches_sort_full_kernel():
+    """The fused full-plan kernels (masks + minute deltas) agree at the
+    host level: batch-order masks, decoded delta dicts."""
+    from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas
+
+    rng = np.random.default_rng(7)
+    cols = _dedupe(*_random_columns(rng, 2000, cells=256))
+    cols = _pad(cols, 2048)
+    table = table_size_for(int(cols[0][cols[0] != int(_PAD_CELL)].max()))
+    with jax.enable_x64(True):
+        outs_sort = to_host_many(*_plan_full_kernel(*(jnp.asarray(c) for c in cols)))
+        outs_scat = to_host_many(
+            *_plan_full_kernel_scatter(
+                *(jnp.asarray(c) for c in cols), table_size=table
+            )
+        )
+    masks_sort = unpermute_masks(outs_sort[0], outs_sort[1], outs_sort[2])
+    masks_scat = unpermute_masks(outs_scat[0], outs_scat[1], outs_scat[2])
+    np.testing.assert_array_equal(masks_scat[0], masks_sort[0])
+    np.testing.assert_array_equal(masks_scat[1], masks_sort[1])
+    size = len(cols[0])
+    deltas = [
+        decode_owner_minute_deltas(np.zeros(size, np.int32), o[3], o[4], o[5], o[6])
+        for o in (outs_sort, outs_scat)
+    ]
+    assert deltas[0] == deltas[1]
+
+
+def test_shard_kernel_scatter_matches_packed_kernel_end_to_end():
+    """Whole-shard parity on the bench layout: plans, per-owner minute
+    deltas, and the digest from `_shard_kernel_scatter` equal the
+    packed sort kernel's across an 8-shard mesh."""
+    import bench
+    from evolu_tpu.ops.merkle_ops import decode_owner_minute_deltas
+    from evolu_tpu.parallel.mesh import create_mesh, sharding
+    from evolu_tpu.parallel.reconcile import (
+        _compiled_kernel,
+        _shard_kernel,
+        scatter_shard_kernel,
+    )
+
+    mesh = create_mesh()
+    n_dev = mesh.devices.size
+    cols, total = bench.shard_layout(
+        bench.build_columns(n=2048, owners=32, stored_winners=True), n_dev
+    )
+    real = cols["cell_id"] != int(_PAD_CELL)
+    table = table_size_for(int(cols["cell_id"].max(initial=0, where=real)))
+    shd = sharding(mesh)
+    names = ("cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix")
+    results = {}
+    with jax.enable_x64(True):
+        for label, kernel in (
+            ("sort", _shard_kernel),
+            ("scatter", scatter_shard_kernel(table)),
+        ):
+            args = [jax.device_put(cols[k], shd) for k in names]
+            outs = to_host_many(*_compiled_kernel(mesh, kernel)(*args))
+            shard_size = total // n_dev
+            masks = unpermute_masks(outs[0], outs[1], outs[2], block_size=shard_size)
+            deltas = decode_owner_minute_deltas(*outs[3:8])
+            results[label] = (masks, deltas, int(outs[8]))
+    np.testing.assert_array_equal(results["sort"][0][0], results["scatter"][0][0])
+    np.testing.assert_array_equal(results["sort"][0][1], results["scatter"][0][1])
+    assert results["sort"][1] == results["scatter"][1]
+    assert results["sort"][2] == results["scatter"][2]
+
+
+def test_router_rejects_duplicates_and_wide_cells():
+    cell_id = np.array([1, 2, 1], np.int32)
+    k1 = np.array([5, 6, 5], np.uint64)
+    k2 = np.array([9, 9, 9], np.uint64)
+    assert batch_has_duplicate_keys(cell_id, k1, k2)
+    set_plan_path("scatter")
+    assert not use_scatter_plan(cell_id, k1, k2)
+    # Dup-free passes.
+    k1u = np.array([5, 6, 7], np.uint64)
+    assert not batch_has_duplicate_keys(cell_id, k1u, k2)
+    assert use_scatter_plan(cell_id, k1u, k2)
+    # Cell ids beyond the table bound keep the sort path.
+    wide = np.array([1 << MAX_TABLE_BITS], np.int32)
+    assert not use_scatter_plan(
+        wide, np.array([1], np.uint64), np.array([1], np.uint64)
+    )
+
+
+def test_reconcile_router_falls_back_on_duplicate_batch():
+    """A batch with an in-batch duplicate key routes to a SORT kernel
+    even when scatter is forced — and the shard kernels still produce
+    the right plan for it (the dup shape the scatter algebra cannot
+    serve)."""
+    from evolu_tpu.parallel.reconcile import (
+        _shard_kernel,
+        _shard_kernel_wide,
+        shard_kernel_for,
+    )
+
+    cols = {
+        "cell_id": np.array([3, 3, 4], np.int32),
+        "k1": np.array([5, 5, 6], np.uint64),
+        "k2": np.array([9, 9, 9], np.uint64),
+        "ex_k1": np.zeros(3, np.uint64),
+        "ex_k2": np.zeros(3, np.uint64),
+        "owner_ix": np.zeros(3, np.int64),
+    }
+    set_plan_path("scatter")
+    kernel = shard_kernel_for(cols)
+    assert kernel in (_shard_kernel, _shard_kernel_wide)
+    # The dup-free twin routes to the scatter kernel.
+    from evolu_tpu.parallel.reconcile import scatter_shard_kernel
+
+    cols["k1"] = np.array([5, 6, 7], np.uint64)
+    assert shard_kernel_for(cols) is scatter_shard_kernel(table_size_for(4))
+
+
+def test_router_admits_padded_shard_layouts():
+    """Padding rows are identical (PAD, 0, 0) triples — the duplicate
+    screen must ignore them or every padded mesh layout self-reports
+    as duplicate and silently pins the sort path (found by the verify
+    drive: fleet reconcile never dispatched scatter)."""
+    from evolu_tpu.parallel.reconcile import scatter_shard_kernel, shard_kernel_for
+
+    cols = {
+        "cell_id": np.array([3, 4, int(_PAD_CELL), int(_PAD_CELL)], np.int32),
+        "k1": np.array([5, 6, 0, 0], np.uint64),
+        "k2": np.array([9, 9, 0, 0], np.uint64),
+        "ex_k1": np.zeros(4, np.uint64),
+        "ex_k2": np.zeros(4, np.uint64),
+        "owner_ix": np.zeros(4, np.int64),
+    }
+    set_plan_path("scatter")
+    assert not batch_has_duplicate_keys(cols["cell_id"], cols["k1"], cols["k2"])
+    assert shard_kernel_for(cols) is scatter_shard_kernel(table_size_for(4))
+
+
+def test_env_var_overrides_config(monkeypatch):
+    set_plan_path("sort")
+    monkeypatch.setenv("EVOLU_MERGE_PLAN", "scatter")
+    assert merge_plan_path() == "scatter"
+    monkeypatch.setenv("EVOLU_MERGE_PLAN", "sort")
+    set_plan_path("scatter")
+    assert merge_plan_path() == "sort"
+    monkeypatch.setenv("EVOLU_MERGE_PLAN", "scater")  # typo'd pin: loud
+    with pytest.raises(ValueError):
+        merge_plan_path()
+    monkeypatch.delenv("EVOLU_MERGE_PLAN")
+    assert merge_plan_path() == "scatter"
+    with pytest.raises(ValueError):
+        set_plan_path("bogus")
+
+
+def test_plan_batch_device_full_identical_across_paths():
+    """End-to-end through the message planner: PlannedBatch contents
+    (xor mask list, upsert identity, deltas) identical under forced
+    sort vs forced scatter."""
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.core.types import CrdtMessage
+    from evolu_tpu.ops.merge import plan_batch_device_full
+
+    rng = np.random.default_rng(11)
+    msgs = []
+    for i in range(300):
+        ts = timestamp_to_string(
+            Timestamp(
+                millis=1_700_000_000_000 + int(rng.integers(0, 120_000)),
+                counter=int(rng.integers(0, 4)),
+                node=f"{rng.integers(1, 8):016x}",
+            )
+        )
+        msgs.append(
+            CrdtMessage(ts, "t", f"r{int(rng.integers(0, 40))}", "c", i)
+        )
+    # Dedup identical timestamps per cell (the scatter precondition;
+    # duplicates would route to sort and the paths trivially agree).
+    seen, unique = set(), []
+    for m in msgs:
+        key = (m.table, m.row, m.column, m.timestamp)
+        if key not in seen:
+            seen.add(key)
+            unique.append(m)
+    winners = {}
+    plans = {}
+    for path in ("sort", "scatter"):
+        set_plan_path(path)
+        xor_mask, upserts, deltas = plan_batch_device_full(unique, winners)
+        plans[path] = (list(xor_mask), [id(u) for u in upserts], dict(deltas))
+    assert plans["sort"] == plans["scatter"]

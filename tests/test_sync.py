@@ -712,3 +712,72 @@ def test_strict_mode_reference_range_bytes_identical():
         strict = protocol.encode_content("todo", "r1", "c1", v, extensions=False)
         lax = protocol.encode_content("todo", "r1", "c1", v, extensions=True)
         assert strict == lax
+
+
+# --- lax-wire interop corner: a FLOAT written into the reference's
+# int32 `numberValue` field (VERDICT #5). The reference client encodes
+# with protobuf-ts (SURVEY.md:263); its `varint32write(value, buf)`
+# applies JS BITWISE ops to the raw number — `value & 0x7f` /
+# `value >> 7` truncate through ToInt32 — and the final sub-0x80 chunk
+# is pushed as-is and truncated by the Uint8Array store (ToUint8). Net
+# effect: the wire carries the varint of trunc(value); the fraction
+# NEVER reaches the wire, so there is no "float in an int32 field" to
+# detect — only a well-formed int32 varint. (protobuf-ts's debug
+# `assertInt32` would throw first in dev builds; the production
+# minified path and protobufjs-lineage writers share the truncating
+# arithmetic. Either way the only bytes a peer can emit for the field
+# are integer varints.)
+#
+# Pinned decision: our decoder treats field 5 as what the wire says —
+# the truncated int32 — with the same |0 wrap every conformant decoder
+# applies. No new error surface (the ValueError-only contract is for
+# MALFORMED wire; these fixtures are well-formed), and re-encoding the
+# decoded value is byte-stable, so relaying never rewrites it.
+
+
+def _content_with_field5(varint_bytes: bytes) -> bytes:
+    # table=1 "t", row=2 "r", column=3 "c", then field 5 (tag 0x28,
+    # varint) with the hand-built payload protobuf-ts would emit.
+    return (
+        b"\x0a\x01t" + b"\x12\x01r" + b"\x1a\x01c" + b"\x28" + varint_bytes
+    )
+
+
+@pytest.mark.parametrize(
+    "varint_bytes, expected",
+    [
+        # 3.5 → final chunk push(3.5), Uint8Array stores 3.
+        (b"\x03", 3),
+        # 300.7 → (300.7 & 0x7f)|0x80 = 0xac, 300.7 >>> 7 = 2.
+        (b"\xac\x02", 300),
+        # -2.5 → negative branch: 9 × (value & 127 | 128) with ToInt32
+        # truncation (-2), then push(1) — the 10-byte two's-complement
+        # varint of -2.
+        (b"\xfe" + b"\xff" * 8 + b"\x01", -2),
+        # 2^31 + 0.5 → bitwise ops wrap to int32: decodes as -2^31.
+        (b"\x80\x80\x80\x80\x08", -(2**31)),
+    ],
+)
+def test_protobuf_ts_float_in_int32_field_fixture(varint_bytes, expected):
+    table, row, column, value = protocol.decode_content(
+        _content_with_field5(varint_bytes)
+    )
+    assert (table, row, column) == ("t", "r", "c")
+    assert value == expected and isinstance(value, int)
+    # Relay stability: re-encoding the decoded value reproduces the
+    # canonical field-5 varint (no silent rewrite into the float
+    # extension field).
+    assert protocol.encode_content("t", "r", "c", value) == _content_with_field5(
+        protocol._varint(expected)
+    )
+
+
+def test_our_encoder_never_emits_field5_for_floats():
+    """The converse pin: OUR encoder routes non-integer numbers to the
+    doubleValue=6 extension (or raises in strict interop mode) — a
+    float can never masquerade as an int32 on our side of the wire."""
+    data = protocol.encode_content("t", "r", "c", 3.5)
+    assert b"\x28" not in data.split(b"\x1a\x01c")[1][:1]  # no field-5 tag after column
+    assert protocol.decode_content(data)[3] == 3.5
+    with pytest.raises(TypeError):
+        protocol.encode_content("t", "r", "c", 3.5, extensions=False)

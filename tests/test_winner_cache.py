@@ -567,3 +567,42 @@ def test_plan_packed_seed_failure_samples_ewma_once(monkeypatch):
         )
     finally:
         db.close()
+
+
+def test_capacity_cap_drop_and_reseed_eviction():
+    """Bounded cache (VERDICT #3): driving the cache past `max_slots`
+    with ever-new cells must evict (drop-and-reseed), never grow past
+    the cap, and keep the SQLite end state + tree byte-equal to the
+    streamed-winner planner's. One batch bigger than the cap itself
+    plans streamed (no cache state) — same end state."""
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.ops.merge import plan_batch_device_full
+
+    db_a, db_b = _db(), _db()
+    cache = DeviceWinnerCache(db_b, capacity=16, adaptive=False, max_slots=40)
+    tree_a, tree_b = {}, {}
+    metrics.reset()
+    try:
+        # 6 batches × 23 distinct rows (cells rotate via the row key),
+        # crossing the 40-slot cap repeatedly.
+        for batch_no in range(6):
+            batch = tuple(
+                _mk(i + batch_no * 23, row=f"cap{batch_no}-{i}") for i in range(23)
+            )
+            tree_a = apply_messages(db_a, tree_a, batch, planner=plan_batch_device_full)
+            tree_b = apply_messages(db_b, tree_b, batch, planner=cache.plan_batch)
+            assert len(cache._slots) <= 40, f"batch {batch_no} exceeded the cap"
+            assert cache._next_slot <= 64  # device slots stay bounded too
+            assert _dump(db_a) == _dump(db_b), f"batch {batch_no}"
+            assert merkle_tree_to_string(tree_a) == merkle_tree_to_string(tree_b)
+        assert metrics.get_counter("evolu_winner_cache_evictions_total") >= 1
+
+        # A single batch larger than the cap: streamed, still byte-equal.
+        big = tuple(_mk(500 + i, row=f"big{i}") for i in range(50))
+        tree_a = apply_messages(db_a, tree_a, big, planner=plan_batch_device_full)
+        tree_b = apply_messages(db_b, tree_b, big, planner=cache.plan_batch)
+        assert _dump(db_a) == _dump(db_b)
+        assert merkle_tree_to_string(tree_a) == merkle_tree_to_string(tree_b)
+        assert len(cache._slots) <= 40
+    finally:
+        db_a.close(), db_b.close()
