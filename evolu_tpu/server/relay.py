@@ -398,6 +398,24 @@ class _Handler(BaseHTTPRequestHandler):
             log("dev", f"relay {self.address_string()} {format % args}",
                 _flight=False)
 
+    def _body_length(self) -> Optional[int]:
+        """Harden Content-Length parsing: a non-numeric header used to
+        raise an uncaught ValueError out of `int(...)` (connection
+        reset instead of an HTTP answer), and a NEGATIVE value passed
+        the `> MAX_BODY_BYTES` check and then `rfile.read(-1)` read
+        UNBOUNDED. → the parsed length, or None after answering 400.
+        The MAX_BODY_BYTES cap stays at the call sites (413)."""
+        raw = self.headers.get("Content-Length", "0")
+        try:
+            length = int(raw)
+        except (TypeError, ValueError):
+            length = -1
+        if length < 0:
+            metrics.inc("evolu_relay_errors_total")
+            self.send_error(400, "invalid Content-Length")
+            return None
+        return length
+
     def _respond(self, code: int, body: bytes, content_type: str) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
@@ -438,12 +456,13 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(404)
 
     def do_POST(self) -> None:  # POST / (index.ts:224-248)
-        if self.path in ("/replicate/summary", "/replicate/pull"):
+        if self.path.startswith("/replicate/"):
             if self.replication is None:
                 # Only a relay CONFIGURED for replication exposes the
-                # gossip surface: /replicate/summary enumerates owner
-                # ids, which the sync path treats as capabilities — a
-                # plain client-facing relay must not disclose them.
+                # gossip/snapshot surface: /replicate/summary and the
+                # snapshot manifest enumerate owner ids, which the sync
+                # path treats as capabilities — a plain client-facing
+                # relay must not disclose them.
                 self.send_error(404)
                 return
             self._do_replicate()
@@ -453,7 +472,9 @@ class _Handler(BaseHTTPRequestHandler):
         # exceed requests_total (error-rate = errors/requests must stay
         # a fraction).
         metrics.inc("evolu_relay_requests_total", endpoint="/")
-        length = int(self.headers.get("Content-Length", 0))
+        length = self._body_length()
+        if length is None:
+            return
         if length > MAX_BODY_BYTES:
             metrics.inc("evolu_relay_errors_total")
             self.send_error(413)
@@ -510,14 +531,25 @@ class _Handler(BaseHTTPRequestHandler):
         self._respond(200, out, "application/octet-stream")
 
     def _do_replicate(self) -> None:
-        """POST /replicate/summary and /replicate/pull — the peer
-        gossip surface (server/replicate.py). Malformed bodies answer
-        400 (the wire decoders raise ValueError only); anything else is
-        a 500 like the sync path."""
-        from evolu_tpu.server import replicate
+        """POST /replicate/{summary,pull,snapshot,snapshot/chunk} — the
+        peer gossip + bootstrap surface (server/replicate.py,
+        server/snapshot.py). Malformed bodies answer 400 (the wire
+        decoders raise ValueError only; unknown/expired snapshot ids
+        are a deliberate 400 too — the puller's restart signal);
+        anything else is a 500 like the sync path."""
+        from evolu_tpu.server import replicate, snapshot
 
+        if self.path not in ("/replicate/summary", "/replicate/pull",
+                             "/replicate/snapshot", "/replicate/snapshot/chunk"):
+            # 404 BEFORE any metric: the endpoint label must only ever
+            # take allowlisted values — counting raw unknown paths
+            # would let any caller mint registry series without bound.
+            self.send_error(404)
+            return
         metrics.inc("evolu_relay_requests_total", endpoint=self.path)
-        length = int(self.headers.get("Content-Length", 0))
+        length = self._body_length()
+        if length is None:
+            return
         if length > MAX_BODY_BYTES:
             metrics.inc("evolu_relay_errors_total")
             self.send_error(413)
@@ -526,8 +558,16 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if self.path == "/replicate/summary":
                 out = replicate.serve_summary(self.store, body, self.replication)
+            elif self.path == "/replicate/pull":
+                out = replicate.serve_pull(
+                    self.store, body,
+                    per_owner=self.replication.pull_messages_per_owner,
+                    per_response=self.replication.pull_messages_per_response,
+                )
+            elif self.path == "/replicate/snapshot":
+                out = snapshot.serve_snapshot(self.store, body, self.replication)
             else:
-                out = replicate.serve_pull(self.store, body)
+                out = snapshot.serve_snapshot_chunk(self.store, body, self.replication)
         except ValueError as e:
             metrics.inc("evolu_relay_errors_total")
             self.send_error(400, str(e))
@@ -568,14 +608,26 @@ class RelayServer:
     passes. `peers=[]` (non-None) makes a pure LISTENER: it serves the
     gossip endpoints without polling anyone. Relays NOT configured for
     replication answer 404 on `/replicate/*` — the summary endpoint
-    enumerates owner ids (capabilities on the sync path), so the
-    surface is for peer meshes on trusted networks, not for clients.
-    `start()`/`stop()` own its lifecycle."""
+    (and the snapshot manifest) enumerate owner ids (capabilities on
+    the sync path), so the surface is for peer meshes on trusted
+    networks, not for clients. `bootstrap_lag_owners` enables snapshot
+    bootstrap (`server/snapshot.py`): an empty peer — or one lacking at
+    least that many advertised owners — installs a donor snapshot
+    instead of crawling history through capped pulls.
+
+    `checkpoint_interval_s` (with `checkpoint_path`, defaulting to
+    `<store path>.checkpoint` for file-backed stores) runs periodic
+    local snapshot checkpoints for crash-consistent fast restart
+    (`snapshot.write_checkpoint` / `snapshot.restore_checkpoint`).
+    `start()`/`stop()` own every lifecycle."""
 
     def __init__(self, store: Optional[RelayStore] = None, host: str = "127.0.0.1",
                  port: int = 0, batching: bool = False, scheduler=None,
                  peers: Optional[Sequence[str]] = None, replication=None,
-                 replication_interval_s: float = 30.0):
+                 replication_interval_s: float = 30.0,
+                 bootstrap_lag_owners: Optional[int] = None,
+                 checkpoint_interval_s: Optional[float] = None,
+                 checkpoint_path: Optional[str] = None):
         self.store = store or RelayStore()
         self.scheduler = scheduler
         if batching and scheduler is None:
@@ -589,6 +641,26 @@ class RelayServer:
             self.replication = ReplicationManager(
                 self.store, peers, scheduler=self.scheduler,
                 interval_s=replication_interval_s,
+                bootstrap_lag_owners=bootstrap_lag_owners,
+            )
+        self.checkpointer = None
+        if checkpoint_interval_s is None:
+            from evolu_tpu.utils.config import default_config
+
+            checkpoint_interval_s = default_config.checkpoint_interval_s
+        if checkpoint_interval_s is not None:
+            from evolu_tpu.server.snapshot import CheckpointWriter
+
+            if checkpoint_path is None:
+                store_path = getattr(getattr(self.store, "db", None), "path", None)
+                if not store_path or store_path == ":memory:":
+                    raise ValueError(
+                        "checkpoint_interval_s needs checkpoint_path for "
+                        "non-file-backed stores"
+                    )
+                checkpoint_path = store_path + ".checkpoint"
+            self.checkpointer = CheckpointWriter(
+                self.store, checkpoint_path, checkpoint_interval_s
             )
         handler = type(
             "BoundHandler", (_Handler,),
@@ -608,12 +680,18 @@ class RelayServer:
         self._thread.start()
         if self.replication is not None:
             self.replication.start()
+        if self.checkpointer is not None:
+            self.checkpointer.start()
         return self
 
     def stop(self) -> None:
         self._httpd.shutdown()
         if self._thread:
             self._thread.join()
+        if self.checkpointer is not None:
+            # Before the store closes; a capture in flight finishes its
+            # read transactions first (stop joins the loop thread).
+            self.checkpointer.stop()
         if self.replication is not None:
             # Before the scheduler drains and WELL before the store
             # closes: an in-flight gossip round may still be submitting

@@ -119,22 +119,30 @@ def serve_summary(store, body: bytes, manager: Optional["ReplicationManager"]) -
     )
 
 
-def serve_pull(store, body: bytes) -> bytes:
+def serve_pull(store, body: bytes, per_owner: Optional[int] = None,
+               per_response: Optional[int] = None) -> bytes:
     """Handler body for `POST /replicate/pull`: ranged per-owner reads
     (strictly after `since`, every node's messages, earliest-first and
     capped — see PULL_MESSAGES_PER_OWNER) + the tree string at fetch
     time. Owners past the response budget are omitted; the puller's
     convergence check treats them as still-diverged and the next round
-    resumes. ValueError only on malformed input."""
+    resumes. The caps default to the module constants but are
+    configurable per relay (`ReplicationManager(pull_messages_per_
+    owner=..., pull_messages_per_response=...)` — the bench sweeps
+    them honestly). ValueError only on malformed input."""
+    cap_owner = PULL_MESSAGES_PER_OWNER if per_owner is None else int(per_owner)
+    cap_resp = (
+        PULL_MESSAGES_PER_RESPONSE if per_response is None else int(per_response)
+    )
     req = protocol.decode_replica_pull(body)
     chunks = []
     served = 0
     for uid, since in req.pulls:
-        if served >= PULL_MESSAGES_PER_RESPONSE:
+        if served >= cap_resp:
             break
         msgs = store.replica_messages(
             uid, since,
-            min(PULL_MESSAGES_PER_OWNER, PULL_MESSAGES_PER_RESPONSE - served),
+            min(cap_owner, cap_resp - served),
         )
         served += len(msgs)
         chunks.append(
@@ -197,11 +205,26 @@ class ReplicationManager:
         http_post: Optional[Callable[[str, bytes], bytes]] = None,
         rng=None,
         pull_chunk: int = PULL_OWNERS_PER_REQUEST,
+        pull_messages_per_owner: Optional[int] = None,
+        pull_messages_per_response: Optional[int] = None,
+        bootstrap_lag_owners: Optional[int] = None,
+        snapshot_chunk_bytes: Optional[int] = None,
     ):
         import functools
         import random
 
         from evolu_tpu.sync.client import BACKOFF_BASE_S, _http_post
+        from evolu_tpu.utils.config import default_config
+
+        # Any knob left at None falls back to the process default_config
+        # (utils/config.py) — one place to tune a whole fleet — and only
+        # then to the module constants at serve time.
+        if pull_messages_per_owner is None:
+            pull_messages_per_owner = default_config.pull_messages_per_owner
+        if pull_messages_per_response is None:
+            pull_messages_per_response = default_config.pull_messages_per_response
+        if bootstrap_lag_owners is None:
+            bootstrap_lag_owners = default_config.bootstrap_lag_owners
 
         self.store = store
         self.scheduler = scheduler
@@ -213,10 +236,25 @@ class ReplicationManager:
         )
         self.backoff_max_s = float(backoff_max_s)
         self.pull_chunk = int(pull_chunk)
+        # serve_pull caps this relay answers with (None = the module
+        # defaults, read at serve time so tests can monkeypatch them).
+        self.pull_messages_per_owner = pull_messages_per_owner
+        self.pull_messages_per_response = pull_messages_per_response
+        # Snapshot bootstrap (server/snapshot.py): None disables the
+        # trigger entirely (incremental anti-entropy only — the PR-3
+        # behavior and the default). An int N arms it: a peer whose
+        # store is EMPTY, or that lacks >= N owners a donor advertises,
+        # installs a full snapshot instead of crawling history through
+        # capped pulls, then gossips from the manifest watermark.
+        self.bootstrap_lag_owners = bootstrap_lag_owners
+        self.snapshot_chunk_bytes = snapshot_chunk_bytes
+        self._snapshot_cache = None
+        self._snapshot_cache_lock = threading.Lock()
         self._post = http_post or functools.partial(_http_post, retries=0)
         self._rng = rng or random.random
         now = time.monotonic()
         self._peers = [_Peer(u, now) for u in peers]
+        self._swap_checked = False
         self._cv = threading.Condition()
         self._hint_at: Optional[float] = None
         self._stopping = False
@@ -328,15 +366,78 @@ class ReplicationManager:
         for p in self._peers:
             self._round(p)
 
+    @property
+    def snapshot_cache(self):
+        """Donor-side snapshot cache, built lazily (only relays whose
+        peers actually bootstrap pay the capture memory). Lock-guarded:
+        two peers' concurrent first /replicate/snapshot requests (the
+        threaded HTTP server) must share ONE instance — a second
+        instance would orphan the first peer's snapshot id mid-fetch
+        and double the capture cost."""
+        with self._snapshot_cache_lock:
+            if self._snapshot_cache is None:
+                from evolu_tpu.server.snapshot import (
+                    SNAPSHOT_CHUNK_BYTES, SnapshotCache,
+                )
+
+                self._snapshot_cache = SnapshotCache(
+                    self.store,
+                    chunk_bytes=self.snapshot_chunk_bytes or SNAPSHOT_CHUNK_BYTES,
+                )
+            return self._snapshot_cache
+
     def _post_checked(self, url: str, body: bytes) -> bytes:
         """The round's transport, with a stop check before each leg —
         a multi-leg round against a black-holing peer must not hold
-        stop() through every remaining socket timeout."""
+        stop() through every remaining socket timeout. Every leg counts
+        one HTTP round-trip (the unit the snapshot-vs-anti-entropy
+        acceptance ratio is asserted in)."""
         if self._stopping:
             raise _ManagerStopping()
+        leg = url.rsplit("/replicate/", 1)[-1] if "/replicate/" in url else "other"
+        metrics.inc(
+            "evolu_repl_round_trips_total", replica=self.replica_id, leg=leg
+        )
         return self._post(url, body)
 
+    def _finish_pending_swap_once(self) -> None:
+        """A crash between shard swaps leaves a verified install half
+        swapped in (phase=swap). `_bootstrap` would finish it, but the
+        half-swapped live tables may advertise enough owners that the
+        bootstrap trigger never fires again — so the FIRST round of any
+        manager unconditionally finishes a pending swap. Probe via
+        sqlite_master first: a store that never bootstrapped must not
+        grow a state table just from being gossiped."""
+        if self._swap_checked:
+            return
+        self._swap_checked = True
+        try:
+            shard0 = (getattr(self.store, "shards", None) or [self.store])[0]
+            have = shard0.db.exec_sql_query(
+                "SELECT name FROM sqlite_master WHERE type='table' "
+                "AND name='snapshotBootstrapState'"
+            )
+            if not have:
+                return
+            from evolu_tpu.server import snapshot as snap
+
+            inst = snap.SnapshotInstaller(self.store)
+            st = inst.pending()
+            if st is not None and st["phase"] == "swap":
+                inst.finish_swap()
+                metrics.inc(
+                    "evolu_snap_installs_total", result="ok",
+                    replica=self.replica_id, peer=st["peer"],
+                )
+                log("server", "finished stranded snapshot swap",
+                    snapshot=st["snapshot_id"], peer=st["peer"])
+        except Exception as e:  # noqa: BLE001 - recovery must never
+            # block gossip; the pending state stays for the next try.
+            self._swap_checked = False
+            log("server", "pending snapshot swap check failed", error=repr(e))
+
     def _round(self, peer: _Peer) -> None:
+        self._finish_pending_swap_once()
         labels = {"replica": self.replica_id, "peer": peer.url}
         try:
             converged, pulled = self._gossip(peer)
@@ -391,6 +492,15 @@ class ReplicationManager:
         resp = protocol.decode_replica_summary(
             self._post_checked(peer.url + "/replicate/summary", protocol.encode_replica_summary(mine))
         )
+        if self._should_bootstrap(local, resp.trees):
+            if peer.diverged_since is None:
+                peer.diverged_since = time.monotonic()
+            installed = self._bootstrap(peer)
+            # Not "converged" yet: the donor may have written past the
+            # snapshot watermark — the nonzero return arms the hint so
+            # the NEXT round diffs from the watermark at debounce
+            # latency and pulls only the post-snapshot tail.
+            return False, installed
         diverged: List[Tuple[str, str]] = []  # (owner, since)
         for uid, peer_tree_s in resp.trees:
             # Compare and diff the SAME bulk snapshot — no per-owner
@@ -445,6 +555,155 @@ class ReplicationManager:
             for uid, _since in diverged
         )
         return converged, pulled
+
+    # -- snapshot bootstrap (server/snapshot.py) --
+
+    def _should_bootstrap(self, local: dict, advertised) -> bool:
+        """Arm the O(state) cold-start instead of O(history) capped
+        pulls: the local store is empty, or it lacks BOTH at least
+        `bootstrap_lag_owners` owners the peer advertises AND the
+        majority of the advertised set (a relay restored from an old
+        disk). The majority clause keeps routine fleet growth on the
+        incremental path: one new owner appearing on a converged
+        100-owner mesh is a ranged pull, never a full-store
+        re-snapshot, whatever the threshold. None disables (PR-3
+        behavior)."""
+        if self.bootstrap_lag_owners is None or not advertised:
+            return False
+        if not local:
+            return True
+        unknown = sum(1 for uid, _t in advertised if uid not in local)
+        # max(1, ·): a converged mesh has unknown == 0 and must never
+        # re-bootstrap, whatever the configured threshold.
+        return (unknown >= max(1, self.bootstrap_lag_owners)
+                and unknown * 2 > len(advertised))
+
+    def bootstrap_from(self, peer_url: str) -> int:
+        """Run one snapshot bootstrap against `peer_url` on the calling
+        thread (the unit-test / bench / operator surface — `run_once`'s
+        analog). Returns the number of message rows installed."""
+        return self._bootstrap(_Peer(peer_url, time.monotonic()))
+
+    def _bootstrap(self, peer: _Peer) -> int:
+        """Manifest → resumable chunk fetches → crash-consistent
+        install → golden-parity verify → atomic swap. The chunk
+        watermark lives in the STORE (snapshotBootstrapState), so a
+        SIGKILL anywhere in the fetch loop resumes from the last
+        committed chunk without re-transferring completed ones; a
+        donor-side snapshot expiry (HTTP 400 on the chunk leg) drops
+        the stale install and the next round restarts fresh."""
+        import urllib.error
+
+        from evolu_tpu.server import snapshot as snap
+
+        labels = {"replica": self.replica_id, "peer": peer.url}
+        inst = snap.SnapshotInstaller(self.store)
+        t0 = time.perf_counter()
+        manifest, start = None, 0
+        st = inst.pending()
+        if st is not None and st["phase"] == "swap":
+            # Died between shard swaps: finish (idempotent), done — the
+            # data was fully verified before the swap began, and the
+            # swap is peer-independent (WHICHEVER peer this round
+            # targets, aborting would strand already-swapped shards on
+            # the snapshot and throw away verified data).
+            inst.finish_swap()
+            metrics.observe(
+                "evolu_snap_install_ms", (time.perf_counter() - t0) * 1e3
+            )
+            metrics.inc("evolu_snap_installs_total", result="ok", **labels)
+            return 0
+        if st is not None and st["peer"] != peer.url:
+            with self._cv:
+                known = any(p.url == st["peer"] for p in self._peers)
+            if known:
+                # The watermark belongs to ANOTHER configured peer
+                # (multi-peer mesh, first round after a crash happened
+                # to target a different donor): resume against the
+                # original donor instead of discarding completed
+                # chunks — only IT still serves this snapshot id.
+                peer = _Peer(st["peer"], time.monotonic())
+                labels = {"replica": self.replica_id, "peer": peer.url}
+            else:
+                inst.abort()  # an unconfigured peer's stale install
+                st = None
+        if st is not None:
+            manifest, start = st["manifest"], st["next_chunk"]
+            if start:
+                metrics.inc("evolu_snap_resumes_total", **labels)
+                log("server", "snapshot bootstrap resuming", peer=peer.url,
+                    snapshot=manifest.snapshot_id, next_chunk=start,
+                    chunks=len(manifest.chunk_sizes))
+        if manifest is None:
+            body = protocol.encode_snapshot_request(
+                protocol.SnapshotRequest(
+                    self.replica_id, self.snapshot_chunk_bytes or 0
+                )
+            )
+            manifest = protocol.decode_snapshot_manifest(
+                self._post_checked(peer.url + "/replicate/snapshot", body)
+            )
+            inst.begin(manifest, peer.url)
+            log("server", "snapshot bootstrap starting", peer=peer.url,
+                snapshot=manifest.snapshot_id, owners=len(manifest.owners),
+                rows=manifest.message_count, bytes=manifest.total_bytes,
+                chunks=len(manifest.chunk_sizes))
+        try:
+            for i in range(start, len(manifest.chunk_sizes)):
+                req = protocol.encode_snapshot_chunk_request(
+                    protocol.SnapshotChunkRequest(
+                        manifest.snapshot_id, i, self.replica_id
+                    )
+                )
+                try:
+                    raw = self._post_checked(
+                        peer.url + "/replicate/snapshot/chunk", req
+                    )
+                except urllib.error.HTTPError as e:
+                    if e.code == 400:
+                        # The donor no longer serves this snapshot id:
+                        # the persisted watermark is worthless — drop it
+                        # so the next round begins a fresh bootstrap.
+                        inst.abort()
+                        metrics.inc(
+                            "evolu_snap_installs_total", result="expired", **labels
+                        )
+                    raise
+                chunk = protocol.decode_snapshot_chunk(raw)
+                if (chunk.snapshot_id != manifest.snapshot_id
+                        or chunk.index != i
+                        or len(chunk.payload) != manifest.chunk_sizes[i]
+                        or chunk.crc != manifest.chunk_crcs[i]):
+                    raise snap.SnapshotInstallError(
+                        f"snapshot chunk {i}: response does not match the "
+                        "manifest (id/index/size/crc)"
+                    )
+                inst.install_chunk(i, chunk.payload,
+                                   expected_crc=manifest.chunk_crcs[i])
+                metrics.inc("evolu_snap_chunks_fetched_total", **labels)
+                metrics.inc(
+                    "evolu_snap_bytes_fetched_total", len(chunk.payload), **labels
+                )
+            inst.verify(manifest)
+        except (_ManagerStopping, urllib.error.URLError, OSError):
+            # Transport interruptions keep the watermark: resume next
+            # round without re-transferring completed chunks.
+            raise
+        except snap.SnapshotInstallError:
+            # Integrity failure: the shipped bytes are not trustworthy —
+            # drop everything and refetch fresh. Live tables untouched.
+            inst.abort()
+            metrics.inc("evolu_snap_installs_total", result="error", **labels)
+            raise
+        inst.swap()
+        metrics.observe(
+            "evolu_snap_install_ms", (time.perf_counter() - t0) * 1e3
+        )
+        metrics.inc("evolu_snap_installs_total", result="ok", **labels)
+        log("server", "snapshot bootstrap installed", peer=peer.url,
+            snapshot=manifest.snapshot_id, rows=manifest.message_count,
+            owners=len(manifest.owners))
+        return manifest.message_count
 
     def _ingest(self, requests: List[protocol.SyncRequest]) -> None:
         """Apply pulled messages through the relay's OWN serving paths
@@ -514,5 +773,41 @@ class ReplicationManager:
                 "convergence_lag_p99_ms": metrics.quantile(
                     "evolu_repl_convergence_lag_ms", 0.99, **labels
                 ),
+                "snapshot_bootstraps": metrics.get_counter(
+                    "evolu_snap_installs_total", result="ok", **labels
+                ),
+                "snapshot_chunks_fetched": metrics.get_counter(
+                    "evolu_snap_chunks_fetched_total", **labels
+                ),
+                "snapshot_bytes_fetched": metrics.get_counter(
+                    "evolu_snap_bytes_fetched_total", **labels
+                ),
             })
-        return {"replica_id": self.replica_id, "peers": peers}
+        return {
+            "replica_id": self.replica_id,
+            "peers": peers,
+            # Donor-side snapshot service (unlabeled — served to
+            # whoever asked, like messages_served).
+            "snapshot": {
+                "captures": metrics.get_counter("evolu_snap_captures_total"),
+                "capture_rows": metrics.get_counter(
+                    "evolu_snap_capture_rows_total"
+                ),
+                "capture_bytes": metrics.get_counter(
+                    "evolu_snap_capture_bytes_total"
+                ),
+                "manifests_served": metrics.get_counter(
+                    "evolu_snap_manifests_served_total"
+                ),
+                "chunks_served": metrics.get_counter(
+                    "evolu_snap_chunks_served_total"
+                ),
+                "chunk_bytes_served": metrics.get_counter(
+                    "evolu_snap_chunk_bytes_served_total"
+                ),
+                "checkpoints": metrics.get_counter(
+                    "evolu_snap_checkpoints_total"
+                ),
+                "install_p99_ms": metrics.quantile("evolu_snap_install_ms", 0.99),
+            },
+        }

@@ -1,0 +1,809 @@
+"""Snapshot checkpoint & peer bootstrap: O(state) relay cold-start.
+
+No reference equivalent — the reference relay is a single node that
+never bootstraps. PR 3's Merkle anti-entropy converges peers in
+bandwidth proportional to DIVERGENCE, but its pulls are capped and
+minute-ranged: a FRESH relay (or one restoring after disk loss) is
+"diverged by the whole history" and must crawl it in O(history)
+capped round-trips through `serve_pull`. Production replicated
+systems bootstrap from a state snapshot and hand off to the
+incremental log at a watermark; this module is that subsystem:
+
+* **Consistent capture** — per shard, inside ONE SQLite read
+  transaction, every `message` row and `merkleTree` row streams into a
+  framed byte format (explicit lengths everywhere — timestamps and
+  owner ids may be any width, contents are ciphertext blobs). The
+  native leg `eh_snapshot_rows` packs the whole shard in one C call;
+  the stdlib SQL path is the byte-identical oracle (parity-pinned).
+  The stream splits into crc32-checked chunks at record boundaries,
+  each under the relay body cap, described by a manifest
+  (`sync/protocol.py::SnapshotManifest`) carrying per-owner
+  watermarks: the Merkle ROOT hash + a crc32 of the owner's serialized
+  tree text at capture time.
+
+* **Shipping** — donor endpoints `POST /replicate/snapshot` (manifest;
+  capture is cached briefly so resumed pulls see the same bytes) and
+  `POST /replicate/snapshot/chunk` (resumable ranged fetch), 404-gated
+  with the rest of `/replicate/*` (the manifest enumerates owner ids —
+  capabilities on the sync path). An expired snapshot id answers 400;
+  the puller aborts its stale install and restarts fresh.
+
+* **Crash-consistent install** (`SnapshotInstaller`) — chunks land in
+  side tables (`messageBsnap`/`merkleTreeBsnap`) of the live store,
+  one transaction per (chunk, shard), with the chunk watermark
+  persisted in a `snapshotBootstrapState` table AFTER the chunk's rows
+  commit: a SIGKILL between chunks resumes from the watermark instead
+  of re-transferring completed chunks (re-applying the one un-marked
+  chunk is idempotent — same PK, INSERT OR IGNORE). When every chunk
+  is in, the installer recomputes EVERY owner's Merkle tree from the
+  shipped rows and verifies byte-identity against the shipped tree
+  text and the manifest digests (golden-parity trees — any mismatch
+  aborts the install and leaves the live tables untouched), then swaps
+  the tables in atomically — per shard, ONE transaction first folds
+  every live row the snapshot lacks (pre-existing local-only rows AND
+  client writes accepted during the install) into the side tables
+  through the same changes==1 XOR gate the serve path uses, then DROP
+  + ALTER RENAME (SQLite DDL is transactional, and the store's lock is
+  held for the whole merge+swap, so handler threads never observe a
+  half-swapped shard and an acknowledged write can never vanish in the
+  swap). After the swap
+  the peer's trees EQUAL the donor's at capture time, so normal PR-3
+  gossip resumes from exactly the watermark: the first summary
+  exchange diffs only post-snapshot writes.
+
+* **Periodic local checkpoints** — `write_checkpoint` reuses the same
+  capture path to produce one atomically-replaced file (tmp + fsync +
+  rename); `restore_checkpoint` reuses the same install+verify path
+  for crash-consistent fast restart. `RelayServer(checkpoint_
+  interval_s=...)` runs a `CheckpointWriter` loop.
+
+The relay stays E2EE-blind throughout (rows are plaintext timestamps +
+ciphertext; trees are digests of timestamps), and the relay side holds
+no device state — the client-side HBM winner cache contract
+(`ops/winner_cache.py`) is untouched; any engine jit caches are
+shape-keyed, not content-keyed, so a table swap invalidates nothing.
+
+Observability: the `evolu_snap_*` families (docs/OBSERVABILITY.md) and
+a `snapshot` section under `/stats` replication.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import uuid
+import zlib
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from evolu_tpu.core.merkle import (
+    apply_prefix_xors,
+    merkle_tree_from_string,
+    merkle_tree_to_string,
+    minute_deltas_host,
+)
+from evolu_tpu.obs import metrics
+from evolu_tpu.sync import protocol
+from evolu_tpu.utils.log import log
+
+# Chunk sizing: the default rides well under the relay's 20 MB body
+# cap; donors clamp puller-requested sizes into [64 KiB, 8 MiB].
+SNAPSHOT_CHUNK_BYTES = 4 << 20
+SNAPSHOT_MIN_CHUNK_BYTES = 64 << 10
+SNAPSHOT_MAX_CHUNK_BYTES = 8 << 20
+# How long a donor keeps a captured snapshot servable. Bounds memory
+# (max_entries × store size) while giving a puller ample time to drain
+# chunks; an expired id answers 400 and the puller restarts fresh.
+SNAPSHOT_TTL_S = 600.0
+
+_REC_MESSAGE = 0x4D  # 'M': u32 ts_len‖ts ‖ u32 uid_len‖uid ‖ u32 len‖content
+_REC_TREE = 0x54  # 'T': u32 uid_len‖uid ‖ u32 tree_len‖tree
+
+_U32 = struct.Struct("<I")
+
+_MESSAGE_SCHEMA = (
+    'CREATE TABLE "messageBsnap" ('
+    '"timestamp" TEXT, "userId" TEXT, "content" BLOB, '
+    'PRIMARY KEY ("userId", "timestamp")) WITHOUT ROWID'
+)
+_TREE_SCHEMA = (
+    'CREATE TABLE "merkleTreeBsnap" ('
+    '"userId" TEXT PRIMARY KEY, "merkleTree" TEXT)'
+)
+
+
+class SnapshotInstallError(Exception):
+    """A snapshot failed integrity/parity verification (crc mismatch,
+    recomputed tree != shipped tree, owner/count drift). The install
+    aborted; the live tables were never touched."""
+
+
+@contextmanager
+def _exclusive_txn(db):
+    """A transaction that is guaranteed to be OUR OWN. The store's
+    `transaction()` JOINS an already-open transaction, and the batch
+    engine's explicit begin/commit protocol releases the db lock
+    between statements — joining it would interleave capture reads or
+    install/swap DDL into a foreign write transaction (reading
+    uncommitted rows into a snapshot, or committing half a swap with
+    someone else's batch). Hold the db lock, wait out any open
+    transaction, then BEGIN for real. Engine transactions are
+    per-batch and bounded, so the wait is short."""
+    while True:
+        with db._lock:
+            conn = getattr(db, "_conn", None)  # PySqliteDatabase
+            open_txn = getattr(db, "_in_txn", False) or bool(
+                conn is not None and conn.in_transaction
+            )
+            if not open_txn:
+                with db.transaction():
+                    yield db
+                return
+        time.sleep(0.002)
+
+
+# --- framing ---
+
+
+def _frame_message(ts: str, uid: str, content: bytes) -> bytes:
+    t, u = ts.encode("utf-8"), uid.encode("utf-8")
+    return b"".join(
+        (bytes((_REC_MESSAGE,)), _U32.pack(len(t)), t, _U32.pack(len(u)), u,
+         _U32.pack(len(content)), content)
+    )
+
+
+def _frame_tree(uid: str, tree: str) -> bytes:
+    u, tr = uid.encode("utf-8"), tree.encode("utf-8")
+    return b"".join(
+        (bytes((_REC_TREE,)), _U32.pack(len(u)), u, _U32.pack(len(tr)), tr)
+    )
+
+
+def _take(data: bytes, pos: int) -> Tuple[bytes, int]:
+    if pos + 4 > len(data):
+        raise ValueError("truncated snapshot record length")
+    (n,) = _U32.unpack_from(data, pos)
+    pos += 4
+    field = data[pos : pos + n]
+    if len(field) != n:
+        raise ValueError("truncated snapshot record field")
+    return field, pos + n
+
+
+def _next_record(data: bytes, pos: int) -> Tuple[tuple, int]:
+    """One framed record at `pos` → (("M", ts, uid, content) |
+    ("T", uid, tree), next_pos). ValueError on malformed framing."""
+    t = data[pos]
+    if t == _REC_MESSAGE:
+        ts, pos = _take(data, pos + 1)
+        uid, pos = _take(data, pos)
+        content, pos = _take(data, pos)
+        return ("M", ts.decode("utf-8"), uid.decode("utf-8"), bytes(content)), pos
+    if t == _REC_TREE:
+        uid, pos = _take(data, pos + 1)
+        tree, pos = _take(data, pos)
+        return ("T", uid.decode("utf-8"), tree.decode("utf-8")), pos
+    raise ValueError(f"unknown snapshot record type {t:#x}")
+
+
+def iter_records(data: bytes, pos: int = 0):
+    """Yield every framed record in `data`; ValueError on malformed
+    framing (the installer treats that exactly like a crc failure)."""
+    end = len(data)
+    while pos < end:
+        rec, pos = _next_record(data, pos)
+        yield rec
+
+
+def _scan_stream(stream: bytes, chunk_bytes: int):
+    """ONE pass over the framed stream: chunk boundaries (split at
+    RECORD boundaries so every chunk parses standalone; at least one
+    record per chunk, an oversized record ships as its own chunk),
+    the message count, and the per-owner tree records.
+    → (chunks, message_count, [(uid, tree_text), ...])."""
+    chunks: List[bytes] = []
+    trees: List[Tuple[str, str]] = []
+    message_count = 0
+    pos = start = 0
+    end = len(stream)
+    while pos < end:
+        rec, nxt = _next_record(stream, pos)
+        if rec[0] == "M":
+            message_count += 1
+        else:
+            trees.append((rec[1], rec[2]))
+        if pos != start and nxt - start > chunk_bytes:
+            chunks.append(stream[start:pos])
+            start = pos
+        pos = nxt
+    if pos > start:
+        chunks.append(stream[start:pos])
+    return chunks, message_count, trees
+
+
+# --- capture ---
+
+
+def _capture_shard_py(db) -> bytes:
+    """The stdlib oracle: both SELECTs run inside the caller's read
+    transaction; ORDER BY matches the native leg (PK order for the
+    WITHOUT ROWID message table) so the two paths are byte-identical."""
+    out: List[bytes] = []
+    for r in db.exec_sql_query(
+        'SELECT "timestamp", "userId", "content" FROM "message" '
+        'ORDER BY "userId", "timestamp"'
+    ):
+        content = r["content"]
+        out.append(_frame_message(r["timestamp"], r["userId"],
+                                  content if content is not None else b""))
+    for r in db.exec_sql_query(
+        'SELECT "userId", "merkleTree" FROM "merkleTree" ORDER BY "userId"'
+    ):
+        out.append(_frame_tree(r["userId"], r["merkleTree"]))
+    return b"".join(out)
+
+
+def capture_shard(db) -> bytes:
+    """One shard's framed rows — the native one-C-call leg when the
+    backend offers it, else the stdlib oracle. Caller holds the read
+    transaction (the two SELECTs must see one consistent state)."""
+    if hasattr(db, "snapshot_rows"):
+        raw = db.snapshot_rows()
+        if raw is not None:  # None = stale .so without the symbol
+            return raw
+    return _capture_shard_py(db)
+
+
+def _shards_of(store) -> Sequence:
+    return getattr(store, "shards", None) or [store]
+
+
+def capture_snapshot(
+    store, chunk_bytes: int = SNAPSHOT_CHUNK_BYTES,
+    snapshot_id: Optional[str] = None,
+) -> Tuple[protocol.SnapshotManifest, List[bytes]]:
+    """→ (manifest, chunks). Consistency is per shard (one read
+    transaction each) — the store's own consistency unit: an owner
+    lives wholly inside one shard, so every owner's rows and tree are
+    mutually consistent, which is exactly what install verification
+    re-derives."""
+    parts: List[bytes] = []
+    for shard in _shards_of(store):
+        db = shard.db
+        with _exclusive_txn(db):
+            parts.append(capture_shard(db))
+    stream = b"".join(parts)
+    chunks, message_count, tree_recs = _scan_stream(stream, chunk_bytes)
+    owners: List[Tuple[str, int, int]] = []
+    for uid, tree in tree_recs:
+        root = merkle_tree_from_string(tree).get("hash") or 0
+        owners.append((uid, int(root), zlib.crc32(tree.encode("utf-8"))))
+    owners.sort()
+    manifest = protocol.SnapshotManifest(
+        snapshot_id or uuid.uuid4().hex,
+        tuple(len(c) for c in chunks),
+        tuple(zlib.crc32(c) for c in chunks),
+        tuple(owners),
+        message_count,
+        len(stream),
+    )
+    metrics.inc("evolu_snap_captures_total")
+    metrics.inc("evolu_snap_capture_rows_total", message_count)
+    metrics.inc("evolu_snap_capture_bytes_total", len(stream))
+    return manifest, chunks
+
+
+# --- donor-side snapshot cache + endpoint bodies ---
+
+
+class SnapshotCache:
+    """Keeps recently captured snapshots servable for resumable chunk
+    fetches. A fresh-enough unexpired capture with the same chunk size
+    is reused (N bootstrapping peers don't force N captures); entries
+    expire after `ttl_s` and the registry is capped at `max_entries`
+    (oldest evicted). Bounded staleness is fine — post-capture writes
+    flow through normal gossip from the watermark."""
+
+    def __init__(self, store, chunk_bytes: int = SNAPSHOT_CHUNK_BYTES,
+                 ttl_s: float = SNAPSHOT_TTL_S, max_entries: int = 2,
+                 clock=time.monotonic):
+        self._store = store
+        self.chunk_bytes = int(chunk_bytes)
+        self._ttl_s = float(ttl_s)
+        self._max_entries = int(max_entries)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # id -> (expires_at, chunk_bytes, manifest, chunks)
+        self._entries: Dict[str, tuple] = {}
+
+    def _clamp(self, requested: int) -> int:
+        cb = requested or self.chunk_bytes
+        return max(SNAPSHOT_MIN_CHUNK_BYTES, min(int(cb), SNAPSHOT_MAX_CHUNK_BYTES))
+
+    def manifest(self, requested_chunk_bytes: int = 0) -> protocol.SnapshotManifest:
+        cb = self._clamp(requested_chunk_bytes)
+        with self._lock:
+            now = self._clock()
+            self._entries = {
+                k: v for k, v in self._entries.items() if v[0] > now
+            }
+            for _sid, (_exp, entry_cb, manifest, _chunks) in self._entries.items():
+                if entry_cb == cb:
+                    return manifest
+        # Capture OUTSIDE the cache lock: chunk() must stay servable
+        # while a full-store capture runs, or one peer's manifest miss
+        # stalls every other peer's in-flight chunk fetches for the
+        # whole capture (long enough at scale to trip their snapshot
+        # TTLs). Two racing first-misses may both capture — rare and
+        # merely wasteful; both snapshots get registered and served.
+        manifest, chunks = capture_snapshot(self._store, cb)
+        with self._lock:
+            while len(self._entries) >= self._max_entries:
+                oldest = min(self._entries, key=lambda k: self._entries[k][0])
+                del self._entries[oldest]
+            self._entries[manifest.snapshot_id] = (
+                self._clock() + self._ttl_s, cb, manifest, chunks,
+            )
+        return manifest
+
+    def chunk(self, snapshot_id: str, index: int) -> protocol.SnapshotChunk:
+        with self._lock:
+            entry = self._entries.get(snapshot_id)
+            if entry is not None and entry[0] <= self._clock():
+                del self._entries[snapshot_id]
+                entry = None
+            if entry is None:
+                # ValueError → the relay answers 400; the puller reads
+                # a 400 on the chunk leg as "snapshot gone", drops its
+                # stale install state and restarts fresh.
+                raise ValueError(f"unknown or expired snapshot {snapshot_id!r}")
+            _exp, _cb, manifest, chunks = entry
+        if not 0 <= index < len(chunks):
+            raise ValueError(
+                f"snapshot chunk index {index} out of range 0..{len(chunks) - 1}"
+            )
+        payload = chunks[index]
+        return protocol.SnapshotChunk(
+            snapshot_id, index, manifest.chunk_crcs[index], payload
+        )
+
+
+def serve_snapshot(store, body: bytes, manager) -> bytes:
+    """Handler body for `POST /replicate/snapshot`: capture (or reuse a
+    fresh cached capture) and answer the manifest. ValueError only on
+    malformed input (wire-decoder contract → 400)."""
+    req = protocol.decode_snapshot_request(body)
+    manifest = manager.snapshot_cache.manifest(req.chunk_bytes)
+    metrics.inc("evolu_snap_manifests_served_total")
+    return protocol.encode_snapshot_manifest(manifest)
+
+
+def serve_snapshot_chunk(store, body: bytes, manager) -> bytes:
+    """Handler body for `POST /replicate/snapshot/chunk`: one ranged,
+    resumable chunk. Unknown/expired snapshot ids and out-of-range
+    indices answer 400 via ValueError — the puller's restart signal."""
+    req = protocol.decode_snapshot_chunk_request(body)
+    chunk = manager.snapshot_cache.chunk(req.snapshot_id, req.index)
+    metrics.inc("evolu_snap_chunks_served_total")
+    metrics.inc("evolu_snap_chunk_bytes_served_total", len(chunk.payload))
+    return protocol.encode_snapshot_chunk(chunk)
+
+
+# --- crash-consistent install ---
+
+
+class SnapshotInstaller:
+    """Installs a snapshot into side tables of the LIVE store with a
+    persisted chunk watermark, then verifies and atomically swaps.
+    All state (side tables + the `snapshotBootstrapState` key/value
+    table on shard 0) lives in the store's own SQLite files, so every
+    step inherits SQLite's crash consistency: a killed process resumes
+    from exactly the last committed watermark."""
+
+    def __init__(self, store):
+        self.store = store
+        self.shards = _shards_of(store)
+        self._state_db = self.shards[0].db
+        self._state_db.exec(
+            'CREATE TABLE IF NOT EXISTS "snapshotBootstrapState" '
+            '("key" TEXT PRIMARY KEY, "value" TEXT)'
+        )
+
+    # -- persisted state --
+
+    def _state_get(self) -> Dict[str, str]:
+        rows = self._state_db.exec_sql_query(
+            'SELECT "key", "value" FROM "snapshotBootstrapState"'
+        )
+        return {r["key"]: r["value"] for r in rows}
+
+    def _state_set(self, **kv) -> None:
+        db = self._state_db
+        with _exclusive_txn(db):
+            for k, v in kv.items():
+                db.run(
+                    'INSERT OR REPLACE INTO "snapshotBootstrapState" '
+                    '("key", "value") VALUES (?, ?)',
+                    (k, str(v)),
+                )
+
+    def _state_clear(self) -> None:
+        self._state_db.run('DELETE FROM "snapshotBootstrapState"')
+
+    def pending(self) -> Optional[dict]:
+        """The persisted install-in-progress, if any: {snapshot_id,
+        peer, manifest, next_chunk, phase}. Undecodable state (e.g. a
+        half-written row from a pre-crash schema) clears itself."""
+        st = self._state_get()
+        if not st or "manifest" not in st:
+            return None
+        try:
+            manifest = protocol.decode_snapshot_manifest(
+                bytes.fromhex(st["manifest"])
+            )
+            return {
+                "snapshot_id": st["snapshot_id"],
+                "peer": st.get("peer", ""),
+                "manifest": manifest,
+                "next_chunk": int(st.get("next_chunk", 0)),
+                "phase": st.get("phase", "fetch"),
+            }
+        except (ValueError, KeyError):
+            self._state_clear()
+            return None
+
+    # -- install steps --
+
+    def begin(self, manifest: protocol.SnapshotManifest, peer: str) -> None:
+        for shard in self.shards:
+            db = shard.db
+            with _exclusive_txn(db):
+                db.run('DROP TABLE IF EXISTS "messageBsnap"')
+                db.run('DROP TABLE IF EXISTS "merkleTreeBsnap"')
+                db.run(_MESSAGE_SCHEMA)
+                db.run(_TREE_SCHEMA)
+        self._state_set(
+            snapshot_id=manifest.snapshot_id,
+            peer=peer,
+            manifest=protocol.encode_snapshot_manifest(manifest).hex(),
+            next_chunk=0,
+            phase="fetch",
+        )
+
+    def _shard_db(self, uid: str):
+        if hasattr(self.store, "shard_of"):
+            return self.store.shard_of(uid).db
+        return self.shards[0].db
+
+    def install_chunk(self, index: int, payload: bytes,
+                      expected_crc: Optional[int] = None) -> int:
+        """Parse one chunk and commit its rows into the side tables —
+        one transaction per destination shard, then the watermark.
+        Re-applying a chunk (crash between a shard commit and the
+        watermark) is idempotent: same PKs, INSERT OR IGNORE /
+        OR REPLACE. Returns the number of message rows."""
+        if expected_crc is not None and zlib.crc32(payload) != expected_crc:
+            raise SnapshotInstallError(
+                f"snapshot chunk {index}: crc mismatch "
+                f"({zlib.crc32(payload):08x} != {expected_crc:08x})"
+            )
+        by_shard: Dict[int, Tuple[list, list]] = {}
+        n_msgs = 0
+        try:
+            for rec in iter_records(payload):
+                uid = rec[2] if rec[0] == "M" else rec[1]
+                si = (self.store.shard_index(uid)
+                      if hasattr(self.store, "shard_index") else 0)
+                msgs, trees = by_shard.setdefault(si, ([], []))
+                if rec[0] == "M":
+                    msgs.append((rec[1], rec[2], rec[3]))
+                    n_msgs += 1
+                else:
+                    trees.append((rec[1], rec[2]))
+        except ValueError as e:
+            raise SnapshotInstallError(f"snapshot chunk {index}: {e}") from e
+        for si, (msgs, trees) in sorted(by_shard.items()):
+            db = self.shards[si].db
+            with _exclusive_txn(db):
+                if msgs:
+                    db.run_many(
+                        'INSERT OR IGNORE INTO "messageBsnap" '
+                        '("timestamp", "userId", "content") VALUES (?, ?, ?)',
+                        msgs,
+                    )
+                if trees:
+                    db.run_many(
+                        'INSERT OR REPLACE INTO "merkleTreeBsnap" '
+                        '("userId", "merkleTree") VALUES (?, ?)',
+                        trees,
+                    )
+        self._state_set(next_chunk=index + 1)
+        return n_msgs
+
+    def verify(self, manifest: protocol.SnapshotManifest) -> None:
+        """Golden-parity gate: recompute EVERY owner's Merkle tree from
+        the installed rows and demand byte-identity with the shipped
+        tree text and the manifest watermarks, plus exact owner-set and
+        row-count agreement. Any mismatch aborts before the live
+        tables are touched."""
+        shipped: Dict[str, str] = {}
+        total = 0
+        for shard in self.shards:
+            for r in shard.db.exec_sql_query(
+                'SELECT "userId", "merkleTree" FROM "merkleTreeBsnap"'
+            ):
+                shipped[r["userId"]] = r["merkleTree"]
+            total += shard.db.exec_sql_query(
+                'SELECT COUNT(*) AS n FROM "messageBsnap"'
+            )[0]["n"]
+        by_owner = {uid: (root, crc) for uid, root, crc in manifest.owners}
+        if set(shipped) != set(by_owner):
+            raise SnapshotInstallError(
+                f"snapshot owner set mismatch: manifest has "
+                f"{len(by_owner)} owners, stream delivered {len(shipped)}"
+            )
+        if total != manifest.message_count:
+            raise SnapshotInstallError(
+                f"snapshot row count mismatch: manifest says "
+                f"{manifest.message_count}, installed {total}"
+            )
+        for uid, tree_text in shipped.items():
+            db = self._shard_db(uid)
+            ts = [
+                r["timestamp"]
+                for r in db.exec_sql_query(
+                    'SELECT "timestamp" FROM "messageBsnap" WHERE "userId" = ?',
+                    (uid,),
+                )
+            ]
+            deltas, _digest = minute_deltas_host(ts)
+            recomputed = merkle_tree_to_string(apply_prefix_xors({}, deltas))
+            root, crc = by_owner[uid]
+            if (
+                recomputed != tree_text
+                or zlib.crc32(recomputed.encode("utf-8")) != crc
+                or (merkle_tree_from_string(recomputed).get("hash") or 0) != root
+            ):
+                metrics.inc("evolu_snap_verify_failures_total")
+                raise SnapshotInstallError(
+                    f"snapshot tree verification failed for owner {uid!r}: "
+                    "recomputed tree is not byte-identical to the manifest "
+                    "watermark"
+                )
+
+    def _merge_live_rows_locked(self, db) -> int:
+        """Inside an ALREADY-HELD exclusive transaction on `db`: fold
+        every live row the snapshot lacks into the side tables through
+        the relay's own changes==1 XOR gate — a lagging (not empty)
+        peer must not lose rows the donor never had, and a client
+        write accepted DURING the install must survive the swap
+        (running inside the swap's own transaction closes that window:
+        no writer can land between this scan and the table rename).
+        The swapped-in trees stay exact unions. No-op for an empty
+        store."""
+        merged = 0
+        owners = [
+            r["userId"]
+            for r in db.exec_sql_query('SELECT DISTINCT "userId" FROM "message"')
+        ]
+        for uid in owners:
+            # Anti-join instead of per-row INSERT+changes probing: ONE
+            # SELECT names exactly the rows the snapshot lacks (both
+            # tables are PK-unique on (userId, timestamp), so the fresh
+            # set IS the inserted set), then ONE bulk insert — this
+            # runs inside the swap's exclusive transaction, where a
+            # per-row Python loop over a big lagging store would stall
+            # every handler thread on the store lock.
+            fresh_rows = db.exec_sql_query(
+                'SELECT "timestamp", "content" FROM "message" AS m '
+                'WHERE "userId" = ? AND NOT EXISTS ('
+                'SELECT 1 FROM "messageBsnap" AS b '
+                'WHERE b."userId" = m."userId" '
+                'AND b."timestamp" = m."timestamp")',
+                (uid,),
+            )
+            if not fresh_rows:
+                continue
+            db.run_many(
+                'INSERT OR IGNORE INTO "messageBsnap" '
+                '("timestamp", "userId", "content") VALUES (?, ?, ?)',
+                [(r["timestamp"], uid, r["content"]) for r in fresh_rows],
+            )
+            got = db.exec_sql_query(
+                'SELECT "merkleTree" FROM "merkleTreeBsnap" '
+                'WHERE "userId" = ?',
+                (uid,),
+            )
+            tree = merkle_tree_from_string(
+                got[0]["merkleTree"] if got else "{}"
+            )
+            deltas, _d = minute_deltas_host(
+                [r["timestamp"] for r in fresh_rows]
+            )
+            db.run(
+                'INSERT OR REPLACE INTO "merkleTreeBsnap" '
+                '("userId", "merkleTree") VALUES (?, ?)',
+                (uid, merkle_tree_to_string(apply_prefix_xors(tree, deltas))),
+            )
+            merged += len(fresh_rows)
+        return merged
+
+    def swap(self) -> None:
+        """Mark phase=swap, then swap every shard. The phase marker
+        makes a crash between shard swaps resumable: `finish_swap` is
+        idempotent (skips shards whose side tables are already gone)."""
+        self._state_set(phase="swap")
+        self.finish_swap()
+
+    def finish_swap(self) -> None:
+        """Per shard, in ONE exclusive transaction: merge live rows
+        the snapshot lacks (see `_merge_live_rows_locked`), then
+        DROP + RENAME. Everything a client wrote up to the instant the
+        rename commits is either in the snapshot or merged here —
+        an acknowledged write can never vanish in the swap."""
+        merged = 0
+        for shard in self.shards:
+            db = shard.db
+            with _exclusive_txn(db):
+                have = db.exec_sql_query(
+                    "SELECT name FROM sqlite_master WHERE type='table' "
+                    "AND name='messageBsnap'"
+                )
+                if not have:
+                    continue  # this shard already swapped (resume)
+                merged += self._merge_live_rows_locked(db)
+                db.run('DROP TABLE "message"')
+                db.run('ALTER TABLE "messageBsnap" RENAME TO "message"')
+                db.run('DROP TABLE "merkleTree"')
+                db.run('ALTER TABLE "merkleTreeBsnap" RENAME TO "merkleTree"')
+        if merged:
+            metrics.inc("evolu_snap_local_rows_merged_total", merged)
+        self._state_clear()
+
+    def abort(self) -> None:
+        for shard in self.shards:
+            db = shard.db
+            with _exclusive_txn(db):
+                db.run('DROP TABLE IF EXISTS "messageBsnap"')
+                db.run('DROP TABLE IF EXISTS "merkleTreeBsnap"')
+        self._state_clear()
+
+
+def install_stream(
+    store,
+    manifest: protocol.SnapshotManifest,
+    chunks: Iterable[bytes],
+    source: str = "<local>",
+) -> None:
+    """Install a fully-materialized snapshot (the checkpoint-restore
+    path; the network bootstrap drives `SnapshotInstaller` itself so it
+    can persist the watermark between fetches)."""
+    inst = SnapshotInstaller(store)
+    inst.begin(manifest, source)
+    t0 = time.perf_counter()
+    try:
+        for i, payload in enumerate(chunks):
+            inst.install_chunk(i, payload, expected_crc=manifest.chunk_crcs[i])
+        inst.verify(manifest)
+    except BaseException:
+        inst.abort()
+        raise
+    inst.swap()
+    metrics.observe("evolu_snap_install_ms", (time.perf_counter() - t0) * 1e3)
+    metrics.inc("evolu_snap_installs_total", result="ok")
+
+
+# --- local checkpoints ---
+
+CHECKPOINT_MAGIC = b"EVOLUSNAP1\n"
+
+
+def write_checkpoint(store, path: str,
+                     chunk_bytes: int = SNAPSHOT_CHUNK_BYTES) -> protocol.SnapshotManifest:
+    """Capture the store and atomically replace the checkpoint file
+    (tmp + fsync + rename): a crash mid-write leaves the previous
+    checkpoint intact — the file is always a complete, crc-covered
+    snapshot or absent."""
+    manifest, chunks = capture_snapshot(store, chunk_bytes)
+    blob = protocol.encode_snapshot_manifest(manifest)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(CHECKPOINT_MAGIC)
+        f.write(_U32.pack(len(blob)))
+        f.write(blob)
+        for c in chunks:
+            f.write(c)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    # fsync the parent directory too: without it the rename's directory
+    # entry may not survive power loss, and a counted checkpoint could
+    # silently revert/vanish — the "complete or absent" claim must hold
+    # across power failure, not just process crash.
+    dir_fd = os.open(os.path.dirname(os.path.abspath(path)) or ".", os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+    metrics.inc("evolu_snap_checkpoints_total")
+    metrics.set_gauge("evolu_snap_checkpoint_bytes", manifest.total_bytes)
+    return manifest
+
+
+def read_checkpoint(path: str) -> Tuple[protocol.SnapshotManifest, List[bytes]]:
+    """→ (manifest, chunks), crc-verified. ValueError on any
+    corruption — a torn or tampered checkpoint never half-installs."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if not data.startswith(CHECKPOINT_MAGIC):
+        raise ValueError(f"not an evolu snapshot checkpoint: {path!r}")
+    pos = len(CHECKPOINT_MAGIC)
+    if pos + 4 > len(data):
+        raise ValueError("truncated checkpoint header")
+    (n,) = _U32.unpack_from(data, pos)
+    pos += 4
+    manifest = protocol.decode_snapshot_manifest(data[pos : pos + n])
+    pos += n
+    chunks: List[bytes] = []
+    for i, size in enumerate(manifest.chunk_sizes):
+        payload = data[pos : pos + size]
+        if len(payload) != size:
+            raise ValueError(f"truncated checkpoint chunk {i}")
+        if zlib.crc32(payload) != manifest.chunk_crcs[i]:
+            raise ValueError(f"checkpoint chunk {i} crc mismatch")
+        chunks.append(payload)
+        pos += size
+    if pos != len(data):
+        raise ValueError("trailing bytes after the last checkpoint chunk")
+    return manifest, chunks
+
+
+def restore_checkpoint(store, path: str) -> protocol.SnapshotManifest:
+    """Rebuild a store from a checkpoint file through the same
+    install+verify path a peer bootstrap uses (golden-parity trees or
+    the restore aborts). Pre-existing local rows merge through the XOR
+    gate, exactly like a lagging-peer bootstrap."""
+    manifest, chunks = read_checkpoint(path)
+    install_stream(store, manifest, chunks, source=f"checkpoint:{path}")
+    return manifest
+
+
+class CheckpointWriter:
+    """Periodic local checkpoints for crash-consistent fast restart
+    (`RelayServer(checkpoint_interval_s=...)`). Failures are counted
+    and logged, never fatal — the previous checkpoint stays valid."""
+
+    def __init__(self, store, path: str, interval_s: float,
+                 chunk_bytes: int = SNAPSHOT_CHUNK_BYTES):
+        self.store = store
+        self.path = path
+        self.interval_s = float(interval_s)
+        self.chunk_bytes = int(chunk_bytes)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "CheckpointWriter":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="evolu-checkpoint"
+            )
+            self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                write_checkpoint(self.store, self.path, self.chunk_bytes)
+            except Exception as e:  # noqa: BLE001 - keep checkpointing
+                metrics.inc("evolu_snap_checkpoint_failures_total")
+                log("server", "checkpoint write failed", path=self.path,
+                    error=repr(e))
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None

@@ -94,6 +94,8 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.eh_get_messages_wire.argtypes = [
         p, s, c.c_int32, s, s, c.c_int32, c.POINTER(p), i64p, i64p,
     ]
+    if hasattr(lib, "eh_snapshot_rows"):  # stale pre-r7 .so lacks it
+        lib.eh_snapshot_rows.argtypes = [p, c.POINTER(p), i64p, i64p, i64p]
     return lib
 
 
@@ -736,6 +738,36 @@ class CppSqliteDatabase:
             raise UnknownError("apply_planned_cells: cell index out of range")
         if rc != 0:
             raise self._err()
+
+    def snapshot_rows(self) -> Optional[bytes]:
+        """Whole-shard snapshot capture in ONE C call: every message
+        row + merkleTree row as framed records (server/snapshot.py
+        format), byte-identical to the stdlib oracle framing
+        (parity-pinned in tests/test_snapshot.py). None on a stale
+        pre-r7 .so (loader's "binary exists, no make" path) — the
+        caller degrades to the SQL oracle. The caller holds the read
+        transaction (consistency across the two internal SELECTs)."""
+        lib = self._lib
+        if not hasattr(lib, "eh_snapshot_rows"):
+            return None
+        out = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        n_msgs = ctypes.c_int64()
+        n_trees = ctypes.c_int64()
+        with self._lock:
+            self._check_open()
+            rc = lib.eh_snapshot_rows(
+                self._db, ctypes.byref(out), ctypes.byref(out_len),
+                ctypes.byref(n_msgs), ctypes.byref(n_trees),
+            )
+        if rc == 3:
+            raise UnknownError("snapshot capture failed (out of memory?)")
+        if rc != 0:
+            raise self._err()
+        try:
+            return ctypes.string_at(out.value, out_len.value)
+        finally:
+            lib.eh_free(out)
 
     def fetch_relay_messages(
         self, user_id: str, since: str, node_id: str

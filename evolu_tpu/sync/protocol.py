@@ -442,6 +442,209 @@ def decode_replica_pull_response(data: bytes) -> ReplicaPullResponse:
     return ReplicaPullResponse(tuple(chunks))
 
 
+# --- snapshot checkpoint & peer bootstrap messages (extension — no
+# reference equivalent; see evolu_tpu/server/snapshot.py). Same
+# hand-rolled proto3 subset, same ValueError-only decoder contract,
+# same E2EE-blindness (the framed row stream carries exactly what the
+# relay already stores: plaintext timestamps + ciphertext blobs). ---
+#
+#     SnapshotRequest      { replicaId=1 chunkBytes=2 }
+#     SnapshotOwner        { userId=1 rootHash=2 treeCrc=3 }
+#     SnapshotManifest     { snapshotId=1 chunkSizes=2 (repeated)
+#                            chunkCrcs=3 (repeated)
+#                            owners=4 (repeated SnapshotOwner)
+#                            messageCount=5 totalBytes=6 }
+#     SnapshotChunkRequest { snapshotId=1 index=2 replicaId=3 }
+#     SnapshotChunk        { snapshotId=1 index=2 crc=3 payload=4 }
+
+
+@dataclass(frozen=True)
+class SnapshotRequest:
+    """Asks a donor relay for a consistent snapshot manifest.
+    `chunk_bytes` is the puller's preferred chunk size (0 = donor
+    default; the donor clamps it under its body cap either way)."""
+
+    replica_id: str
+    chunk_bytes: int = 0
+
+
+@dataclass(frozen=True)
+class SnapshotManifest:
+    """The snapshot contract: chunk sizes + crc32s for resumable ranged
+    fetches, and per-owner watermarks — the Merkle ROOT hash (JS signed
+    int32) plus a crc32 of the owner's serialized tree text at capture
+    time. After install the puller recomputes every owner's tree from
+    the shipped rows and verifies byte-identity against the shipped
+    tree text AND these digests; gossip then resumes from exactly this
+    watermark (trees equal ⇒ the first summary exchange diffs only
+    post-snapshot writes)."""
+
+    snapshot_id: str
+    chunk_sizes: Tuple[int, ...]
+    chunk_crcs: Tuple[int, ...]
+    owners: Tuple[Tuple[str, int, int], ...]  # (owner, root_hash, tree_crc)
+    message_count: int
+    total_bytes: int
+
+
+@dataclass(frozen=True)
+class SnapshotChunkRequest:
+    snapshot_id: str
+    index: int
+    replica_id: str = ""
+
+
+@dataclass(frozen=True)
+class SnapshotChunk:
+    snapshot_id: str
+    index: int
+    crc: int  # crc32 of payload — checked against the manifest too
+    payload: bytes
+
+
+def encode_snapshot_request(r: SnapshotRequest) -> bytes:
+    out = _string(1, r.replica_id)
+    if r.chunk_bytes:
+        out += _tag(2, 0) + _varint(r.chunk_bytes)
+    return out
+
+
+@_wire_decoder
+def decode_snapshot_request(data: bytes) -> SnapshotRequest:
+    replica_id, chunk_bytes = "", 0
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            replica_id = v.decode("utf-8")
+        elif num == 2:
+            chunk_bytes = int(v)
+    return SnapshotRequest(replica_id, chunk_bytes)
+
+
+def encode_snapshot_manifest(m: SnapshotManifest) -> bytes:
+    out = _string(1, m.snapshot_id)
+    out += b"".join(_tag(2, 0) + _varint(s) for s in m.chunk_sizes)
+    out += b"".join(_tag(3, 0) + _varint(c) for c in m.chunk_crcs)
+    for uid, root_hash, tree_crc in m.owners:
+        inner = _string(1, uid) + _tag(2, 0) + _varint(root_hash)
+        inner += _tag(3, 0) + _varint(tree_crc)
+        out += _len_delimited(4, inner)
+    out += _tag(5, 0) + _varint(m.message_count)
+    out += _tag(6, 0) + _varint(m.total_bytes)
+    return out
+
+
+@_wire_decoder
+def _decode_snapshot_owner(data: bytes) -> Tuple[str, int, int]:
+    uid, root_hash, tree_crc = "", 0, 0
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            uid = v.decode("utf-8")
+        elif num == 2:
+            # Merkle root hashes are JS signed int32 (core/merkle.py);
+            # negatives ride as 10-byte two's-complement varints like
+            # the int32 value field — truncate identically on decode.
+            root_hash = ((int(v) & 0xFFFFFFFF) ^ 0x80000000) - 0x80000000
+        elif num == 3:
+            tree_crc = int(v) & 0xFFFFFFFF
+    return uid, root_hash, tree_crc
+
+
+@_wire_decoder
+def decode_snapshot_manifest(data: bytes) -> SnapshotManifest:
+    snapshot_id = ""
+    chunk_sizes: List[int] = []
+    chunk_crcs: List[int] = []
+    owners: List[Tuple[str, int, int]] = []
+    message_count = total_bytes = 0
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            snapshot_id = v.decode("utf-8")
+        elif num == 2:
+            chunk_sizes.append(int(v))
+        elif num == 3:
+            chunk_crcs.append(int(v) & 0xFFFFFFFF)
+        elif num == 4:
+            if wt != 2:
+                raise ValueError(f"snapshot owner field has wire type {wt}")
+            owners.append(_decode_snapshot_owner(v))
+        elif num == 5:
+            message_count = int(v)
+        elif num == 6:
+            total_bytes = int(v)
+    if len(chunk_sizes) != len(chunk_crcs):
+        raise ValueError(
+            f"snapshot manifest chunk sizes ({len(chunk_sizes)}) and crcs "
+            f"({len(chunk_crcs)}) disagree"
+        )
+    return SnapshotManifest(
+        snapshot_id, tuple(chunk_sizes), tuple(chunk_crcs), tuple(owners),
+        message_count, total_bytes,
+    )
+
+
+def encode_snapshot_chunk_request(r: SnapshotChunkRequest) -> bytes:
+    return (
+        _string(1, r.snapshot_id)
+        + _tag(2, 0) + _varint(r.index)
+        + _string(3, r.replica_id)
+    )
+
+
+@_wire_decoder
+def decode_snapshot_chunk_request(data: bytes) -> SnapshotChunkRequest:
+    snapshot_id = replica_id = ""
+    index = 0
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            snapshot_id = v.decode("utf-8")
+        elif num == 2:
+            index = int(v)
+        elif num == 3:
+            replica_id = v.decode("utf-8")
+    return SnapshotChunkRequest(snapshot_id, index, replica_id)
+
+
+def encode_snapshot_chunk(c: SnapshotChunk) -> bytes:
+    return (
+        _string(1, c.snapshot_id)
+        + _tag(2, 0) + _varint(c.index)
+        + _tag(3, 0) + _varint(c.crc)
+        + _len_delimited(4, c.payload)
+    )
+
+
+@_wire_decoder
+def decode_snapshot_chunk(data: bytes) -> SnapshotChunk:
+    snapshot_id = ""
+    index = crc = 0
+    payload = b""
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            snapshot_id = v.decode("utf-8")
+        elif num == 2:
+            index = int(v)
+        elif num == 3:
+            crc = int(v) & 0xFFFFFFFF
+        elif num == 4:
+            if wt != 2:
+                # A varint here would make bytes(v) ALLOCATE v zero
+                # bytes — same remote memory-DoS shape as the content
+                # field of EncryptedCrdtMessage.
+                raise ValueError(f"payload field has wire type {wt}")
+            payload = bytes(v)
+    return SnapshotChunk(snapshot_id, index, crc, payload)
+
+
 @_wire_decoder
 def decode_sync_response(data: bytes) -> SyncResponse:
     messages: List[EncryptedCrdtMessage] = []

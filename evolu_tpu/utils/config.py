@@ -55,6 +55,28 @@ class Config:
     # always relay verbatim, and reference-range traffic is
     # byte-identical either way.
     wire_extensions: bool = True
+    # -- relay fleet knobs (no reference equivalent). These are LIVE
+    # defaults: `RelayServer` / `ReplicationManager` resolve any
+    # constructor arg left at None from the process `default_config`
+    # (set_config before constructing relays), so embedders can tune a
+    # fleet in one place without threading kwargs everywhere. --
+    # serve_pull response budgets: at most this many messages per owner
+    # and per response in one anti-entropy pull answer. None = the
+    # server defaults (8192 / 65536, `replicate.PULL_MESSAGES_PER_*`).
+    # Smaller values bound gossip-round latency; the snapshot-bootstrap
+    # bench sweeps them honestly (benchmarks/snapshot_bootstrap.py).
+    pull_messages_per_owner: "int | None" = None
+    pull_messages_per_response: "int | None" = None
+    # Snapshot bootstrap trigger (server/snapshot.py): a relay whose
+    # store is empty — or lacking at least this many owners a peer
+    # advertises — installs a full snapshot instead of crawling history
+    # through capped pulls. None disables (incremental-only, the PR-3
+    # behavior).
+    bootstrap_lag_owners: "int | None" = None
+    # Periodic local snapshot checkpoints for crash-consistent fast
+    # restart (RelayServer(checkpoint_interval_s=...) →
+    # snapshot.CheckpointWriter). None disables.
+    checkpoint_interval_s: "float | None" = None
     # After a swallowed offline sync failure, probe the relay's
     # GET /ping starting at this cadence in seconds (backing off 2x per
     # failure up to 30s); the first success fires the reconnect hook

@@ -25,9 +25,13 @@ def main() -> None:
     ap.add_argument("--db", default="relay.db")
     ap.add_argument("--host", default="0.0.0.0")
     ap.add_argument("--port", type=int, default=int(os.environ.get("PORT", 4000)))
+    ap.add_argument("--checkpoint-interval", type=float, default=None,
+                    help="write a local snapshot checkpoint every N seconds "
+                         "(crash-consistent fast restart; server/snapshot.py)")
     args = ap.parse_args()
 
-    server = RelayServer(RelayStore(args.db), host=args.host, port=args.port)
+    server = RelayServer(RelayStore(args.db), host=args.host, port=args.port,
+                         checkpoint_interval_s=args.checkpoint_interval)
     server.start()
     print(f"relay listening on {server.url} (db: {args.db})")
     try:

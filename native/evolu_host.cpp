@@ -808,6 +808,81 @@ int eh_get_messages_wire(sqlite3 *db, const char *user, int32_t user_len,
   return 0;
 }
 
+// --- snapshot capture (server/snapshot.py) ---
+//
+// Every `message` row and `merkleTree` row of one shard, packed into
+// ONE malloc'd buffer of framed records the caller frees with eh_free:
+//   'M' (0x4D): u32 ts_len‖ts ‖ u32 uid_len‖uid ‖ u32 len‖content
+//   'T' (0x54): u32 uid_len‖uid ‖ u32 tree_len‖tree
+// (little-endian lengths, explicit everywhere — timestamps/ids may be
+// any width, contents are ciphertext blobs with possible NULs). Rows
+// stream in PK order (userId, timestamp) and trees by userId, exactly
+// matching the stdlib oracle `snapshot._capture_shard_py`, so the two
+// paths are byte-identical (parity-pinned). The caller wraps this in
+// a read transaction — the two SELECTs must see one consistent state.
+int eh_snapshot_rows(sqlite3 *db, unsigned char **out, int64_t *out_len,
+                     int64_t *out_msgs, int64_t *out_trees) {
+  std::string buf;
+  auto put_u32 = [&buf](uint32_t v) {
+    buf.append(reinterpret_cast<const char *>(&v), 4);
+  };
+  sqlite3_stmt *st = nullptr;
+  const char *msg_sql =
+      "SELECT \"timestamp\", \"userId\", \"content\" FROM \"message\" "
+      "ORDER BY \"userId\", \"timestamp\"";
+  if (sqlite3_prepare_v2(db, msg_sql, -1, &st, nullptr) != SQLITE_OK) return 1;
+  int64_t msgs = 0;
+  int rc;
+  while ((rc = sqlite3_step(st)) == SQLITE_ROW) {
+    const unsigned char *ts = sqlite3_column_text(st, 0);
+    uint32_t ts_len = uint32_t(sqlite3_column_bytes(st, 0));
+    const unsigned char *uid = sqlite3_column_text(st, 1);
+    uint32_t uid_len = uint32_t(sqlite3_column_bytes(st, 1));
+    const void *blob = sqlite3_column_blob(st, 2);
+    uint32_t blen = uint32_t(sqlite3_column_bytes(st, 2));
+    buf.push_back(char(0x4D));
+    put_u32(ts_len);
+    if (ts_len) buf.append(reinterpret_cast<const char *>(ts), ts_len);
+    put_u32(uid_len);
+    if (uid_len) buf.append(reinterpret_cast<const char *>(uid), uid_len);
+    put_u32(blen);
+    if (blen) buf.append(static_cast<const char *>(blob), blen);
+    msgs++;
+  }
+  sqlite3_finalize(st);
+  if (rc != SQLITE_DONE) return 1;
+
+  const char *tree_sql =
+      "SELECT \"userId\", \"merkleTree\" FROM \"merkleTree\" "
+      "ORDER BY \"userId\"";
+  if (sqlite3_prepare_v2(db, tree_sql, -1, &st, nullptr) != SQLITE_OK) return 1;
+  int64_t trees = 0;
+  while ((rc = sqlite3_step(st)) == SQLITE_ROW) {
+    const unsigned char *uid = sqlite3_column_text(st, 0);
+    uint32_t uid_len = uint32_t(sqlite3_column_bytes(st, 0));
+    const unsigned char *tr = sqlite3_column_text(st, 1);
+    uint32_t tr_len = uint32_t(sqlite3_column_bytes(st, 1));
+    buf.push_back(char(0x54));
+    put_u32(uid_len);
+    if (uid_len) buf.append(reinterpret_cast<const char *>(uid), uid_len);
+    put_u32(tr_len);
+    if (tr_len) buf.append(reinterpret_cast<const char *>(tr), tr_len);
+    trees++;
+  }
+  sqlite3_finalize(st);
+  if (rc != SQLITE_DONE) return 1;
+
+  unsigned char *p =
+      static_cast<unsigned char *>(malloc(buf.size() ? buf.size() : 1));
+  if (!p) return 3;
+  memcpy(p, buf.data(), buf.size());
+  *out = p;
+  *out_len = static_cast<int64_t>(buf.size());
+  *out_msgs = msgs;
+  *out_trees = trees;
+  return 0;
+}
+
 // --- packed query reader (SURVEY hot loop #4) ---
 //
 // Step an already-bound statement to completion and pack every row

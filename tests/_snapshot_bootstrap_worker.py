@@ -1,0 +1,64 @@
+"""Subprocess worker for the SIGKILL-mid-bootstrap crash test
+(tests/test_snapshot.py::test_sigkill_between_chunks_resumes_from_watermark).
+
+Runs ONE snapshot bootstrap of a file-backed relay store against a
+donor relay URL, printing a `CHUNK <i>` line after each chunk's rows +
+watermark COMMIT (and then sleeping `delay_s`, so the parent can
+SIGKILL this process deterministically BETWEEN chunks). On completion
+prints `DONE crc=<state crc>` — the parent compares it against the
+donor's own state crc for byte-identity.
+
+    python tests/_snapshot_bootstrap_worker.py <donor_url> <db_path> <delay_s>
+"""
+
+import os
+import sys
+import time
+import zlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    donor_url, db_path, delay_s = sys.argv[1], sys.argv[2], float(sys.argv[3])
+
+    from evolu_tpu.server import snapshot
+    from evolu_tpu.server.relay import RelayStore
+    from evolu_tpu.server.replicate import ReplicationManager
+    from evolu_tpu.sync.client import _http_post
+
+    orig_install = snapshot.SnapshotInstaller.install_chunk
+
+    def traced_install(self, index, payload, expected_crc=None):
+        n = orig_install(self, index, payload, expected_crc)
+        # The watermark for `index` is COMMITTED at this point: a kill
+        # during the sleep below is exactly "between snapshot chunks".
+        print(f"CHUNK {index}", flush=True)
+        if delay_s:
+            time.sleep(delay_s)
+        return n
+
+    snapshot.SnapshotInstaller.install_chunk = traced_install
+
+    store = RelayStore(db_path)
+    mgr = ReplicationManager(
+        store, [donor_url], replica_id="kill-victim",
+        bootstrap_lag_owners=1, snapshot_chunk_bytes=64 * 1024,
+        http_post=lambda u, d: _http_post(u, d, retries=0),
+    )
+    mgr.run_once()
+
+    crc = 0
+    for u in sorted(store.user_ids()):
+        crc = zlib.crc32(store.get_merkle_tree_string(u).encode(), crc)
+        for m in store.replica_messages(u, ""):
+            crc = zlib.crc32(m.timestamp.encode(), crc)
+            crc = zlib.crc32(m.content, crc)
+    print(f"DONE crc={crc:08x}", flush=True)
+    mgr.stop()
+    store.close()
+
+
+if __name__ == "__main__":
+    main()

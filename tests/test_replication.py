@@ -195,11 +195,12 @@ def test_replica_wire_decoders_raise_valueerror_only():
 
 def test_unconfigured_relay_hides_the_replication_surface():
     """A relay WITHOUT replication configured answers 404 on
-    /replicate/* — the summary endpoint enumerates owner ids, which
-    are capabilities on the sync path."""
+    /replicate/* — the summary endpoint (and the snapshot manifest)
+    enumerate owner ids, which are capabilities on the sync path."""
     server = RelayServer(RelayStore()).start()
     try:
-        for path in ("/replicate/summary", "/replicate/pull"):
+        for path in ("/replicate/summary", "/replicate/pull",
+                     "/replicate/snapshot", "/replicate/snapshot/chunk"):
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _post(server.url + path, b"")
             assert ei.value.code == 404
@@ -210,10 +211,70 @@ def test_unconfigured_relay_hides_the_replication_surface():
 def test_malformed_replicate_body_answers_400():
     server = RelayServer(RelayStore(), peers=[]).start()
     try:
-        for path in ("/replicate/summary", "/replicate/pull"):
+        for path in ("/replicate/summary", "/replicate/pull",
+                     "/replicate/snapshot/chunk"):
             with pytest.raises(urllib.error.HTTPError) as ei:
                 _post(server.url + path, b"\xff\xff\xff")
             assert ei.value.code == 400
+        # An unknown configured sub-path stays a 404, not a crash.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.url + "/replicate/nope", b"")
+        assert ei.value.code == 404
+    finally:
+        server.stop()
+
+
+def _post_raw_content_length(url, path, content_length):
+    """POST with an arbitrary (possibly hostile) Content-Length header
+    over a raw socket — urllib would refuse to send these."""
+    import socket
+    from urllib.parse import urlsplit
+
+    parts = urlsplit(url)
+    with socket.create_connection((parts.hostname, parts.port), timeout=10) as s:
+        req = (
+            f"POST {path} HTTP/1.1\r\nHost: {parts.netloc}\r\n"
+            f"Content-Length: {content_length}\r\n"
+            "Content-Type: application/octet-stream\r\n\r\n"
+        )
+        s.sendall(req.encode("ascii"))
+        s.settimeout(10)
+        data = b""
+        while b"\r\n" not in data:
+            got = s.recv(4096)
+            if not got:
+                break
+            data += got
+        status = data.split(b"\r\n", 1)[0].decode("ascii", "replace")
+        return int(status.split()[1])
+
+
+def test_hostile_content_length_answers_400_on_both_handlers():
+    """Satellite hardening: a non-numeric Content-Length used to raise
+    an uncaught ValueError out of `int(...)` (connection reset), and a
+    NEGATIVE value passed the `> MAX_BODY_BYTES` check and then
+    `rfile.read(-1)` read UNBOUNDED. Both must answer 400 — on the
+    sync handler (do_POST) and the replicate handler alike — and the
+    server must stay serviceable afterwards."""
+    server = RelayServer(RelayStore(), peers=[]).start()
+    try:
+        for path in ("/", "/replicate/summary"):
+            for hostile in ("banana", "-1", "-999999999", "12abc", ""):
+                code = _post_raw_content_length(server.url, path, hostile)
+                assert code == 400, (path, hostile, code)
+        # Oversize still answers 413 (the cap, distinct from 400).
+        for path in ("/", "/replicate/summary"):
+            code = _post_raw_content_length(
+                server.url, path, 20 * 1024 * 1024 + 1
+            )
+            assert code == 413, (path, code)
+        # The relay still serves normal traffic after the abuse.
+        body = protocol.encode_replica_summary(
+            protocol.ReplicaSummary((), "probe")
+        )
+        protocol.decode_replica_summary(
+            _post(server.url + "/replicate/summary", body)
+        )
     finally:
         server.stop()
 
