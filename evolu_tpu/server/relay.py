@@ -347,7 +347,7 @@ class ShardedRelayStore:
             s.close()
 
 
-def relay_stats_payload(store, replication=None) -> dict:
+def relay_stats_payload(store, replication=None, fleet=None) -> dict:
     """The GET /stats JSON: store-derived row counts per shard (shared
     truth in a MultiprocessRelay — every worker reads the same files)
     plus this process's request counters from the metrics registry
@@ -377,6 +377,8 @@ def relay_stats_payload(store, replication=None) -> dict:
     }
     if replication is not None:
         payload["replication"] = replication.stats_payload()
+    if fleet is not None:
+        payload["fleet"] = fleet.stats_payload()
     return payload
 
 
@@ -384,6 +386,7 @@ class _Handler(BaseHTTPRequestHandler):
     store: RelayStore  # injected by RelayServer
     scheduler = None  # SyncScheduler when continuous batching is on
     replication = None  # ReplicationManager when the relay has peers
+    fleet = None  # FleetManager when the relay is an owner-sharded fleet member
 
     def log_message(self, format: str, *args) -> None:
         # Target-gated like every other runtime signal (config.log):
@@ -423,6 +426,39 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _respond_retry_after(self, retry_after: float) -> None:
+        """503 + Retry-After: the ONE flow-control answer shape —
+        scheduler backpressure, a fleet owner mid-install, a forward
+        target briefly down. Clients back off and retry; never counted
+        in errors_total."""
+        from evolu_tpu.server.scheduler import format_retry_after
+
+        self.send_response(503)
+        self.send_header("Retry-After", format_retry_after(retry_after))
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def _serve_request(self, request: "protocol.SyncRequest") -> Optional[bytes]:
+        """Serve one LOCAL sync request through whichever path this
+        relay runs (scheduler vs per-request) — shared by the sync
+        POST handler and `/fleet/forward` (the recipes must never
+        drift). → response bytes, or None after having answered 503
+        backpressure itself."""
+        if self.scheduler is not None:
+            from evolu_tpu.server.scheduler import SchedulerQueueFull
+
+            try:
+                return self.scheduler.submit(request)
+            except SchedulerQueueFull as e:
+                # Backpressure is flow control, not a pipeline error
+                # (errors_total stays an error-rate): tell the client
+                # when to come back instead of letting handler threads
+                # pile up unboundedly.
+                metrics.inc("evolu_relay_backpressure_total")
+                self._respond_retry_after(e.retry_after)
+                return None
+        return serve_single_request(self.store, request)
+
     def do_GET(self) -> None:  # /ping (index.ts:250-252) + observability
         if self.path == "/ping":
             body = b"ok"
@@ -445,8 +481,54 @@ class _Handler(BaseHTTPRequestHandler):
                 # store.stats() runs SQL: a shard closing mid-scrape
                 # must surface as an HTTP 500, not a dropped connection.
                 body = json.dumps(
-                    relay_stats_payload(self.store, self.replication)
+                    relay_stats_payload(self.store, self.replication,
+                                        self.fleet)
                 ).encode("utf-8")
+            except Exception as e:  # noqa: BLE001
+                metrics.inc("evolu_relay_errors_total")
+                self.send_error(500, str(e))
+                return
+            self._respond(200, body, "application/json")
+        elif self.path == "/health":
+            # Readiness, not liveness (/ping is liveness): "serving"
+            # vs "bootstrap/install in progress" via the PR-5 install
+            # state machine's persisted phase marker (+ per-owner
+            # rebalance state when fleet-configured) — fleet failover
+            # probes and the bench must never route to a relay
+            # mid-install. 503 while installing so dumb HTTP checks
+            # (LB health probes) read it without parsing the body.
+            metrics.inc("evolu_relay_requests_total", endpoint="/health")
+            try:
+                if self.fleet is not None:
+                    serving, detail = self.fleet.health_payload()
+                else:
+                    from evolu_tpu.server.snapshot import install_phase
+
+                    phase = install_phase(self.store)
+                    serving = phase is None
+                    detail = {
+                        "status": "serving" if serving else "installing",
+                        "install_phase": phase,
+                    }
+                if self.scheduler is not None:
+                    # Saturation signal for operators / load-aware
+                    # probing — readiness itself stays install-driven
+                    # (a full queue answers 503 per request already).
+                    detail["queue_depth"] = self.scheduler.depth()
+            except Exception as e:  # noqa: BLE001 - probe gets a clean 500
+                metrics.inc("evolu_relay_errors_total")
+                self.send_error(500, str(e))
+                return
+            self._respond(200 if serving else 503,
+                          json.dumps(detail).encode("utf-8"),
+                          "application/json")
+        elif self.path == "/fleet":
+            if self.fleet is None:
+                self.send_error(404)
+                return
+            metrics.inc("evolu_relay_requests_total", endpoint="/fleet")
+            try:
+                body = json.dumps(self.fleet.stats_payload()).encode("utf-8")
             except Exception as e:  # noqa: BLE001
                 metrics.inc("evolu_relay_errors_total")
                 self.send_error(500, str(e))
@@ -467,6 +549,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._do_replicate()
             return
+        if self.path.startswith("/fleet/"):
+            self._do_fleet()
+            return
         t0 = time.perf_counter()
         # Count the request BEFORE any reject so errors_total can never
         # exceed requests_total (error-rate = errors/requests must stay
@@ -484,32 +569,17 @@ class _Handler(BaseHTTPRequestHandler):
                         buckets=metrics.SIZE_BUCKETS)
         try:
             request = protocol.decode_sync_request(body)
+            if self.fleet is not None:
+                if not self._route_fleet(request, body):
+                    return  # answered: 307/forwarded/503-not-ready
             shard = (
                 self.store.shard_index(request.user_id)
                 if hasattr(self.store, "shard_index") else 0
             )
             metrics.inc("evolu_relay_shard_requests_total", shard=str(shard))
-            if self.scheduler is not None:
-                from evolu_tpu.server.scheduler import (
-                    SchedulerQueueFull,
-                    format_retry_after,
-                )
-
-                try:
-                    out = self.scheduler.submit(request)
-                except SchedulerQueueFull as e:
-                    # Backpressure is flow control, not a pipeline
-                    # error (errors_total stays an error-rate): tell
-                    # the client when to come back instead of letting
-                    # handler threads pile up unboundedly.
-                    metrics.inc("evolu_relay_backpressure_total")
-                    self.send_response(503)
-                    self.send_header("Retry-After", format_retry_after(e.retry_after))
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-            else:
-                out = serve_single_request(self.store, request)
+            out = self._serve_request(request)
+            if out is None:
+                return  # 503 backpressure already answered
         except Exception as e:  # noqa: BLE001 - index.ts:231-233
             # The flight dump rides the exception (server-side only —
             # the wire response stays a bare 500, no event leakage).
@@ -579,6 +649,152 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(500, str(e))
             return
         self._respond(200, out, "application/octet-stream")
+
+    # -- fleet routing (server/fleet.py) --
+
+    def _route_fleet(self, request: "protocol.SyncRequest", body: bytes) -> bool:
+        """Owner-sharded placement check for one sync POST. True →
+        this relay is placed for the owner and ready: caller serves
+        locally. False → already answered: 307 + the authoritative
+        peer URL (redirect mode), the peer's proxied response (forward
+        mode), or 503 + Retry-After (owner mid-install / target
+        briefly unreachable — the client's backoff retries)."""
+        from evolu_tpu.server.fleet import FleetNotReady
+
+        try:
+            action, target = self.fleet.route(request.user_id)
+        except FleetNotReady as e:
+            self._respond_retry_after(e.retry_after)
+            return False
+        if action == "local":
+            return True
+        if action == "redirect":
+            metrics.inc("evolu_fleet_redirects_total")
+            self.send_response(307)
+            self.send_header("Location", target + "/")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return False
+        # forward: wrap the UNTOUCHED client body in the hop-guarded
+        # envelope and relay the peer's raw response back.
+        metrics.inc("evolu_fleet_forwards_total")
+        import urllib.error
+
+        from evolu_tpu.sync.client import _http_post
+
+        env = protocol.encode_fleet_forward(
+            protocol.FleetForward(body, self.fleet.self_url, 1)
+        )
+        try:
+            out = _http_post(target + "/fleet/forward", env, retries=1)
+        except urllib.error.HTTPError as e:
+            if e.code in (429, 503):
+                # The peer is shedding load: flow control, relayed.
+                metrics.inc("evolu_fleet_forward_failures_total")
+                self._respond_retry_after(0.25)
+                return False
+            # A DEFINITIVE answer (404 = peer not fleet-enabled, 400 =
+            # envelope rejected, 500 = peer pipeline failure) is not
+            # transient — masking it as 503 would make clients spin
+            # backoff forever while errors_total reads healthy. 502 it.
+            metrics.inc("evolu_relay_errors_total")
+            metrics.inc("evolu_fleet_forward_failures_total")
+            log("dev", "fleet forward rejected by peer", peer=target,
+                code=e.code)
+            self.send_error(502, f"fleet forward target answered {e.code}")
+            return False
+        except Exception as e:  # noqa: BLE001 - target down mid-window:
+            # flow control, not an error — the next route() re-probes
+            # and fails over.
+            metrics.inc("evolu_fleet_forward_failures_total")
+            log("dev", "fleet forward failed", peer=target, error=repr(e))
+            self._respond_retry_after(0.25)
+            return False
+        metrics.observe("evolu_relay_response_bytes", len(out),
+                        buckets=metrics.SIZE_BUCKETS)
+        self._respond(200, out, "application/octet-stream")
+        return False
+
+    def _do_fleet(self) -> None:
+        """POST /fleet/{forward,reload} — the fleet peer/operator
+        surface. `/fleet/forward` carries a hop-guarded peer envelope
+        (octet-stream, ValueError→400 like every wire decoder);
+        `/fleet/reload` is the static-config push (JSON body =
+        FleetConfig.to_json; a stale version answers 400)."""
+        if self.fleet is None or self.path not in ("/fleet/forward",
+                                                   "/fleet/reload"):
+            # 404 BEFORE any metric: the endpoint label must only ever
+            # take allowlisted values.
+            self.send_error(404)
+            return
+        metrics.inc("evolu_relay_requests_total", endpoint=self.path)
+        length = self._body_length()
+        if length is None:
+            return
+        if length > MAX_BODY_BYTES:
+            metrics.inc("evolu_relay_errors_total")
+            self.send_error(413)
+            return
+        body = self.rfile.read(length)
+        try:
+            if self.path == "/fleet/forward":
+                env = protocol.decode_fleet_forward(body)
+                if env.hops != 1:
+                    # The enforced hop guard: forwarders always send
+                    # hops=1 and this handler never forwards again, so
+                    # anything else is a malformed or replayed
+                    # envelope — reject before any side effect.
+                    raise ValueError(
+                        f"fleet forward from {env.origin!r} carries "
+                        f"hops={env.hops}; only single-hop envelopes "
+                        "are served"
+                    )
+                request = protocol.decode_sync_request(env.payload)
+                # NO route() here: a forwarded request is served where
+                # it lands, even if the rings disagree mid-reload
+                # (scoped gossip drains any stray owner).
+                metrics.inc("evolu_fleet_forwarded_served_total")
+                out = self._serve_request(request)
+                if out is None:
+                    return  # 503 backpressure already answered
+                if self.replication is not None and request.messages:
+                    self.replication.hint()
+                self._respond(200, out, "application/octet-stream")
+                return
+            # /fleet/reload is a control-plane MUTATION on the
+            # client-facing port: with EVOLU_FLEET_RELOAD_TOKEN set,
+            # demand the matching header (constant-time compare) —
+            # else anyone who can reach the sync port could hijack the
+            # ring with a high-version config. Unset = open, for
+            # trusted-network meshes like the /replicate/* surface
+            # (docs/FLEET.md).
+            token = os.environ.get("EVOLU_FLEET_RELOAD_TOKEN")
+            if token:
+                import hmac
+
+                got = self.headers.get("X-Evolu-Fleet-Token", "")
+                if not hmac.compare_digest(got, token):
+                    metrics.inc("evolu_relay_errors_total")
+                    self.send_error(403, "fleet reload token mismatch")
+                    return
+            cfg_json = json.loads(body.decode("utf-8"))
+            from evolu_tpu.utils.config import FleetConfig
+
+            cfg = FleetConfig.from_json(cfg_json)
+            rebalancing = self.fleet.apply_config(cfg)
+            out = json.dumps({
+                "ring_version": self.fleet.config.version,
+                "rebalancing": rebalancing,
+            }).encode("utf-8")
+            self._respond(200, out, "application/json")
+        except ValueError as e:
+            metrics.inc("evolu_relay_errors_total")
+            self.send_error(400, str(e))
+        except Exception as e:  # noqa: BLE001 - clean 500, like sync
+            flight.attach(e)
+            metrics.inc("evolu_relay_errors_total")
+            log("dev", "relay fleet request failed", error=repr(e))
+            self.send_error(500, str(e))
 
 
 class _RelayHTTPServer(ThreadingHTTPServer):
@@ -662,13 +878,37 @@ class RelayServer:
             self.checkpointer = CheckpointWriter(
                 self.store, checkpoint_path, checkpoint_interval_s
             )
-        handler = type(
+        self.fleet = None
+        self._handler_cls = type(
             "BoundHandler", (_Handler,),
             {"store": self.store, "scheduler": self.scheduler,
              "replication": self.replication},
         )
-        self._httpd = _RelayHTTPServer((host, port), handler)
+        self._httpd = _RelayHTTPServer((host, port), self._handler_cls)
         self._thread: Optional[threading.Thread] = None
+
+    def enable_fleet(self, config, self_url: Optional[str] = None):
+        """Join an owner-sharded fleet (server/fleet.py): install the
+        placement ring, start answering non-placed sync POSTs with
+        307/forward, scope this relay's replication gossip to
+        placement, and expose `/fleet/reload` + the fleet `/health`
+        detail. The server socket binds at CONSTRUCTION, so call this
+        between construction and `start()` when the relay has peers:
+        the replication loop's first gossip round fires immediately on
+        start and must already be placement-scoped (an unscoped first
+        round would pull owners this member is not placed for). The
+        FleetConfig must be the same object of truth on every member —
+        see utils/config.py."""
+        from evolu_tpu.server.fleet import FleetManager
+
+        self.fleet = FleetManager(
+            self.store, config, self_url or self.url,
+            replication=self.replication,
+        )
+        self._handler_cls.fleet = self.fleet
+        if self.replication is not None:
+            self.replication.fleet = self.fleet
+        return self.fleet
 
     @property
     def url(self) -> str:
@@ -688,6 +928,10 @@ class RelayServer:
         self._httpd.shutdown()
         if self._thread:
             self._thread.join()
+        if self.fleet is not None:
+            # Before replication/store teardown: a rebalance thread may
+            # still be ingesting through the store (stop joins it).
+            self.fleet.stop()
         if self.checkpointer is not None:
             # Before the store closes; a capture in flight finishes its
             # read transactions first (stop joins the loop thread).

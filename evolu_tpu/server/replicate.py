@@ -112,9 +112,22 @@ def serve_summary(store, body: bytes, manager: Optional["ReplicationManager"]) -
         # owner — an owner we lack entirely is divergence too.
         if any(by_owner.get(uid, "{}") != tree for uid, tree in incoming.trees):
             manager.hint()
+    fleet = getattr(manager, "fleet", None) if manager is not None else None
+    if fleet is not None and incoming.peer_url:
+        # Placement-scoped answer (server/fleet.py): the caller told
+        # us its URL — advertise only the owners placed on IT, so a
+        # converged fleet's summary traffic is O(R), not O(fleet).
+        # Owners WE store that belong to the caller are included even
+        # if we are not placed for them: that is exactly how a stray
+        # owner (written here mid-reload) drains to its placement.
+        # An empty peer_url (pre-fleet peers, the bench's oracle
+        # reads) still gets everything — interop unchanged.
+        mine = [(uid, t) for uid, t in mine
+                if fleet.placed_on(uid, incoming.peer_url)]
     return protocol.encode_replica_summary(
         protocol.ReplicaSummary(
-            tuple(mine), manager.replica_id if manager is not None else ""
+            tuple(mine), manager.replica_id if manager is not None else "",
+            fleet.self_url if fleet is not None else "",
         )
     )
 
@@ -252,6 +265,13 @@ class ReplicationManager:
         self._snapshot_cache_lock = threading.Lock()
         self._post = http_post or functools.partial(_http_post, retries=0)
         self._rng = rng or random.random
+        # Owner-sharded fleet membership (server/fleet.py), attached by
+        # RelayServer.enable_fleet: scopes summaries/pulls to placement
+        # (O(R) gossip) and hands the snapshot path to the fleet's
+        # owner-granular rebalance (the whole-store bootstrap trigger
+        # stays off — a partitioned relay must never install every
+        # owner of a donor).
+        self.fleet = None
         now = time.monotonic()
         self._peers = [_Peer(u, now) for u in peers]
         self._swap_checked = False
@@ -301,8 +321,13 @@ class ReplicationManager:
     def add_peer(self, url: str) -> None:
         """Register a peer after construction (mutual peering needs
         both relays' URLs, which only exist once both servers bind —
-        tests and dynamic topologies use this). Gossips immediately."""
+        tests, dynamic topologies, and fleet reloads use this).
+        Idempotent under its own lock: racing registrations (two
+        concurrent /fleet/reload pushes) must not gossip one peer
+        twice per round forever. Gossips immediately."""
         with self._cv:
+            if any(p.url == url.rstrip("/") for p in self._peers):
+                return
             p = _Peer(url, time.monotonic())
             self._peers.append(p)
             metrics.set_gauge(
@@ -488,7 +513,19 @@ class ReplicationManager:
         of course write more afterwards)."""
         labels = {"replica": self.replica_id, "peer": peer.url}
         local = dict(owner_tree_map(self.store))  # ONE bulk read
-        mine = protocol.ReplicaSummary(tuple(local.items()), self.replica_id)
+        send = local
+        if self.fleet is not None:
+            # Placement scope (server/fleet.py): advertise to this
+            # peer only the owners placed on IT — including strays we
+            # store but are not placed for (they drain to placement) —
+            # and carry our URL so the peer scopes its answer the same
+            # way. Gossip traffic drops from O(fleet) to O(R).
+            send = {uid: t for uid, t in local.items()
+                    if self.fleet.placed_on(uid, peer.url)}
+        mine = protocol.ReplicaSummary(
+            tuple(send.items()), self.replica_id,
+            self.fleet.self_url if self.fleet is not None else "",
+        )
         resp = protocol.decode_replica_summary(
             self._post_checked(peer.url + "/replicate/summary", protocol.encode_replica_summary(mine))
         )
@@ -503,6 +540,12 @@ class ReplicationManager:
             return False, installed
         diverged: List[Tuple[str, str]] = []  # (owner, since)
         for uid, peer_tree_s in resp.trees:
+            if self.fleet is not None and not self.fleet.placed_on(
+                    uid, self.fleet.self_url):
+                # Not ours to hold: never pull an owner we are not
+                # placed for (a scoping peer won't advertise one, but
+                # the wire is untrusted — enforce locally too).
+                continue
             # Compare and diff the SAME bulk snapshot — no per-owner
             # re-reads (N+1 on a converged mesh), and no chance of
             # diffing a different tree than the one compared. A local
@@ -567,7 +610,12 @@ class ReplicationManager:
         incremental path: one new owner appearing on a converged
         100-owner mesh is a ranged pull, never a full-store
         re-snapshot, whatever the threshold. None disables (PR-3
-        behavior)."""
+        behavior). A FLEET member never whole-store bootstraps: its
+        moves are owner-granular through the fleet rebalance
+        (server/fleet.py) — installing a donor's full snapshot would
+        un-partition the tier."""
+        if self.fleet is not None:
+            return False
         if self.bootstrap_lag_owners is None or not advertised:
             return False
         if not local:

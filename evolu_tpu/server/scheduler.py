@@ -153,6 +153,14 @@ class SyncScheduler:
 
     # -- admission (handler threads) --
 
+    def depth(self) -> int:
+        """Current admission-queue occupancy (0..max_queue) — the
+        load signal the fleet `/health` detail exposes so operators
+        (and future load-aware placement) can see saturation per
+        relay without scraping the full registry."""
+        with self._cv:
+            return len(self._queue)
+
     def submit(self, request: protocol.SyncRequest) -> bytes:
         """Serve one request: coalesced through the next engine pass,
         or as a singleton dispatch for shapes the engine can't batch —

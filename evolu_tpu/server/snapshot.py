@@ -261,32 +261,55 @@ def _shards_of(store) -> Sequence:
     return getattr(store, "shards", None) or [store]
 
 
+def _filter_stream(stream: bytes, owners) -> bytes:
+    """Keep only `owners`' records (host-side re-frame of the captured
+    stream — the fleet's O(moved-owners) transfer: capture cost stays
+    O(store), but nothing else is chunked, digested, or shipped)."""
+    wanted = set(owners)
+    out: List[bytes] = []
+    pos = 0
+    end = len(stream)
+    while pos < end:
+        rec, nxt = _next_record(stream, pos)
+        uid = rec[2] if rec[0] == "M" else rec[1]
+        if uid in wanted:
+            out.append(stream[pos:nxt])
+        pos = nxt
+    return b"".join(out)
+
+
 def capture_snapshot(
     store, chunk_bytes: int = SNAPSHOT_CHUNK_BYTES,
     snapshot_id: Optional[str] = None,
+    owners=None,
 ) -> Tuple[protocol.SnapshotManifest, List[bytes]]:
     """→ (manifest, chunks). Consistency is per shard (one read
     transaction each) — the store's own consistency unit: an owner
     lives wholly inside one shard, so every owner's rows and tree are
     mutually consistent, which is exactly what install verification
-    re-derives."""
+    re-derives. `owners` (an iterable) scopes the snapshot to those
+    owners only (fleet rebalance); None = the whole store."""
     parts: List[bytes] = []
     for shard in _shards_of(store):
         db = shard.db
         with _exclusive_txn(db):
             parts.append(capture_shard(db))
     stream = b"".join(parts)
+    if owners is not None:
+        stream = _filter_stream(stream, owners)
     chunks, message_count, tree_recs = _scan_stream(stream, chunk_bytes)
-    owners: List[Tuple[str, int, int]] = []
+    # NB `owner_digests`, not `owners` — that name is the scoping
+    # parameter above and must stay readable through the whole body.
+    owner_digests: List[Tuple[str, int, int]] = []
     for uid, tree in tree_recs:
         root = merkle_tree_from_string(tree).get("hash") or 0
-        owners.append((uid, int(root), zlib.crc32(tree.encode("utf-8"))))
-    owners.sort()
+        owner_digests.append((uid, int(root), zlib.crc32(tree.encode("utf-8"))))
+    owner_digests.sort()
     manifest = protocol.SnapshotManifest(
         snapshot_id or uuid.uuid4().hex,
         tuple(len(c) for c in chunks),
         tuple(zlib.crc32(c) for c in chunks),
-        tuple(owners),
+        tuple(owner_digests),
         message_count,
         len(stream),
     )
@@ -316,22 +339,28 @@ class SnapshotCache:
         self._max_entries = int(max_entries)
         self._clock = clock
         self._lock = threading.Lock()
-        # id -> (expires_at, chunk_bytes, manifest, chunks)
+        # id -> (expires_at, chunk_bytes, owners_key, manifest, chunks)
         self._entries: Dict[str, tuple] = {}
 
     def _clamp(self, requested: int) -> int:
         cb = requested or self.chunk_bytes
         return max(SNAPSHOT_MIN_CHUNK_BYTES, min(int(cb), SNAPSHOT_MAX_CHUNK_BYTES))
 
-    def manifest(self, requested_chunk_bytes: int = 0) -> protocol.SnapshotManifest:
+    def manifest(self, requested_chunk_bytes: int = 0,
+                 owners=None) -> protocol.SnapshotManifest:
+        """`owners` scopes the capture (fleet rebalance — the entry is
+        keyed by the owner set, so scoped and full snapshots never
+        serve each other's chunks)."""
         cb = self._clamp(requested_chunk_bytes)
+        owners_key = None if owners is None else frozenset(owners)
         with self._lock:
             now = self._clock()
             self._entries = {
                 k: v for k, v in self._entries.items() if v[0] > now
             }
-            for _sid, (_exp, entry_cb, manifest, _chunks) in self._entries.items():
-                if entry_cb == cb:
+            for _sid, (_exp, entry_cb, entry_ok, manifest,
+                       _chunks) in self._entries.items():
+                if entry_cb == cb and entry_ok == owners_key:
                     return manifest
         # Capture OUTSIDE the cache lock: chunk() must stay servable
         # while a full-store capture runs, or one peer's manifest miss
@@ -339,13 +368,13 @@ class SnapshotCache:
         # whole capture (long enough at scale to trip their snapshot
         # TTLs). Two racing first-misses may both capture — rare and
         # merely wasteful; both snapshots get registered and served.
-        manifest, chunks = capture_snapshot(self._store, cb)
+        manifest, chunks = capture_snapshot(self._store, cb, owners=owners)
         with self._lock:
             while len(self._entries) >= self._max_entries:
                 oldest = min(self._entries, key=lambda k: self._entries[k][0])
                 del self._entries[oldest]
             self._entries[manifest.snapshot_id] = (
-                self._clock() + self._ttl_s, cb, manifest, chunks,
+                self._clock() + self._ttl_s, cb, owners_key, manifest, chunks,
             )
         return manifest
 
@@ -360,7 +389,7 @@ class SnapshotCache:
                 # a 400 on the chunk leg as "snapshot gone", drops its
                 # stale install state and restarts fresh.
                 raise ValueError(f"unknown or expired snapshot {snapshot_id!r}")
-            _exp, _cb, manifest, chunks = entry
+            _exp, _cb, _ok, manifest, chunks = entry
         if not 0 <= index < len(chunks):
             raise ValueError(
                 f"snapshot chunk index {index} out of range 0..{len(chunks) - 1}"
@@ -376,7 +405,9 @@ def serve_snapshot(store, body: bytes, manager) -> bytes:
     fresh cached capture) and answer the manifest. ValueError only on
     malformed input (wire-decoder contract → 400)."""
     req = protocol.decode_snapshot_request(body)
-    manifest = manager.snapshot_cache.manifest(req.chunk_bytes)
+    manifest = manager.snapshot_cache.manifest(
+        req.chunk_bytes, owners=req.owners or None
+    )
     metrics.inc("evolu_snap_manifests_served_total")
     return protocol.encode_snapshot_manifest(manifest)
 
@@ -393,6 +424,26 @@ def serve_snapshot_chunk(store, body: bytes, manager) -> bytes:
 
 
 # --- crash-consistent install ---
+
+
+def install_phase(store) -> Optional[str]:
+    """The persisted install state machine's phase marker ("fetch" |
+    "swap"), or None when no install is in progress. Probes via
+    sqlite_master WITHOUT constructing a SnapshotInstaller — a store
+    that never bootstrapped must not grow a state table just from
+    being health-checked (`GET /health`, server/fleet.py readiness)."""
+    shard0 = _shards_of(store)[0]
+    have = shard0.db.exec_sql_query(
+        "SELECT name FROM sqlite_master WHERE type='table' "
+        "AND name='snapshotBootstrapState'"
+    )
+    if not have:
+        return None
+    rows = shard0.db.exec_sql_query(
+        'SELECT "value" FROM "snapshotBootstrapState" WHERE "key" = ?',
+        ("phase",),
+    )
+    return rows[0]["value"] if rows else None
 
 
 class SnapshotInstaller:

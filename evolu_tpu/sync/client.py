@@ -17,6 +17,7 @@ from __future__ import annotations
 import queue
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from typing import Callable, Optional
 
@@ -96,6 +97,13 @@ class SyncTransport:
         self.on_reconnect = on_reconnect or (lambda: None)
         self._queue: "queue.Queue[object]" = queue.Queue()
         self._stop = object()
+        # Learned owner→relay routes (fleet 307 redirects,
+        # server/fleet.py). Touched only on the transport thread.
+        # Invalidated by the next 307 (re-learn), a 404 (stale route —
+        # the owner moved or the relay left the fleet), or a
+        # connection failure on the learned URL (fail back to the
+        # configured relay before declaring offline).
+        self._routes: dict = {}
         # Reconnect probing state (db.ts:390-412 analog): offline is
         # entered by a swallowed fetch error, left by the first probe
         # success or successful round — either fires on_reconnect.
@@ -268,24 +276,62 @@ class SyncTransport:
         metrics.inc("evolu_sync_request_messages_total", len(request.messages))
         metrics.observe("evolu_sync_request_bytes", len(body),
                         buckets=metrics.SIZE_BUCKETS)
-        log("sync:request", url=self.config.sync_url,
+        owner_id = request.owner.id
+        base = self.config.sync_url
+        url = self._routes.get(owner_id, base)
+        log("sync:request", url=url,
             messages=len(request.messages), bytes=len(body))
-        try:
-            response_bytes = self._http_post(self.config.sync_url, body)
-        except urllib.error.HTTPError as e:
-            # The server answered: that's a real error (4xx/5xx), not
-            # offline — surface it so divergence isn't silent. The
-            # transport is demonstrably UP, so clear any offline state.
-            metrics.inc("evolu_sync_http_errors_total")
-            self._note_online()
-            self.on_error(UnknownError(e))
-            return None
-        except (urllib.error.URLError, OSError):
-            # Offline is not an error (sync.worker.ts:217-227) — but it
-            # arms the reconnect probe.
-            metrics.inc("evolu_sync_offline_rounds_total")
-            self._note_offline()
-            return None
+        followed = False
+        while True:
+            try:
+                response_bytes = self._http_post(url, body)
+                break
+            except urllib.error.HTTPError as e:
+                # A fleet relay answers a non-placed sync POST with
+                # 307 + the authoritative peer URL (server/fleet.py).
+                # Follow AT MOST ONE redirect per request and cache
+                # the learned owner→relay route; each hop's POST keeps
+                # its own full 429/503/connection backoff schedule
+                # inside _http_post, so backpressure at the redirected
+                # relay still backs off normally.
+                location = e.headers.get("Location") if e.headers else None
+                if e.code == 307 and location and not followed:
+                    followed = True
+                    url = urllib.parse.urljoin(url, location)
+                    self._routes[owner_id] = url
+                    metrics.inc("evolu_sync_redirects_total")
+                    log("sync:request", "fleet redirect", url=url)
+                    continue
+                if e.code in (307, 404) and self._routes.pop(owner_id, None):
+                    # A second 307 (ring churn) or a 404 (the learned
+                    # relay no longer serves this owner): the cached
+                    # route is stale. For the 404, retry ONCE at the
+                    # configured relay in this same round.
+                    metrics.inc("evolu_sync_route_invalidations_total")
+                    if e.code == 404 and url != base:
+                        url = base
+                        continue
+                # The server answered: that's a real error (4xx/5xx),
+                # not offline — surface it so divergence isn't silent.
+                # The transport is demonstrably UP, so clear any
+                # offline state.
+                metrics.inc("evolu_sync_http_errors_total")
+                self._note_online()
+                self.on_error(UnknownError(e))
+                return None
+            except (urllib.error.URLError, OSError):
+                if url != base and self._routes.pop(owner_id, None):
+                    # The LEARNED relay is unreachable — that says
+                    # nothing about the configured one: drop the route
+                    # and fail over to it before declaring offline.
+                    metrics.inc("evolu_sync_route_invalidations_total")
+                    url = base
+                    continue
+                # Offline is not an error (sync.worker.ts:217-227) —
+                # but it arms the reconnect probe.
+                metrics.inc("evolu_sync_offline_rounds_total")
+                self._note_offline()
+                return None
         self._note_online()
         try:
             from evolu_tpu.sync import native_crypto

@@ -314,10 +314,19 @@ class ReplicaSummary:
     """One side of a gossip exchange: every owner this relay stores,
     with its serialized Merkle tree. Sent as the `/replicate/summary`
     request body (the caller's summary) AND returned as its response
-    (the callee's) — divergence is computable from either side."""
+    (the callee's) — divergence is computable from either side.
+
+    `peer_url` (field 3, fleet extension): the CALLER's advertised base
+    URL. A fleet relay (server/fleet.py) scopes its response to owners
+    placed on that URL, dropping gossip traffic from O(fleet) to O(R).
+    Empty (the pre-fleet wire and non-fleet relays) means "answer
+    everything" — old and new peers interoperate unchanged. Like
+    `replica_id` it is untrusted input: it selects a SUBSET of the
+    response and is never minted into metric labels."""
 
     trees: Tuple[Tuple[str, str], ...]  # (owner id, merkle tree string)
     replica_id: str
+    peer_url: str = ""
 
 
 @dataclass(frozen=True)
@@ -346,7 +355,10 @@ def encode_replica_summary(s: ReplicaSummary) -> bytes:
     out = b"".join(
         _len_delimited(1, _string(1, uid) + _string(2, tree)) for uid, tree in s.trees
     )
-    return out + _string(2, s.replica_id)
+    out += _string(2, s.replica_id)
+    if s.peer_url:
+        out += _string(3, s.peer_url)
+    return out
 
 
 @_wire_decoder
@@ -365,7 +377,7 @@ def _decode_owner_tree(data: bytes) -> Tuple[str, str]:
 @_wire_decoder
 def decode_replica_summary(data: bytes) -> ReplicaSummary:
     trees: List[Tuple[str, str]] = []
-    replica_id = ""
+    replica_id = peer_url = ""
     pos = 0
     while pos < len(data):
         num, wt, v, pos = _read_field(data, pos)
@@ -375,7 +387,9 @@ def decode_replica_summary(data: bytes) -> ReplicaSummary:
             trees.append(_decode_owner_tree(v))
         elif num == 2:
             replica_id = v.decode("utf-8")
-    return ReplicaSummary(tuple(trees), replica_id)
+        elif num == 3:
+            peer_url = v.decode("utf-8")
+    return ReplicaSummary(tuple(trees), replica_id, peer_url)
 
 
 def encode_replica_pull(p: ReplicaPull) -> bytes:
@@ -448,7 +462,7 @@ def decode_replica_pull_response(data: bytes) -> ReplicaPullResponse:
 # same E2EE-blindness (the framed row stream carries exactly what the
 # relay already stores: plaintext timestamps + ciphertext blobs). ---
 #
-#     SnapshotRequest      { replicaId=1 chunkBytes=2 }
+#     SnapshotRequest      { replicaId=1 chunkBytes=2 owners=3 (repeated) }
 #     SnapshotOwner        { userId=1 rootHash=2 treeCrc=3 }
 #     SnapshotManifest     { snapshotId=1 chunkSizes=2 (repeated)
 #                            chunkCrcs=3 (repeated)
@@ -462,10 +476,17 @@ def decode_replica_pull_response(data: bytes) -> ReplicaPullResponse:
 class SnapshotRequest:
     """Asks a donor relay for a consistent snapshot manifest.
     `chunk_bytes` is the puller's preferred chunk size (0 = donor
-    default; the donor clamps it under its body cap either way)."""
+    default; the donor clamps it under its body cap either way).
+    `owners` (field 3, fleet extension): non-empty scopes the capture
+    to exactly those owners — the O(moved-owners) transfer the fleet
+    rebalance needs instead of a full-store ship. Empty = everything
+    (the whole-store bootstrap, and what pre-fleet donors — whose
+    decoders skip the unknown field — always serve; pullers keep a
+    client-side record filter for exactly that downgrade)."""
 
     replica_id: str
     chunk_bytes: int = 0
+    owners: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -506,12 +527,15 @@ def encode_snapshot_request(r: SnapshotRequest) -> bytes:
     out = _string(1, r.replica_id)
     if r.chunk_bytes:
         out += _tag(2, 0) + _varint(r.chunk_bytes)
+    for uid in r.owners:
+        out += _string(3, uid)
     return out
 
 
 @_wire_decoder
 def decode_snapshot_request(data: bytes) -> SnapshotRequest:
     replica_id, chunk_bytes = "", 0
+    owners: List[str] = []
     pos = 0
     while pos < len(data):
         num, wt, v, pos = _read_field(data, pos)
@@ -519,7 +543,11 @@ def decode_snapshot_request(data: bytes) -> SnapshotRequest:
             replica_id = v.decode("utf-8")
         elif num == 2:
             chunk_bytes = int(v)
-    return SnapshotRequest(replica_id, chunk_bytes)
+        elif num == 3:
+            if wt != 2:
+                raise ValueError(f"owners field has wire type {wt}")
+            owners.append(v.decode("utf-8"))
+    return SnapshotRequest(replica_id, chunk_bytes, tuple(owners))
 
 
 def encode_snapshot_manifest(m: SnapshotManifest) -> bytes:
@@ -643,6 +671,58 @@ def decode_snapshot_chunk(data: bytes) -> SnapshotChunk:
                 raise ValueError(f"payload field has wire type {wt}")
             payload = bytes(v)
     return SnapshotChunk(snapshot_id, index, crc, payload)
+
+
+# --- fleet routing envelope (extension — no reference equivalent; see
+# evolu_tpu/server/fleet.py). A relay in forward mode wraps a sync POST
+# body it is not placed for and relays it to the authoritative peer's
+# `POST /fleet/forward`; the response is the raw sync response bytes,
+# relayed back verbatim. `hops` is the loop guard, enforced at both
+# ends: forwarders send hops=1, the serving handler 400-rejects any
+# other value AND never forwards again (ring disagreement during a
+# config reload must degrade to local service + gossip heal, not a
+# forward cycle).
+# Same ValueError-only decoder contract; the payload stays E2EE-blind
+# (it IS the client's encrypted SyncRequest, untouched). ---
+#
+#     FleetForward { payload=1 origin=2 hops=3 }
+
+
+@dataclass(frozen=True)
+class FleetForward:
+    payload: bytes  # the original encoded SyncRequest body, verbatim
+    origin: str  # forwarding relay's base URL (observability only)
+    hops: int = 1
+
+
+def encode_fleet_forward(f: FleetForward) -> bytes:
+    return (
+        _len_delimited(1, f.payload)
+        + _string(2, f.origin)
+        + _tag(3, 0) + _varint(f.hops)
+    )
+
+
+@_wire_decoder
+def decode_fleet_forward(data: bytes) -> FleetForward:
+    payload = b""
+    origin = ""
+    hops = 0
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            if wt != 2:
+                # A varint here would make bytes(v) ALLOCATE v zero
+                # bytes — same remote memory-DoS shape as the content
+                # field of EncryptedCrdtMessage.
+                raise ValueError(f"payload field has wire type {wt}")
+            payload = bytes(v)
+        elif num == 2:
+            origin = v.decode("utf-8")
+        elif num == 3:
+            hops = int(v)
+    return FleetForward(payload, origin, hops)
 
 
 @_wire_decoder

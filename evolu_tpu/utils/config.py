@@ -8,7 +8,7 @@ a module-level default exists for parity with `setConfig`.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Union
+from typing import List, Tuple, Union
 
 
 @dataclass
@@ -92,3 +92,82 @@ default_config = Config()
 def set_config(c: Config) -> None:
     global default_config
     default_config = c
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Shared fleet placement configuration (server/fleet.py — no
+    reference equivalent; the reference relay is a single node).
+
+    Every relay in a fleet must hold the SAME FleetConfig: the
+    owner→relay placement ring is a pure function of (relays,
+    virtual_nodes, replication_factor, seed), so agreement on this
+    object IS agreement on who serves whom. Distribution is static
+    config (constructor arg or `POST /fleet/reload`), deliberately not
+    a consensus protocol: a fleet is operated, membership changes are
+    deploys. `version` is a monotonic operator counter so a relay can
+    refuse a stale reload racing a newer one."""
+
+    relays: Tuple[str, ...]  # member base URLs (the ring membership)
+    replication_factor: int = 2  # R: replicas (incl. primary) per owner
+    virtual_nodes: int = 64  # ring points per relay (placement smoothness)
+    seed: int = 0  # shared hash seed — all members must agree
+    version: int = 0  # monotonic config generation (reload ordering)
+    # Routing mode for a request landing on a non-placed relay:
+    # False = 307 redirect carrying the authoritative peer URL (the
+    # client follows and caches the route — sync/client.py); True =
+    # proxy-forward through the relay (one extra hop, but works for
+    # clients that cannot follow redirects).
+    forward: bool = False
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "relays", tuple(u.rstrip("/") for u in self.relays)
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "relays": list(self.relays),
+            "replication_factor": self.replication_factor,
+            "virtual_nodes": self.virtual_nodes,
+            "seed": self.seed,
+            "version": self.version,
+            "forward": self.forward,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FleetConfig":
+        """Decode a `/fleet/reload` body. Raises ValueError on any
+        malformed shape (the relay maps it to HTTP 400, matching the
+        wire-decoder contract)."""
+        try:
+            raw = d["relays"]
+            # A bare string iterates character-by-character into a ring
+            # of one-character "URLs" — an easy templating mistake that
+            # would 200 and then 307 every request to nonsense. Demand
+            # a real list.
+            if isinstance(raw, (str, bytes)) or not isinstance(raw, (list, tuple)):
+                raise ValueError('fleet config "relays" must be a list of URLs')
+            relays = tuple(str(u) for u in raw)
+            if not relays:
+                raise ValueError("fleet config needs at least one relay")
+            if len(relays) > 1024:
+                raise ValueError(f"fleet config lists {len(relays)} relays "
+                                 "(max 1024)")
+            vnodes = int(d.get("virtual_nodes", 64))
+            if not 1 <= vnodes <= 4096:
+                # The ring builds relays × vnodes hash points; an
+                # absurd value from a reload body is a CPU/memory DoS,
+                # not a tuning choice.
+                raise ValueError(
+                    f"virtual_nodes={vnodes} outside 1..4096")
+            return cls(
+                relays=relays,
+                replication_factor=int(d.get("replication_factor", 2)),
+                virtual_nodes=vnodes,
+                seed=int(d.get("seed", 0)),
+                version=int(d.get("version", 0)),
+                forward=bool(d.get("forward", False)),
+            )
+        except (KeyError, TypeError) as e:
+            raise ValueError(f"malformed fleet config: {e!r}") from e

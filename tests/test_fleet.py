@@ -1,0 +1,754 @@
+"""Owner-sharded relay fleet (server/fleet.py): placement ring
+determinism/balance/stability, request routing (307 redirect, proxy
+forward with the hop guard, not-ready 503), client route learning and
+invalidation, placement-scoped gossip, snapshot-driven rebalancing
+with watermark cutover, readiness-probed failover, and the
+FleetForward / ReplicaSummary.peer_url wire codec."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.server.fleet import FleetManager, FleetNotReady, HashRing
+from evolu_tpu.server.relay import RelayServer, RelayStore
+from evolu_tpu.sync import protocol
+from evolu_tpu.sync.client import _http_post
+from evolu_tpu.utils.config import FleetConfig
+
+BASE = 1_700_000_000_000
+
+
+def _msgs(k, n, t0=0):
+    node = f"{k + 1:016x}"
+    return tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(Timestamp(BASE + (t0 + j) * 1000, 0, node)),
+            b"ct-%d-%d" % (k, t0 + j),
+        )
+        for j in range(n)
+    )
+
+
+def _sync_body(owner, messages=(), tree="{}"):
+    return protocol.encode_sync_request(
+        protocol.SyncRequest(messages, owner, "00000000000000bb", tree)
+    )
+
+
+def _owner_for(ring, url, prefix="o", avoid=()):
+    """A deterministic owner id whose primary under `ring` is `url`."""
+    i = 0
+    while True:
+        uid = f"{prefix}{i:04d}"
+        if uid not in avoid and ring.primary(uid) == url.rstrip("/"):
+            return uid
+        i += 1
+
+
+# --- placement ring ---
+
+
+def test_ring_deterministic_r_distinct_and_clamped():
+    cfg = FleetConfig(relays=("http://a:1", "http://b:2", "http://c:3"),
+                      replication_factor=2, seed=7)
+    r1, r2 = HashRing(cfg), HashRing(cfg)
+    for i in range(200):
+        p = r1.placement(f"owner{i}")
+        assert p == r2.placement(f"owner{i}")  # pure function of config
+        assert len(p) == 2 and len(set(p)) == 2
+        assert all(u in cfg.relays for u in p)
+    # R larger than the fleet clamps to the member count.
+    big = HashRing(FleetConfig(relays=("http://a:1",), replication_factor=3))
+    assert big.placement("x") == ("http://a:1",)
+
+
+def test_ring_balance_and_seed_sensitivity():
+    urls = tuple(f"http://relay{i}:400{i}" for i in range(3))
+    ring = HashRing(FleetConfig(relays=urls, replication_factor=1))
+    counts = {u: 0 for u in urls}
+    owners = [f"owner{i:05d}" for i in range(3000)]
+    for uid in owners:
+        counts[ring.primary(uid)] += 1
+    # 64 vnodes each: no relay should hold less than half its fair
+    # share or more than double (loose — this is smoothness, not
+    # perfection).
+    for u, n in counts.items():
+        assert 1000 / 2 <= n <= 1000 * 2, counts
+    other = HashRing(FleetConfig(relays=urls, replication_factor=1, seed=1))
+    moved = sum(1 for uid in owners if other.primary(uid) != ring.primary(uid))
+    assert moved > len(owners) / 3  # a different seed is a different ring
+
+
+def test_ring_join_moves_only_the_new_arc():
+    urls = tuple(f"http://relay{i}:400{i}" for i in range(3))
+    before = HashRing(FleetConfig(relays=urls, replication_factor=1))
+    after = HashRing(FleetConfig(relays=urls + ("http://relay3:4003",),
+                                 replication_factor=1))
+    owners = [f"owner{i:05d}" for i in range(3000)]
+    moved = [uid for uid in owners
+             if after.primary(uid) != before.primary(uid)]
+    # Consistent hashing: a 3→4 join should move ~1/4 of owners, and
+    # every move should land ON the joiner (nothing shuffles between
+    # surviving members).
+    assert len(moved) / len(owners) < 0.45
+    assert all(after.primary(uid) == "http://relay3:4003" for uid in moved)
+
+
+# --- wire codec ---
+
+
+def test_fleet_forward_codec_roundtrip():
+    env = protocol.FleetForward(b"\x00payload\xffbytes", "http://a:1", 1)
+    out = protocol.decode_fleet_forward(protocol.encode_fleet_forward(env))
+    assert out == env
+
+
+def test_replica_summary_peer_url_roundtrip_and_compat():
+    s = protocol.ReplicaSummary((("o1", "{}"),), "r1", "http://me:4000")
+    assert protocol.decode_replica_summary(
+        protocol.encode_replica_summary(s)) == s
+    # The pre-fleet wire (no field 3) decodes with peer_url == "".
+    old = protocol.encode_replica_summary(
+        protocol.ReplicaSummary((("o1", "{}"),), "r1"))
+    got = protocol.decode_replica_summary(old)
+    assert got.peer_url == "" and got.trees == (("o1", "{}"),)
+
+
+def test_fleet_decoders_raise_value_error_only():
+    import random
+
+    rng = random.Random(1234)
+    for fn in (protocol.decode_fleet_forward, protocol.decode_replica_summary):
+        for _ in range(300):
+            blob = bytes(rng.randrange(256) for _ in range(rng.randrange(40)))
+            try:
+                fn(blob)
+            except ValueError:
+                pass  # the only allowed error type
+    # The memory-DoS shape: a varint payload field must not allocate.
+    bad = protocol._tag(1, 0) + protocol._varint(1 << 40)
+    with pytest.raises(ValueError):
+        protocol.decode_fleet_forward(bad)
+
+
+def test_snapshot_request_owners_roundtrip_and_compat():
+    r = protocol.SnapshotRequest("rid", 1024, ("o1", "o2"))
+    assert protocol.decode_snapshot_request(
+        protocol.encode_snapshot_request(r)) == r
+    # Pre-fleet wire (no field 3) decodes with owners == ().
+    old = protocol.encode_snapshot_request(protocol.SnapshotRequest("rid"))
+    assert protocol.decode_snapshot_request(old).owners == ()
+
+
+def test_owner_scoped_snapshot_serves_only_wanted_owners():
+    """The fleet rebalance's O(moved-owners) transfer: a SnapshotRequest
+    naming owners gets a manifest/chunks covering exactly those, and
+    the scoped capture never aliases the full-store cache entry."""
+    from evolu_tpu.server import snapshot as snap
+
+    donor = RelayServer(RelayStore(), peers=[],
+                        replication_interval_s=30).start()
+    try:
+        owners = [f"z{i:04d}" for i in range(6)]
+        for k, uid in enumerate(owners):
+            donor.store.add_messages(uid, _msgs(k, 4))
+        wanted = tuple(owners[:2])
+        body = protocol.encode_snapshot_request(
+            protocol.SnapshotRequest("probe", 0, wanted))
+        manifest = protocol.decode_snapshot_manifest(
+            _http_post(donor.url + "/replicate/snapshot", body))
+        assert tuple(uid for uid, _r, _c in manifest.owners) == wanted
+        assert manifest.message_count == 8
+        seen = set()
+        for i in range(len(manifest.chunk_sizes)):
+            chunk = protocol.decode_snapshot_chunk(_http_post(
+                donor.url + "/replicate/snapshot/chunk",
+                protocol.encode_snapshot_chunk_request(
+                    protocol.SnapshotChunkRequest(manifest.snapshot_id, i)),
+            ))
+            for rec in snap.iter_records(chunk.payload):
+                seen.add(rec[2] if rec[0] == "M" else rec[1])
+        assert seen == set(wanted)
+        # A FULL request afterwards is a DIFFERENT snapshot covering
+        # everything (cache keyed by owner set, not just chunk size).
+        full = protocol.decode_snapshot_manifest(_http_post(
+            donor.url + "/replicate/snapshot",
+            protocol.encode_snapshot_request(
+                protocol.SnapshotRequest("probe"))))
+        assert full.snapshot_id != manifest.snapshot_id
+        assert len(full.owners) == 6
+    finally:
+        donor.stop()
+
+
+# --- routing through real relays ---
+
+
+@pytest.fixture()
+def two_relay_fleet():
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    b = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    cfg = FleetConfig(relays=(a.url, b.url), replication_factor=1, version=1)
+    a.enable_fleet(cfg)
+    b.enable_fleet(cfg)
+    try:
+        yield a, b, cfg
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_redirect_for_non_placed_owner(two_relay_fleet):
+    a, b, _cfg = two_relay_fleet
+    owner_b = _owner_for(a.fleet.ring, b.url)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _http_post(a.url + "/", _sync_body(owner_b, _msgs(0, 2)))
+    assert e.value.code == 307
+    assert e.value.headers.get("Location") == b.url + "/"
+    # The redirect carried no side effect: nothing landed on A.
+    assert a.store.user_ids() == []
+    # Served at the authoritative relay, response is the normal wire.
+    out = _http_post(b.url + "/", _sync_body(owner_b, _msgs(0, 2)))
+    assert protocol.decode_sync_response(out).merkle_tree != "{}"
+
+
+def test_forward_mode_proxies_and_matches_direct_serve(two_relay_fleet):
+    a, b, cfg = two_relay_fleet
+    fwd = FleetConfig(relays=cfg.relays, replication_factor=1, version=2,
+                      forward=True)
+    for s in (a, b):
+        body = json.dumps(fwd.to_json()).encode()
+        req = urllib.request.Request(s.url + "/fleet/reload", data=body,
+                                     method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["ring_version"] == 2
+    owner_b = _owner_for(a.fleet.ring, b.url)
+    out = _http_post(a.url + "/", _sync_body(owner_b, _msgs(0, 3)))
+    # The forwarded response is byte-identical to asking B directly
+    # with the same (now converged) tree — rows landed on B only.
+    assert b.store.user_ids() == [owner_b]
+    assert a.store.user_ids() == []
+    direct = _http_post(b.url + "/", _sync_body(owner_b, _msgs(0, 3)))
+    assert protocol.decode_sync_response(out).merkle_tree == \
+        protocol.decode_sync_response(direct).merkle_tree
+
+
+def test_not_ready_owner_answers_503_retry_after(two_relay_fleet):
+    a, _b, _cfg = two_relay_fleet
+    owner_a = _owner_for(a.fleet.ring, a.url)
+    with a.fleet._lock:
+        a.fleet._installing.add(owner_a)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http_post(a.url + "/", _sync_body(owner_a), retries=0)
+        assert e.value.code == 503
+        assert float(e.value.headers.get("Retry-After")) > 0
+    finally:
+        with a.fleet._lock:
+            a.fleet._installing.discard(owner_a)
+    # Ready again: serves.
+    _http_post(a.url + "/", _sync_body(owner_a, _msgs(1, 1)))
+    assert a.store.user_ids() == [owner_a]
+
+
+def test_stale_reload_rejected_with_400(two_relay_fleet):
+    a, _b, cfg = two_relay_fleet
+    stale = FleetConfig(relays=cfg.relays, replication_factor=1, version=0)
+    body = json.dumps(stale.to_json()).encode()
+    req = urllib.request.Request(a.url + "/fleet/reload", data=body,
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 400
+    assert a.fleet.config.version == 1  # untouched
+
+
+def test_reload_rejects_malformed_and_dos_configs(two_relay_fleet):
+    a, _b, cfg = two_relay_fleet
+    for bad in (
+        {"relays": "http://a:4000", "version": 5},  # bare string
+        {"relays": list(cfg.relays), "version": 5, "virtual_nodes": 10**8},
+        {"relays": [f"http://r{i}:1" for i in range(2000)], "version": 5},
+        {"version": 5},  # no relays at all
+    ):
+        req = urllib.request.Request(
+            a.url + "/fleet/reload", data=json.dumps(bad).encode(),
+            method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400, bad
+    assert a.fleet.config.version == 1
+
+
+def test_reload_token_gate(two_relay_fleet, monkeypatch):
+    """With EVOLU_FLEET_RELOAD_TOKEN set, the control-plane mutation
+    demands the matching header — a client-reachable sync port must
+    not accept ring hijacks."""
+    import os as _os
+
+    a, _b, cfg = two_relay_fleet
+    monkeypatch.setitem(_os.environ, "EVOLU_FLEET_RELOAD_TOKEN", "s3cret")
+    new = FleetConfig(relays=cfg.relays, replication_factor=1, version=3)
+    body = json.dumps(new.to_json()).encode()
+    req = urllib.request.Request(a.url + "/fleet/reload", data=body,
+                                 method="POST")
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req)
+    assert e.value.code == 403
+    assert a.fleet.config.version == 1
+    req = urllib.request.Request(
+        a.url + "/fleet/reload", data=body, method="POST",
+        headers={"X-Evolu-Fleet-Token": "s3cret"})
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read())["ring_version"] == 3
+
+
+def test_health_reports_install_in_progress():
+    server = RelayServer(RelayStore()).start()
+    try:
+        with urllib.request.urlopen(server.url + "/health") as r:
+            assert r.status == 200
+            assert json.loads(r.read())["status"] == "serving"
+        from evolu_tpu.server.snapshot import SnapshotInstaller
+
+        inst = SnapshotInstaller(server.store)
+        manifest = protocol.SnapshotManifest("snap1", (), (), (), 0, 0)
+        inst.begin(manifest, "peer")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(server.url + "/health")
+        assert e.value.code == 503
+        detail = json.loads(e.value.read())
+        assert detail == {"status": "installing", "install_phase": "fetch"}
+        inst.abort()
+        with urllib.request.urlopen(server.url + "/health") as r:
+            assert r.status == 200
+    finally:
+        server.stop()
+    # A batching relay also reports its admission-queue depth — the
+    # saturation signal for operators / load-aware probing.
+    server = RelayServer(RelayStore(), batching=True).start()
+    try:
+        with urllib.request.urlopen(server.url + "/health") as r:
+            assert json.loads(r.read())["queue_depth"] == 0
+    finally:
+        server.stop()
+
+
+# --- client transport: follow-one-307 + route cache ---
+
+
+SCHEMA = {"todo": ("title", "isCompleted")}
+
+
+class _Status404(BaseHTTPRequestHandler):
+    def do_POST(self):  # a reused port / path-prefixed deploy: 404s
+        self.rfile.read(int(self.headers.get("Content-Length", "0")))
+        self.send_error(404)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_client_follows_one_redirect_caches_and_invalidates():
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.runtime.client import create_evolu
+    from evolu_tpu.sync.client import connect
+    from evolu_tpu.utils.config import Config
+
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    b = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    cfg = FleetConfig(relays=(a.url, b.url), replication_factor=1, version=1)
+    a.enable_fleet(cfg)
+    b.enable_fleet(cfg)
+    stub = HTTPServer(("127.0.0.1", 0), _Status404)
+    stub_thread = threading.Thread(target=stub.serve_forever, daemon=True)
+    stub_thread.start()
+    evolu = None
+    try:
+        evolu = create_evolu(SCHEMA, config=Config(sync_url=a.url))
+        connect(evolu)
+        owner = evolu.owner.id
+        primary = evolu._transport  # noqa: F841 - keep a handle
+        home = a if a.fleet.ring.primary(owner) == a.url else b
+        away = b if home is a else a
+        # Point the client at the NON-primary relay: the first round
+        # must 307-redirect exactly once and land on the primary.
+        evolu.config.sync_url = away.url
+        evolu._transport.config.sync_url = away.url
+        before = metrics.get_counter("evolu_sync_redirects_total")
+        evolu.create("todo", {"title": "t1", "isCompleted": False})
+        evolu.worker.flush()
+        evolu.sync()
+        evolu.worker.flush()
+        evolu._transport.flush()
+        assert metrics.get_counter("evolu_sync_redirects_total") == before + 1
+        assert evolu._transport._routes.get(owner) == home.url + "/"
+        assert home.store.user_ids() == [owner]
+        # Second round rides the cached route: no new redirect.
+        evolu.create("todo", {"title": "t2", "isCompleted": False})
+        evolu.worker.flush()
+        evolu.sync()
+        evolu.worker.flush()
+        evolu._transport.flush()
+        assert metrics.get_counter("evolu_sync_redirects_total") == before + 1
+        # A stale learned route (404s now): invalidated, SAME round
+        # retried at the configured relay — no sync error, no loss.
+        evolu._transport._routes[owner] = f"http://127.0.0.1:{stub.server_address[1]}/"
+        errors = []
+        evolu.subscribe_error(errors.append)
+        evolu.create("todo", {"title": "t3", "isCompleted": False})
+        evolu.worker.flush()
+        evolu.sync()
+        evolu.worker.flush()
+        evolu._transport.flush()
+        evolu.worker.flush()
+        assert not errors
+        n = home.store.db.exec_sql_query(
+            'SELECT COUNT(*) AS n FROM "message"')[0]["n"]
+        assert n >= 3  # t3 arrived despite the stale route
+    finally:
+        if evolu is not None:
+            evolu.dispose()
+        stub.shutdown()
+        stub.server_close()
+        a.stop()
+        b.stop()
+
+
+# --- placement-scoped gossip ---
+
+
+def test_gossip_scoped_to_placement():
+    relays = []
+    try:
+        for _ in range(3):
+            relays.append(
+                RelayServer(RelayStore(), peers=[],
+                            replication_interval_s=30).start()
+            )
+        cfg = FleetConfig(relays=tuple(s.url for s in relays),
+                          replication_factor=2, version=1)
+        for s in relays:
+            s.enable_fleet(cfg)
+            for t in relays:
+                if t is not s:
+                    s.replication.add_peer(t.url)
+        a = relays[0]
+        # Owners on A: some placed on peer1, some not.
+        owners = [f"g{i:04d}" for i in range(24)]
+        for k, uid in enumerate(owners):
+            a.store.add_messages(uid, _msgs(k, 3))
+        sent = {}  # peer url -> summary trees sent
+
+        orig_post = a.replication._post
+
+        def recording_post(url, body):
+            if url.endswith("/replicate/summary"):
+                s = protocol.decode_replica_summary(body)
+                sent[url.rsplit("/replicate/", 1)[0]] = s
+            return orig_post(url, body)
+
+        a.replication._post = recording_post
+        a.replication.run_once()
+        assert len(sent) == 2
+        for peer_url, summary in sent.items():
+            advertised = {uid for uid, _t in summary.trees}
+            placed = {uid for uid in owners
+                      if a.fleet.placed_on(uid, peer_url)}
+            assert advertised == placed  # exactly the peer's placement
+            assert summary.peer_url == a.fleet.self_url
+        # R=2 over 3 relays: the union of both scoped summaries must
+        # NOT be "everything to everyone" — each owner reaches only
+        # its replica (O(R) fan-out, minus self).
+        total_sent = sum(len(s.trees) for s in sent.values())
+        assert total_sent < 2 * len(owners)
+        # Transfer happens on the PULLER's round: once each peer runs
+        # one, every owner lives on all R of its placed relays (strays
+        # drained to their placement).
+        for s in relays[1:]:
+            s.replication.run_once()
+        for uid in owners:
+            for target in a.fleet.placement(uid):
+                srv = next(s for s in relays if s.url == target)
+                if srv is a:
+                    continue
+                assert srv.store.get_merkle_tree_string(uid) == \
+                    a.store.get_merkle_tree_string(uid), uid
+    finally:
+        for s in relays:
+            s.stop()
+
+
+def test_serve_summary_scopes_response_to_caller_url():
+    relays = []
+    try:
+        for _ in range(2):
+            relays.append(
+                RelayServer(RelayStore(), peers=[],
+                            replication_interval_s=30).start()
+            )
+        a, b = relays
+        cfg = FleetConfig(relays=(a.url, b.url), replication_factor=1,
+                          version=1)
+        a.enable_fleet(cfg)
+        b.enable_fleet(cfg)
+        owners = [f"s{i:04d}" for i in range(16)]
+        for k, uid in enumerate(owners):
+            a.store.add_messages(uid, _msgs(k, 2))
+        # Ask with b's URL: only owners placed on b come back.
+        body = protocol.encode_replica_summary(
+            protocol.ReplicaSummary((), "probe", b.url))
+        resp = protocol.decode_replica_summary(
+            _http_post(a.url + "/replicate/summary", body))
+        got = {uid for uid, _t in resp.trees}
+        assert got == {uid for uid in owners if a.fleet.placed_on(uid, b.url)}
+        assert resp.peer_url == a.url
+        # An EMPTY peer_url (pre-fleet peer / the bench's oracle read)
+        # still gets the full map — interop unchanged.
+        body = protocol.encode_replica_summary(
+            protocol.ReplicaSummary((), "probe"))
+        resp = protocol.decode_replica_summary(
+            _http_post(a.url + "/replicate/summary", body))
+        assert {uid for uid, _t in resp.trees} == set(owners)
+    finally:
+        for s in relays:
+            s.stop()
+
+
+# --- rebalancing ---
+
+
+def test_join_rebalance_moves_owners_at_watermark():
+    from evolu_tpu.obs import metrics
+
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    b = None
+    try:
+        a.enable_fleet(FleetConfig(relays=(a.url,), replication_factor=1,
+                                   version=1))
+        owners = [f"m{i:04d}" for i in range(20)]
+        for k, uid in enumerate(owners):
+            a.store.add_messages(uid, _msgs(k, 10))
+        # peers=[] (listener) so the joiner's own gossip loop cannot
+        # race the snapshot sweep and drain moved owners via ranged
+        # pulls first (both paths converge — this test pins the
+        # SNAPSHOT path deterministically; fleet BEFORE start() so any
+        # later gossip is born scoped).
+        b = RelayServer(RelayStore(), peers=[],
+                        replication_interval_s=30)
+        cfg2 = FleetConfig(relays=(a.url, b.url), replication_factor=1,
+                           version=2)
+        fb = b.enable_fleet(cfg2)
+        b.start()
+        moved = [uid for uid in owners if fb.ring.primary(uid) == b.url]
+        assert moved, "ring change moved nothing — vnode layout broke"
+        body = json.dumps(cfg2.to_json()).encode()
+        req = urllib.request.Request(a.url + "/fleet/reload", data=body,
+                                     method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["rebalancing"] is True
+        v0 = metrics.get_counter("evolu_fleet_cutover_verified_total")
+        assert fb.rebalance_once() == len(moved)
+        # Counter-asserted snapshot cutover at the Merkle watermark:
+        # every moved owner verified byte-identical to the donor's
+        # capture-time tree before it started being served.
+        assert metrics.get_counter(
+            "evolu_fleet_cutover_verified_total") == v0 + len(moved)
+        for uid in moved:
+            assert b.store.get_merkle_tree_string(uid) == \
+                a.store.get_merkle_tree_string(uid)
+            assert b.store.replica_messages(uid, "") == \
+                a.store.replica_messages(uid, "")
+        # A (after its reload) now redirects moved owners to B.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http_post(a.url + "/", _sync_body(moved[0]))
+        assert e.value.code == 307
+        assert e.value.headers.get("Location") == b.url + "/"
+        # Unmoved owners stay where they were.
+        kept = [uid for uid in owners if uid not in moved]
+        assert all(b.fleet.ring.primary(uid) == a.url for uid in kept)
+        # Re-running the sweep is a no-op (idempotent).
+        assert fb.rebalance_once() == 0
+    finally:
+        if b is not None:
+            b.stop()
+        a.stop()
+
+
+def test_rebalance_survives_concurrent_acked_writes():
+    """A write ACKed by the DONOR after capture must still reach the
+    gaining relay (scoped gossip heals the post-watermark tail) — the
+    zero-lost-ACKed-writes property, in miniature."""
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    b = None
+    try:
+        a.enable_fleet(FleetConfig(relays=(a.url,), replication_factor=1,
+                                   version=1))
+        owners = [f"w{i:04d}" for i in range(12)]
+        for k, uid in enumerate(owners):
+            a.store.add_messages(uid, _msgs(k, 6))
+        # peers=[] so the joiner's gossip loop cannot pre-drain moved
+        # owners before the snapshot sweep (see the join test above);
+        # the donor is added as a gossip peer for the heal phase only.
+        b = RelayServer(RelayStore(), peers=[],
+                        replication_interval_s=30)
+        cfg2 = FleetConfig(relays=(a.url, b.url), replication_factor=1,
+                           version=2)
+        fb = b.enable_fleet(cfg2)
+        b.start()
+        moved = [uid for uid in owners if fb.ring.primary(uid) == b.url]
+        # The donor ACKs one more write AFTER B computed its gain set
+        # but BEFORE B's snapshot install finishes — emulated by
+        # writing between the sweep's summary leg and cutover via the
+        # snapshot-request hook.
+        straggler = moved[0]
+        orig_post = fb._post
+
+        def post_with_straggler(url, body):
+            if url.endswith("/replicate/snapshot"):
+                # Landed on the donor pre-capture: included in the
+                # snapshot — or post-capture: healed by gossip. Both
+                # must converge; this exercises the window.
+                a.store.add_messages(
+                    straggler, _msgs(owners.index(straggler), 2, t0=100))
+            return orig_post(url, body)
+
+        fb._post = post_with_straggler
+        a.fleet.apply_config(cfg2, rebalance=False)
+        assert fb.rebalance_once() == len(moved)
+        # Heal the tail through normal scoped gossip.
+        b.replication.add_peer(a.url)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            b.replication.run_once()
+            if all(
+                b.store.get_merkle_tree_string(u)
+                == a.store.get_merkle_tree_string(u)
+                for u in moved
+            ):
+                break
+            time.sleep(0.05)
+        for uid in moved:
+            assert b.store.replica_messages(uid, "") == \
+                a.store.replica_messages(uid, ""), uid
+    finally:
+        if b is not None:
+            b.stop()
+        a.stop()
+
+
+# --- failover ---
+
+
+def test_down_primary_fails_over_to_next_replica():
+    relays = []
+    try:
+        for _ in range(3):
+            relays.append(
+                RelayServer(RelayStore(), peers=[],
+                            replication_interval_s=30).start()
+            )
+        cfg = FleetConfig(relays=tuple(s.url for s in relays),
+                          replication_factor=2, version=1)
+        for s in relays:
+            s.enable_fleet(cfg)
+        # An owner whose primary is relays[p] and replica relays[q]; a
+        # THIRD relay routes requests for it.
+        ring = relays[0].fleet.ring
+        uid = "f0000"
+        i = 0
+        while True:
+            uid = f"f{i:04d}"
+            p = ring.placement(uid)
+            if len(p) == 2:
+                break
+            i += 1
+        primary = next(s for s in relays if s.url == p[0])
+        replica = next(s for s in relays if s.url == p[1])
+        third = next(s for s in relays if s.url not in p)
+        action, target = third.fleet.route(uid)
+        assert (action, target) == ("redirect", primary.url)
+        # Primary goes down; the probe cache expires and the next
+        # route fails over to the ring replica.
+        primary.stop()
+        third.fleet._probe_cache.clear()
+        action, target = third.fleet.route(uid)
+        assert (action, target) == ("redirect", replica.url)
+        from evolu_tpu.obs import metrics
+
+        assert metrics.get_counter("evolu_fleet_failovers_total") >= 1
+        # The replica, being placed, serves.
+        out = _http_post(replica.url + "/", _sync_body(uid, _msgs(9, 2)))
+        assert protocol.decode_sync_response(out).merkle_tree != "{}"
+        relays.remove(primary)
+    finally:
+        for s in relays:
+            s.stop()
+
+
+def test_forwarded_request_never_reforwarded():
+    """The hop guard: a /fleet/forward landing on a relay that (per a
+    diverged mid-reload ring) is NOT placed for the owner is served
+    locally, never bounced again."""
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    try:
+        a.enable_fleet(FleetConfig(relays=(a.url, "http://127.0.0.1:1"),
+                                   replication_factor=1, version=1,
+                                   forward=True))
+        ring = a.fleet.ring
+        uid = _owner_for(ring, "http://127.0.0.1:1", prefix="h")
+        # A direct POST / in forward mode with NO placed relay passing
+        # the readiness probe sheds 503 + Retry-After instead of
+        # pinning a handler thread on a POST to a known-down peer.
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http_post(a.url + "/", _sync_body(uid), retries=0)
+        assert e.value.code == 503
+        assert float(e.value.headers.get("Retry-After")) > 0
+        # The envelope path must serve locally instead.
+        env = protocol.encode_fleet_forward(
+            protocol.FleetForward(_sync_body(uid, _msgs(3, 2)),
+                                  "http://origin:1", 1))
+        out = _http_post(a.url + "/fleet/forward", env)
+        assert protocol.decode_sync_response(out).merkle_tree != "{}"
+        assert a.store.user_ids() == [uid]
+        # The hop guard is enforced on the wire too: anything but a
+        # single-hop envelope answers 400 before any side effect.
+        bad = protocol.encode_fleet_forward(
+            protocol.FleetForward(_sync_body(uid), "http://origin:1", 2))
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http_post(a.url + "/fleet/forward", bad)
+        assert e.value.code == 400
+    finally:
+        a.stop()
+
+
+def test_forward_to_non_fleet_peer_answers_502_not_503():
+    """A peer that DEFINITIVELY rejects the forward (404: not
+    fleet-enabled / older build) must surface as 502 + errors_total,
+    not be masked as retry-forever flow control."""
+    from evolu_tpu.obs import metrics
+
+    plain = RelayServer(RelayStore()).start()  # no fleet: /fleet/* 404s
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    try:
+        a.enable_fleet(FleetConfig(relays=(a.url, plain.url),
+                                   replication_factor=1, version=1,
+                                   forward=True))
+        uid = _owner_for(a.fleet.ring, plain.url, prefix="p")
+        errs = metrics.get_counter("evolu_relay_errors_total")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http_post(a.url + "/", _sync_body(uid, _msgs(5, 1)), retries=0)
+        assert e.value.code == 502
+        # The forwarder counted it (the peer's bare 404 does not inc
+        # the shared registry): definitive rejection IS an error-rate
+        # event, unlike the 503 flow-control path.
+        assert metrics.get_counter("evolu_relay_errors_total") == errs + 1
+    finally:
+        a.stop()
+        plain.stop()
