@@ -1,0 +1,661 @@
+"""CRDT column types beyond the LWW register (ROADMAP #4, ISSUE 7).
+
+The reference (Evolu v0.5.1) expresses exactly one merge semantic:
+last-writer-wins per (table, row, column) cell. This module adds two
+op-based column types on the SAME substrate — ops are ordinary
+`CrdtMessage`s whose timestamps feed the unchanged Merkle/anti-entropy
+/snapshot machinery; only the APP-TABLE materialization differs:
+
+- **PN-counter** (`"counter"`): each op's value is a signed int delta
+  (the (replica, pos, neg) decomposition: replica = the op timestamp's
+  node, pos/neg = the delta's sign). Cell value = Σ deltas over the
+  distinct op set — permutation- and partition-invariant, so any
+  delivery schedule converges (arXiv:2004.04303's op-based composition
+  view: the increment monoid needs no resolver at all).
+- **Add-wins set** (`"awset"`, observed-remove): an add op carries a
+  JSON `["a", elem]` and is tagged by its own (globally unique) op
+  timestamp; a remove carries `["r", elem, [observed add tags...]]`
+  and kills exactly the adds it OBSERVED. An add whose tag no remove
+  ever lists survives — concurrent add beats remove (true AW-set, not
+  the timestamp-biased LWW-element approximation), and the fold is
+  order-free: alive(tag) = added(tag) ∧ tag ∉ kills, regardless of
+  arrival order (a kill arriving before its add still wins — kills are
+  tombstoned in `__crdt_kill`).
+
+Design invariants (see docs/CRDT_TYPES.md):
+- The LWW xor/Merkle algebra is TIMESTAMP-ONLY and stays byte-for-byte
+  unchanged for typed cells: replication, snapshot bootstrap, and the
+  winner cache's MAX(timestamp) slots need no new wire format — typed
+  ops ride the existing E2EE-opaque message stream, which is exactly
+  why a v1 peer relays them byte-identically (capability negotiation
+  in sync/protocol.py is an announcement, not a format fork).
+- Typed cells NEVER take the LWW app-table upsert: `storage.apply`
+  strips them from every planner's upsert set (one copy:
+  `ops.merge.strip_typed_upserts`) and folds newly-inserted ops into
+  the `__crdt_*` state tables inside the same transaction, then
+  materializes the cell value (counter: pos−neg int; set: canonical
+  sorted JSON array) into the app table.
+- Op decoding raises ValueError ONLY; the fold layer catches, counts
+  (`evolu_crdt_malformed_ops_total`) and ignores malformed ops — a
+  hostile peer must not be able to wedge an owner's sync by writing
+  garbage to a typed column.
+- Host oracle first: every fold here is the semantics reference; the
+  device kernels (`ops/crdt_merge.py`) are pinned bit-identical to it
+  on property-sampled op logs (tests/test_crdt_types.py + golden
+  fixtures that are never updated).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.obs import metrics
+
+LWW = "lww"
+COUNTER = "counter"
+AWSET = "awset"
+COLUMN_TYPES = (LWW, COUNTER, AWSET)
+
+# Counter deltas are bounded to int32 so 2^31 ops can never overflow
+# the int64 pos/neg accumulators (SQLite INTEGER and the device's i64
+# segmented sums share the bound).
+_DELTA_MIN, _DELTA_MAX = -(2**31) + 1, 2**31 - 1
+
+# Batches of at least this many new typed ops fold on device
+# (ops/crdt_merge.py); below it the host oracle is faster than a
+# dispatch (same shape of cutoff as Config.min_device_batch).
+DEVICE_FOLD_MIN = 4096
+
+Cell = Tuple[str, str, str]
+
+_SCHEMA_TABLE_SQL = (
+    'CREATE TABLE IF NOT EXISTS "__crdt_schema" ('
+    '"table" BLOB, "column" BLOB, "type" BLOB, '
+    'PRIMARY KEY ("table", "column"))'
+)
+_STATE_TABLES_SQL = (
+    'CREATE TABLE IF NOT EXISTS "__crdt_counter" ('
+    '"table" BLOB, "row" BLOB, "column" BLOB, '
+    '"pos" INTEGER NOT NULL, "neg" INTEGER NOT NULL, '
+    'PRIMARY KEY ("table", "row", "column"))',
+    # One row per add op; "tag" is the add's op timestamp (globally
+    # unique), "elem" the canonical JSON element key. alive=0 marks an
+    # observed-removed add (kept for `observed_tags` idempotence; the
+    # row is the tombstone's evidence).
+    'CREATE TABLE IF NOT EXISTS "__crdt_set" ('
+    '"tag" BLOB PRIMARY KEY, "table" BLOB, "row" BLOB, "column" BLOB, '
+    '"elem" BLOB, "alive" INTEGER NOT NULL)',
+    'CREATE INDEX IF NOT EXISTS "index__crdt_set_cell" ON "__crdt_set" '
+    '("table", "row", "column", "alive")',
+    # Kill tombstones: a remove may arrive BEFORE the add it observed
+    # (anti-entropy has no causal delivery); the tag must stay dead.
+    'CREATE TABLE IF NOT EXISTS "__crdt_kill" ("tag" BLOB PRIMARY KEY)',
+)
+
+
+# --- column specs & schema registry ---
+
+
+def parse_column_spec(spec: str) -> Tuple[str, str]:
+    """`"votes:counter"` → ("votes", "counter"); a bare name is LWW.
+    Unknown type suffixes raise ValueError (a typo'd schema must fail
+    loudly at declaration, not silently become an LWW column)."""
+    if ":" not in spec:
+        return spec, LWW
+    name, _, ctype = spec.partition(":")
+    if ctype not in COLUMN_TYPES:
+        raise ValueError(f"unknown CRDT column type {ctype!r} in {spec!r}")
+    if not name:
+        raise ValueError(f"empty column name in spec {spec!r}")
+    return name, ctype
+
+
+class CrdtSchema:
+    """Per-database column-type registry. Empty (the common case and
+    every pre-existing database) means pure-LWW and costs one dict
+    probe per apply."""
+
+    __slots__ = ("types",)
+
+    def __init__(self, types: Optional[Dict[Tuple[str, str], str]] = None):
+        self.types: Dict[Tuple[str, str], str] = dict(types or {})
+
+    def column_type(self, table: str, column: str) -> str:
+        return self.types.get((table, column), LWW)
+
+    def is_typed(self, table: str, column: str) -> bool:
+        return (table, column) in self.types
+
+    def has_typed(self, cells: Iterable[Cell]) -> bool:
+        if not self.types:
+            return False
+        return any((t, c) in self.types for t, _r, c in cells)
+
+    def __bool__(self) -> bool:
+        return bool(self.types)
+
+
+def ensure_schema_table(db) -> None:
+    db.exec(_SCHEMA_TABLE_SQL)
+
+
+def ensure_state_tables(db) -> None:
+    for sql in _STATE_TABLES_SQL:
+        db.exec(sql)
+
+
+def declare_column_types(db, declarations: Iterable[Tuple[str, str, str]]) -> None:
+    """Persist (table, column, type) declarations (add-only, idempotent;
+    re-declaring a column with a DIFFERENT type raises — changing merge
+    semantics under committed ops has no sane meaning)."""
+    decls = [(t, c, ct) for t, c, ct in declarations if ct != LWW]
+    if not decls:
+        return
+    ensure_schema_table(db)
+    ensure_state_tables(db)
+    existing = {
+        (r["table"], r["column"]): r["type"]
+        for r in db.exec_sql_query('SELECT "table", "column", "type" FROM "__crdt_schema"')
+    }
+    for t, c, ct in decls:
+        have = existing.get((t, c))
+        if have is not None and have != ct:
+            raise ValueError(
+                f"column {t}.{c} already declared {have!r}, cannot become {ct!r}"
+            )
+    new_decls = [d for d in decls if (d[0], d[1]) not in existing]
+    db.run_many(
+        'INSERT OR IGNORE INTO "__crdt_schema" ("table", "column", "type") '
+        "VALUES (?, ?, ?)",
+        decls,
+    )
+    invalidate_schema_cache(db)
+    if new_decls:
+        _fold_predeclaration_ops(db, new_decls)
+
+
+def _fold_predeclaration_ops(db, decls: Sequence[Tuple[str, str, str]]) -> None:
+    """Ops that reached __message BEFORE a column was declared typed
+    (rolling upgrade: a peer authored typed ops while this replica
+    still ran the undeclared schema) were applied as LWW and would
+    otherwise NEVER be folded — `screen_new_ops` screens everything
+    already in __message, so this replica's materialized value would
+    silently diverge from a replica that declared before syncing, and
+    anti-entropy (timestamp-only) could never heal it. Folding the
+    column's full log at declaration time makes materialization a
+    function of the op set alone, independent of declaration timing.
+    State for a newly-declared column is necessarily empty (only
+    declared columns ever fold), so this is exact, and it runs inside
+    the caller's transaction (UpdateDbSchema is one command = one txn)."""
+    schema = CrdtSchema({(t, c): ct for t, c, ct in decls})
+    msgs: List[CrdtMessage] = []
+    for t, c, _ct in decls:
+        try:
+            rows = db.exec_sql_query(
+                'SELECT "timestamp", "table", "row", "column", "value" '
+                'FROM "__message" WHERE "table" = ? AND "column" = ? '
+                'ORDER BY "timestamp"',
+                (t, c),
+            )
+        except Exception as e:  # noqa: BLE001
+            if _is_missing_table(e):  # declared before init_db_model: no log yet
+                return
+            raise
+        msgs.extend(
+            CrdtMessage(r["timestamp"], r["table"], r["row"], r["column"], r["value"])
+            for r in rows
+        )
+    if not msgs:
+        return
+    metrics.inc("evolu_crdt_predeclaration_folds_total", len(msgs))
+    by_type = partition_typed(schema, msgs)
+    touched: Set[Cell] = set()
+    touched |= apply_counter_ops(db, by_type.get(COUNTER, ()))
+    touched |= apply_set_ops(db, by_type.get(AWSET, ()))
+    if touched:
+        materialize_cells(db, schema, touched)
+
+
+def invalidate_schema_cache(db) -> None:
+    try:
+        db._crdt_schema_cache = None
+    except AttributeError:  # a backend with __slots__: reload per apply
+        pass
+
+
+def _is_missing_table(e: BaseException) -> bool:
+    return "no such table" in str(e)
+
+
+def load_schema(db) -> CrdtSchema:
+    """The per-connection schema cache. Declarations happen on the same
+    worker connection (single-writer discipline, like the winner
+    cache), so a cached load stays valid until `declare_column_types`
+    or an owner reset invalidates it.
+
+    Error discipline: a MISSING `__crdt_schema` table means a
+    pure-LWW database and caches the empty schema (relays and
+    pre-typed apps pay one probe, ever). Any OTHER load error
+    re-raises — swallowing e.g. a cross-process 'database is locked'
+    into an empty cached schema would silently route typed cells
+    through the LWW path forever, permanent divergence; failing the
+    apply transaction instead is safe (rollback + redelivery)."""
+    cached = getattr(db, "_crdt_schema_cache", None)
+    if cached is not None:
+        return cached
+    try:
+        rows = db.exec_sql_query(
+            'SELECT "table", "column", "type" FROM "__crdt_schema"'
+        )
+        types = {(r["table"], r["column"]): r["type"] for r in rows}
+    except Exception as e:  # noqa: BLE001
+        if not _is_missing_table(e):
+            raise
+        types = {}
+    schema = CrdtSchema(types)
+    try:
+        db._crdt_schema_cache = schema
+    except AttributeError:
+        pass
+    return schema
+
+
+# --- op codecs (ValueError-only, like the wire decoders) ---
+
+
+def counter_delta(value) -> int:
+    """Decode a counter op value → signed int delta. ValueError only."""
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise ValueError(f"counter op value must be an int delta: {value!r}")
+    if not _DELTA_MIN <= value <= _DELTA_MAX:
+        raise ValueError(f"counter delta exceeds int32: {value!r}")
+    return value
+
+
+def elem_key(elem) -> str:
+    """Canonical JSON encoding of a set element — the ONE encoding used
+    for kill matching, state storage, and materialization sort order."""
+    if isinstance(elem, bool) or not isinstance(elem, (str, int)):
+        raise ValueError(f"set element must be str or int: {elem!r}")
+    return json.dumps(elem, separators=(",", ":"))
+
+
+def set_add_value(elem) -> str:
+    """Encode an add op value. The op's OWN timestamp becomes its tag."""
+    return f'["a",{elem_key(elem)}]'
+
+
+def set_remove_value(elem, observed: Iterable[str]) -> str:
+    """Encode a remove op value killing the `observed` add tags."""
+    tags = sorted(set(observed))
+    for t in tags:
+        if not isinstance(t, str):
+            raise ValueError(f"observed tag must be a timestamp string: {t!r}")
+    return json.dumps(["r", json.loads(elem_key(elem)), tags],
+                      separators=(",", ":"))
+
+
+def decode_set_op(value) -> Tuple[str, str, Tuple[str, ...]]:
+    """Decode a set op value → (kind, elem_key, kill_tags). ValueError
+    only (the fold layer catches and counts malformed ops)."""
+    if not isinstance(value, str):
+        raise ValueError(f"set op value must be a JSON string: {value!r}")
+    try:
+        op = json.loads(value)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"malformed set op JSON: {e}") from e
+    if not isinstance(op, list) or not op or op[0] not in ("a", "r"):
+        raise ValueError(f"malformed set op shape: {value!r}")
+    if op[0] == "a":
+        if len(op) != 2:
+            raise ValueError(f"add op must be ['a', elem]: {value!r}")
+        return "a", elem_key(op[1]), ()
+    if len(op) != 3 or not isinstance(op[2], list):
+        raise ValueError(f"remove op must be ['r', elem, [tags]]: {value!r}")
+    tags = []
+    for t in op[2]:
+        if not isinstance(t, str):
+            raise ValueError(f"remove op tag must be a string: {t!r}")
+        tags.append(t)
+    return "r", elem_key(op[1]), tuple(tags)
+
+
+def materialize_set_value(alive_elem_keys: Iterable[str]) -> str:
+    """Canonical sorted JSON array over DISTINCT alive element keys —
+    deterministic across replicas for any op delivery order."""
+    return "[" + ",".join(sorted(set(alive_elem_keys))) + "]"
+
+
+# --- host-oracle folds (the semantics reference for the device twins) ---
+
+
+def fold_counter_ops(deltas: Iterable[int]) -> Tuple[int, int]:
+    """Σ over a batch → (pos, neg) non-negative partial sums."""
+    pos = neg = 0
+    for d in deltas:
+        if d > 0:
+            pos += d
+        else:
+            neg -= d
+    return pos, neg
+
+
+def decode_counter_batch(msgs: Sequence[CrdtMessage]) -> Tuple[List[Tuple[CrdtMessage, int]], int]:
+    """→ ([(msg, delta)], malformed_count); malformed ops are dropped."""
+    out, bad = [], 0
+    for m in msgs:
+        try:
+            out.append((m, counter_delta(m.value)))
+        except ValueError:
+            bad += 1
+    return out, bad
+
+
+def decode_set_batch(
+    msgs: Sequence[CrdtMessage],
+) -> Tuple[List[Tuple[CrdtMessage, str]], List[Tuple[CrdtMessage, Tuple[str, ...]]], int]:
+    """→ (adds [(msg, elem_key)] tagged by msg.timestamp,
+    removes [(msg, kill_tags)], malformed_count). Malformed ops are
+    dropped HERE so they can never touch a cell — whether a cell
+    materializes must be a function of the delivered VALID op set only,
+    never of how ops happened to be batched (a malformed op that
+    co-arrives with a valid one must not create an app row that a
+    replica receiving it alone would lack)."""
+    adds: List[Tuple[CrdtMessage, str]] = []
+    removes: List[Tuple[CrdtMessage, Tuple[str, ...]]] = []
+    bad = 0
+    for m in msgs:
+        try:
+            kind, ek, tags = decode_set_op(m.value)
+        except ValueError:
+            bad += 1
+            continue
+        if kind == "a":
+            adds.append((m, ek))
+        else:
+            removes.append((m, tags))
+    return adds, removes, bad
+
+
+def alive_add_flags(
+    add_tags: Sequence[str], kills: Set[str], state_killed: Set[str]
+) -> List[bool]:
+    """The AW-set fold's heart: an add survives iff its tag is in
+    neither the batch kills nor the tombstoned state kills. Order-free
+    and idempotent — the one line both backends must agree on."""
+    return [t not in kills and t not in state_killed for t in add_tags]
+
+
+# --- SQL state integration (runs INSIDE the caller's transaction) ---
+
+
+def _chunked_in(db, sql_prefix: str, keys: Sequence, chunk: int = 500) -> List[dict]:
+    rows: List[dict] = []
+    for i in range(0, len(keys), chunk):
+        part = keys[i : i + chunk]
+        placeholders = ",".join("?" * len(part))
+        rows.extend(db.exec_sql_query(sql_prefix.format(placeholders), tuple(part)))
+    return rows
+
+
+def screen_new_ops(db, msgs: Sequence[CrdtMessage]) -> List[CrdtMessage]:
+    """Ops whose timestamps are NOT yet in __message, first occurrence
+    per timestamp (matching INSERT OR NOTHING's keep-first) — the dedup
+    gate that makes the state fold redelivery-safe."""
+    seen: Set[str] = set()
+    candidates: List[CrdtMessage] = []
+    for m in msgs:
+        if m.timestamp not in seen:
+            seen.add(m.timestamp)
+            candidates.append(m)
+    if not candidates:
+        return []
+    existing = {
+        r["timestamp"]
+        for r in _chunked_in(
+            db,
+            'SELECT "timestamp" FROM "__message" WHERE "timestamp" IN ({})',
+            [m.timestamp for m in candidates],
+        )
+    }
+    return [m for m in candidates if m.timestamp not in existing]
+
+
+def partition_typed(
+    schema: CrdtSchema, msgs: Sequence[CrdtMessage]
+) -> Dict[str, List[CrdtMessage]]:
+    """{"counter": [...], "awset": [...]} for the typed messages of a
+    batch (order preserved). Callers fast-path on empty schema."""
+    out: Dict[str, List[CrdtMessage]] = {}
+    for m in msgs:
+        ct = schema.column_type(m.table, m.column)
+        if ct != LWW:
+            out.setdefault(ct, []).append(m)
+    return out
+
+
+def _fold_counters_device(pairs: Sequence[Tuple[CrdtMessage, int]]):
+    """Per-cell (pos, neg) via the device segmented-sum kernel —
+    bit-identical to the host fold (test-pinned)."""
+    import numpy as np
+
+    from evolu_tpu.ops.crdt_merge import pn_counter_sums
+    from evolu_tpu.ops.host_parse import intern_cells
+
+    msgs = [m for m, _ in pairs]
+    cell_id, cells = intern_cells(
+        [m.table for m in msgs], [m.row for m in msgs], [m.column for m in msgs]
+    )
+    deltas = np.fromiter((d for _, d in pairs), np.int64, len(pairs))
+    pos, neg = pn_counter_sums(cell_id, deltas, len(cells))
+    return {cells[i]: (int(pos[i]), int(neg[i])) for i in range(len(cells))}
+
+
+def _fold_counters_host(pairs: Sequence[Tuple[CrdtMessage, int]]):
+    per_cell: Dict[Cell, List[int]] = {}
+    for m, d in pairs:
+        per_cell.setdefault((m.table, m.row, m.column), []).append(d)
+    return {cell: fold_counter_ops(ds) for cell, ds in per_cell.items()}
+
+
+def apply_counter_ops(db, new_msgs: Sequence[CrdtMessage]) -> Set[Cell]:
+    """Fold NEW counter ops into __crdt_counter. Returns touched cells."""
+    pairs, bad = decode_counter_batch(new_msgs)
+    if bad:
+        metrics.inc("evolu_crdt_malformed_ops_total", bad, type=COUNTER)
+    if not pairs:
+        return set()
+    metrics.inc("evolu_crdt_ops_total", len(pairs), type=COUNTER)
+    if len(pairs) >= DEVICE_FOLD_MIN:
+        metrics.inc("evolu_crdt_plan_total", type=COUNTER, path="device")
+        sums = _fold_counters_device(pairs)
+    else:
+        metrics.inc("evolu_crdt_plan_total", type=COUNTER, path="host")
+        sums = _fold_counters_host(pairs)
+    db.run_many(
+        'INSERT INTO "__crdt_counter" ("table", "row", "column", "pos", "neg") '
+        "VALUES (?, ?, ?, ?, ?) "
+        'ON CONFLICT ("table", "row", "column") DO UPDATE SET '
+        '"pos" = "pos" + excluded."pos", "neg" = "neg" + excluded."neg"',
+        [(t, r, c, p, n) for (t, r, c), (p, n) in sums.items()],
+    )
+    return set(sums)
+
+
+def apply_set_ops(db, new_msgs: Sequence[CrdtMessage]) -> Set[Cell]:
+    """Fold NEW set ops into __crdt_set/__crdt_kill. Returns touched
+    cells (adds AND removes: a remove changes materialization too)."""
+    adds, removes, bad = decode_set_batch(new_msgs)
+    if bad:
+        metrics.inc("evolu_crdt_malformed_ops_total", bad, type=AWSET)
+    if not adds and not removes:
+        return set()
+    metrics.inc("evolu_crdt_ops_total", len(adds) + len(removes), type=AWSET)
+    kills: Set[str] = set()
+    for _m, tags in removes:
+        kills.update(tags)
+
+    # Tombstoned kills relevant to this batch's adds (a kill that
+    # arrived in an EARLIER batch must still dead-on-arrival this add).
+    add_tags = [m.timestamp for m, _ in adds]
+    state_killed: Set[str] = set()
+    if add_tags:
+        state_killed = {
+            r["tag"]
+            for r in _chunked_in(
+                db, 'SELECT "tag" FROM "__crdt_kill" WHERE "tag" IN ({})', add_tags
+            )
+        }
+    if len(adds) + len(kills) >= DEVICE_FOLD_MIN:
+        metrics.inc("evolu_crdt_plan_total", type=AWSET, path="device")
+        from evolu_tpu.ops.crdt_merge import awset_alive_flags
+
+        alive = awset_alive_flags(add_tags, kills, state_killed)
+    else:
+        metrics.inc("evolu_crdt_plan_total", type=AWSET, path="host")
+        alive = alive_add_flags(add_tags, kills, state_killed)
+
+    touched: Set[Cell] = set()
+    if kills:
+        # Tombstone first, then kill matching EXISTING alive adds.
+        db.run_many(
+            'INSERT OR IGNORE INTO "__crdt_kill" ("tag") VALUES (?)',
+            [(t,) for t in sorted(kills)],
+        )
+        killed_rows = _chunked_in(
+            db,
+            'SELECT "tag", "table", "row", "column" FROM "__crdt_set" '
+            'WHERE "alive" = 1 AND "tag" IN ({})',
+            sorted(kills),
+        )
+        if killed_rows:
+            db.run_many(
+                'UPDATE "__crdt_set" SET "alive" = 0 WHERE "tag" = ?',
+                [(r["tag"],) for r in killed_rows],
+            )
+            touched.update((r["table"], r["row"], r["column"]) for r in killed_rows)
+    if adds:
+        db.run_many(
+            'INSERT OR IGNORE INTO "__crdt_set" '
+            '("tag", "table", "row", "column", "elem", "alive") '
+            "VALUES (?, ?, ?, ?, ?, ?)",
+            [
+                (m.timestamp, m.table, m.row, m.column, ek, int(a))
+                for (m, ek), a in zip(adds, alive)
+            ],
+        )
+        touched.update((m.table, m.row, m.column) for m, _ in adds)
+    # Every VALID op touches its cell — a remove targeting a cell with
+    # no stored adds still materializes it (possibly as "[]"), and does
+    # so identically on every replica regardless of batching.
+    touched.update((m.table, m.row, m.column) for m, _ in removes)
+    return touched
+
+
+def materialize_cells(db, schema: CrdtSchema, cells: Iterable[Cell]) -> None:
+    """Upsert the merged value of each touched typed cell into its app
+    table row — the typed replacement for the LWW winner upsert. Runs
+    inside the apply transaction; identical fold state ⇒ identical app
+    bytes on every replica.
+
+    Batched per (table, column): one chunked IN-list read + one
+    run_many upsert per group — per-cell statements would undo the
+    vectorization the device fold just paid for on DEVICE_FOLD_MIN+
+    batches spread over thousands of cells."""
+    from evolu_tpu.storage.apply import _upsert_sql  # one-copy SQL builder
+
+    groups: Dict[Tuple[str, str], Set[str]] = {}
+    for table, row, column in cells:
+        groups.setdefault((table, column), set()).add(row)
+    for (table, column), row_set in sorted(groups.items()):
+        ct = schema.column_type(table, column)
+        rows = sorted(row_set)
+        values: Dict[str, object] = {}
+        if ct == COUNTER:
+            default: object = 0
+            for i in range(0, len(rows), 500):
+                part = rows[i : i + 500]
+                q = (
+                    'SELECT "row", "pos", "neg" FROM "__crdt_counter" '
+                    'WHERE "table" = ? AND "column" = ? AND "row" IN ({})'
+                ).format(",".join("?" * len(part)))
+                for r in db.exec_sql_query(q, (table, column, *part)):
+                    values[r["row"]] = r["pos"] - r["neg"]
+        elif ct == AWSET:
+            default = materialize_set_value(())
+            elems: Dict[str, Set[str]] = {}
+            for i in range(0, len(rows), 500):
+                part = rows[i : i + 500]
+                q = (
+                    'SELECT "row", "elem" FROM "__crdt_set" '
+                    'WHERE "table" = ? AND "column" = ? AND "alive" = 1 '
+                    'AND "row" IN ({})'
+                ).format(",".join("?" * len(part)))
+                for r in db.exec_sql_query(q, (table, column, *part)):
+                    elems.setdefault(r["row"], set()).add(r["elem"])
+            values = {row: materialize_set_value(e) for row, e in elems.items()}
+        else:  # pragma: no cover - partition_typed never routes LWW here
+            continue
+        db.run_many(
+            _upsert_sql(table, column),
+            [(row, values.get(row, default), values.get(row, default))
+             for row in rows],
+        )
+        metrics.inc("evolu_crdt_materialized_cells_total", len(rows), type=ct)
+
+
+def apply_typed_ops(db, schema: CrdtSchema, typed_msgs: Sequence[CrdtMessage]) -> None:
+    """The whole typed apply leg: dedup against __message, fold per
+    type, materialize touched cells. MUST run inside the apply
+    transaction BEFORE the batch's __message insert (the dedup screen
+    reads pre-batch state)."""
+    new_ops = screen_new_ops(db, typed_msgs)
+    by_type = partition_typed(schema, new_ops)
+    touched: Set[Cell] = set()
+    touched |= apply_counter_ops(db, by_type.get(COUNTER, ()))
+    touched |= apply_set_ops(db, by_type.get(AWSET, ()))
+    # Redelivered-only batches still touch no state; nothing to write.
+    if touched:
+        materialize_cells(db, schema, touched)
+
+
+def observed_tags(db, table: str, row: str, column: str, elem) -> List[str]:
+    """Alive add tags for (cell, elem) — what a remove op must observe.
+    Read on the author's own replica (same connection discipline as
+    mutations)."""
+    ek = elem_key(elem)
+    rows = db.exec_sql_query(
+        'SELECT "tag" FROM "__crdt_set" WHERE "table" = ? AND "row" = ? '
+        'AND "column" = ? AND "elem" = ? AND "alive" = 1 ORDER BY "tag"',
+        (table, row, column, ek),
+    )
+    return [r["tag"] for r in rows]
+
+
+def rebuild_state(db, schema: CrdtSchema) -> None:
+    """Maintenance: recompute __crdt_* state and every typed app value
+    from the full __message log (the fold is order-free, so one pass in
+    timestamp order is exact). Used by integrity checks and tests; the
+    incremental path never needs it."""
+    if not schema:
+        return
+    ensure_state_tables(db)
+    for t in ("__crdt_counter", "__crdt_set", "__crdt_kill"):
+        db.run(f'DELETE FROM "{t}"')
+    rows = db.exec_sql_query(
+        'SELECT "timestamp", "table", "row", "column", "value" FROM "__message" '
+        'ORDER BY "timestamp"'
+    )
+    msgs = [
+        CrdtMessage(r["timestamp"], r["table"], r["row"], r["column"], r["value"])
+        for r in rows
+        if schema.is_typed(r["table"], r["column"])
+    ]
+    by_type = partition_typed(schema, msgs)
+    touched: Set[Cell] = set()
+    touched |= apply_counter_ops(db, by_type.get(COUNTER, ()))
+    touched |= apply_set_ops(db, by_type.get(AWSET, ()))
+    if touched:
+        materialize_cells(db, schema, touched)

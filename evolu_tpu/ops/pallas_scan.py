@@ -77,6 +77,21 @@ def _seg_xor(left, right):
     return (lf | rf, jnp.where(rf != 0, rv, lv ^ rv))
 
 
+def _seg_sum(left, right):
+    """Segmented u64 SUM monoid on (flag, hi limb, lo limb) — the
+    add-monoid twin of `_comb` for the PN-counter fold
+    (ops/crdt_merge.py). No 64-bit vectors on TPU Pallas, so the sum
+    carries across two u32 limbs: unsigned u32 add wraps, and
+    `lo < either operand` detects the wrap (values are non-negative
+    pos/neg partial sums, so plain modular limb addition is exact)."""
+    lf, lh, ll = left
+    rf, rh, rl = right
+    lo = ll + rl
+    carry = (lo < rl).astype(jnp.uint32)
+    hi = lh + rh + carry
+    return (lf | rf, jnp.where(rf != 0, rh, hi), jnp.where(rf != 0, rl, lo))
+
+
 def _make_scan_kernel(n_planes: int, combine):
     """Kernel factory: inclusive segmented scan over `n_planes` u32
     planes (plane 0 is the segment flag) under `combine`, one grid
@@ -141,6 +156,7 @@ def _make_scan_kernel(n_planes: int, combine):
 
 _LEX_KERNEL = _make_scan_kernel(5, _comb)
 _XOR_KERNEL = _make_scan_kernel(2, _seg_xor)
+_SUM_KERNEL = _make_scan_kernel(3, _seg_sum)
 
 
 def _scan_call(kernel, n_planes, planes, interpret):
@@ -169,6 +185,11 @@ def _xor_scan_blocks(f, v, interpret: bool = False):
     return _scan_call(_XOR_KERNEL, 2, (f, v), interpret)
 
 
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _sum_scan_blocks(f, hi, lo, interpret: bool = False):
+    return _scan_call(_SUM_KERNEL, 3, (f, hi, lo), interpret)
+
+
 def segmented_xor_scan_pallas(flags, values_u32, interpret: bool = False):
     """(N,) bool flags (segment starts) + (N,) uint32 → inclusive
     segmented XOR scan. At each segment's last row the value is the
@@ -185,6 +206,29 @@ def segmented_xor_scan_pallas(flags, values_u32, interpret: bool = False):
     with jax.enable_x64(False):
         _, out = _xor_scan_blocks(*planes, interpret=interpret)
     return out.reshape(-1)[:n]
+
+
+def segmented_sum_scan_pallas(flags, values_u64, interpret: bool = False):
+    """Drop-in for `crdt_merge.segmented_sum_scan`: (N,) bool flags
+    (segment starts) + uint64 values → inclusive segmented sum, one HBM
+    pass. The u64⇄u32 limb split runs in XLA around the kernel; exact
+    for the PN-counter fold's non-negative partial sums (< 2^55)."""
+    if not PALLAS_AVAILABLE:
+        raise UnknownError("pallas is unavailable in this jax build")
+    n = flags.shape[0]
+    tile = _BLOCK_ROWS * _LANES
+    padded = -(-max(n, 1) // tile) * tile
+    pad = padded - n
+    f = jnp.pad(flags.astype(jnp.uint32), (0, pad))
+    v = jnp.asarray(values_u64, jnp.uint64)
+    vh = jnp.pad((v >> jnp.uint64(32)).astype(jnp.uint32), (0, pad))
+    vl = jnp.pad(v.astype(jnp.uint32), (0, pad))
+    planes = [a.reshape(padded // _LANES, _LANES) for a in (f, vh, vl)]
+    with jax.enable_x64(False):
+        _, oh, ol = _sum_scan_blocks(*planes, interpret=interpret)
+    return (
+        oh.reshape(-1)[:n].astype(jnp.uint64) << jnp.uint64(32)
+    ) | ol.reshape(-1)[:n].astype(jnp.uint64)
 
 
 def segmented_max_scan_pallas(flags, k1, k2, reverse: bool = False,

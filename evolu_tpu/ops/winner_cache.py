@@ -26,6 +26,18 @@ Coherence contract:
   ordered by numeric keys (reference semantics are raw-string order);
   such batches fall back to the host oracle planner and every touched
   cell is invalidated, mirroring `merge._host_fallback`.
+- TYPED cells (CRDT column types, core/crdt_types.py) keep the slot ==
+  MAX(timestamp) invariant unchanged — the xor/Merkle algebra the slot
+  feeds is timestamp-only and type-agnostic. What differs is the slot's
+  MEANING: for an LWW cell the slot's timestamp is also the app-table
+  winner; for a typed cell the app value is merge STATE (__crdt_* fold,
+  materialized by storage.apply) and the slot is only the xor gate.
+  Invalidation per type: LWW invalidation rules apply verbatim; typed
+  merge state never lives in HBM (it lives in SQLite inside the apply
+  transaction), so typed state reset/rollback needs no extra cache
+  hook — the existing transaction-failure reset already covers the
+  shared slots. Contract test: tests/test_crdt_types.py pins slot ==
+  MAX(timestamp) while the app value is the fold, per type.
 - A SECOND connection writing the same database (SyncLock contemplates
   cross-process workers) would silently strand stale winners; every
   `plan_batch` therefore probes `PRAGMA data_version` — which moves

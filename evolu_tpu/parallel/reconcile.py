@@ -74,6 +74,34 @@ _OWNER_BITS, _CELL_BITS = 12, 25
 _PAD_OWNER = (1 << _OWNER_BITS) - 1
 
 
+def pack_owner_cell_key(owner_ix, cell_id, idx, lo_bits: int = 2, lo=None):
+    """ONE copy of the packed-owner i64 sort-key layout:
+    owner(12) | cell(25) | idx(24) | lo(lo_bits). Shared by the LWW
+    shard kernel (lo_bits=2 stored-winner flag bits) and the typed
+    CRDT fold kernels (`ops.crdt_merge.counter_shard_sums_core`,
+    lo_bits=0 — the sum monoid needs no flags), so the (owner, cell)
+    grouping contract can never drift between them. Padding rows
+    (cell_id == _PAD_CELL) take the _PAD_OWNER sentinel and sort last.
+    Traceable; raises at trace time outside enable_x64(True)."""
+    own = jnp.where(
+        cell_id == _PAD_CELL, jnp.int64(_PAD_OWNER), owner_ix.astype(jnp.int64)
+    )
+    key = (
+        (own << jnp.int64(_CELL_BITS + 24 + lo_bits))
+        | ((cell_id.astype(jnp.int64) & jnp.int64((1 << _CELL_BITS) - 1))
+           << jnp.int64(24 + lo_bits))
+        | (idx.astype(jnp.int64) << jnp.int64(lo_bits))
+    )
+    if lo is not None:
+        key = key | lo
+    if key.dtype != jnp.dtype("int64"):  # x64 disabled: would mis-plan
+        raise TypeError(
+            "pack_owner_cell_key must be traced under enable_x64(True): "
+            f"packed key degraded to {key.dtype}"
+        )
+    return key
+
+
 def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
     """Per-shard reconcile: LWW plan + (owner, minute) XOR deltas +
     shard digest. All inputs are this shard's local (S,) slices.
@@ -93,22 +121,10 @@ def _shard_kernel(cell_id, k1, k2, ex_k1, ex_k2, owner_ix):
         return _shard_kernel_wide(cell_id, k1, k2, ex_k1, ex_k2, owner_ix)
     idx = jnp.arange(n, dtype=jnp.int32)
     a, b = winner_flags(k1, k2, ex_k1, ex_k2)
-    own = jnp.where(
-        cell_id == _PAD_CELL, jnp.int64(_PAD_OWNER), owner_ix.astype(jnp.int64)
+    key = pack_owner_cell_key(
+        owner_ix, cell_id, idx, lo_bits=2,
+        lo=(b.astype(jnp.int64) << jnp.int64(1)) | a.astype(jnp.int64),
     )
-    key = (
-        (own << jnp.int64(_CELL_BITS + 26))
-        | ((cell_id.astype(jnp.int64) & jnp.int64((1 << _CELL_BITS) - 1))
-           << jnp.int64(26))
-        | (idx.astype(jnp.int64) << jnp.int64(2))
-        | (b.astype(jnp.int64) << jnp.int64(1))
-        | a.astype(jnp.int64)
-    )
-    if key.dtype != jnp.dtype("int64"):  # x64 disabled: would mis-plan
-        raise TypeError(
-            "_shard_kernel must be traced under enable_x64(True): "
-            f"packed key degraded to {key.dtype}"
-        )
     key_s, s1, s2 = jax.lax.sort((key, k1, k2), num_keys=1, is_stable=False)
     owner_s = (key_s >> jnp.int64(_CELL_BITS + 26)).astype(jnp.int32)
     i_s = ((key_s >> jnp.int64(2)) & jnp.int64((1 << 24) - 1)).astype(jnp.int32)

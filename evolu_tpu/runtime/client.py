@@ -245,6 +245,53 @@ class Evolu:
             self._flush_mutations()
         return row_id
 
+    # -- typed-column mutations (CRDT types beyond LWW, ISSUE 7) --
+
+    def _mutate_raw(self, messages: List[NewCrdtMessage]) -> None:
+        """Queue raw op messages through the same batch machinery as
+        `mutate` (no common-column side writes — a typed op is ONE
+        message on ONE cell)."""
+        b = self._batch_state()
+        b.pending.extend(messages)
+        if b.depth == 0:
+            self._flush_mutations()
+
+    def increment(self, table: str, row_id: str, column: str, delta: int) -> None:
+        """PN-counter op: add `delta` (may be negative) to a
+        `"<column>:counter"` cell. The materialized cell value is the
+        sum over all distinct ops across every replica."""
+        from evolu_tpu.core.crdt_types import counter_delta
+
+        self._mutate_raw([NewCrdtMessage(table, row_id, column, counter_delta(delta))])
+
+    def set_add(self, table: str, row_id: str, column: str, elem) -> None:
+        """AW-set add op for a `"<column>:awset"` cell. The op's own
+        timestamp becomes its unique add tag."""
+        from evolu_tpu.core.crdt_types import set_add_value
+
+        self._mutate_raw([NewCrdtMessage(table, row_id, column, set_add_value(elem))])
+
+    def set_remove(self, table: str, row_id: str, column: str, elem,
+                   observed: Optional[Sequence[str]] = None) -> None:
+        """AW-set observed-remove op: kills exactly the add tags this
+        replica has APPLIED for (cell, elem). The worker queue is
+        drained first so a just-queued same-replica `set_add` is
+        covered — without the drain, add-then-remove on one replica
+        would read an empty observation and silently remove nothing
+        (the add's tag, unobserved, survives by add-wins). A concurrent
+        add from ANOTHER replica this one has not synced still survives
+        (add wins). Adds queued in a still-open `batching()` block are
+        not yet stamped (no tag exists to observe) — close the batch
+        first. Pass `observed` explicitly to skip the read."""
+        from evolu_tpu.core.crdt_types import observed_tags, set_remove_value
+
+        if observed is None:
+            self.worker.flush()
+            observed = observed_tags(self.db, table, row_id, column, elem)
+        self._mutate_raw([
+            NewCrdtMessage(table, row_id, column, set_remove_value(elem, observed))
+        ])
+
     def create(self, table: str, values: Dict[str, object], on_complete=None) -> str:
         values = dict(values)
         values.pop("id", None)

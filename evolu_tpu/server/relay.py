@@ -387,6 +387,23 @@ class _Handler(BaseHTTPRequestHandler):
     scheduler = None  # SyncScheduler when continuous batching is on
     replication = None  # ReplicationManager when the relay has peers
     fleet = None  # FleetManager when the relay is an owner-sharded fleet member
+    # Capabilities this relay echoes back (intersected with the
+    # request's advertised set — sync/protocol.py capability
+    # extension). A request with no capabilities gets the v1 wire,
+    # byte-identical.
+    capabilities = protocol.KNOWN_CAPABILITIES
+
+    def _negotiate_caps(self, request: "protocol.SyncRequest", out: bytes) -> bytes:
+        """Append the negotiated capability fields to an encoded sync
+        response — AFTER the serve path (fused C wire bytes or object
+        path alike; proto3 field order is free). Only fires when the
+        client advertised, so capability-less peers round-trip
+        byte-identically."""
+        caps = tuple(c for c in request.capabilities if c in self.capabilities)
+        if not caps:
+            return out
+        metrics.inc("evolu_crdt_capability_negotiations_total")
+        return out + protocol.encode_response_capabilities(caps)
 
     def log_message(self, format: str, *args) -> None:
         # Target-gated like every other runtime signal (config.log):
@@ -596,6 +613,7 @@ class _Handler(BaseHTTPRequestHandler):
             # Debounced write hint: fresh rows should reach peer relays
             # at gossip-debounce latency, not interval latency.
             self.replication.hint()
+        out = self._negotiate_caps(request, out)
         metrics.observe("evolu_relay_response_bytes", len(out),
                         buckets=metrics.SIZE_BUCKETS)
         self._respond(200, out, "application/octet-stream")
@@ -759,7 +777,8 @@ class _Handler(BaseHTTPRequestHandler):
                     return  # 503 backpressure already answered
                 if self.replication is not None and request.messages:
                     self.replication.hint()
-                self._respond(200, out, "application/octet-stream")
+                self._respond(200, self._negotiate_caps(request, out),
+                              "application/octet-stream")
                 return
             # /fleet/reload is a control-plane MUTATION on the
             # client-facing port: with EVOLU_FLEET_RELOAD_TOKEN set,
@@ -843,8 +862,15 @@ class RelayServer:
                  replication_interval_s: float = 30.0,
                  bootstrap_lag_owners: Optional[int] = None,
                  checkpoint_interval_s: Optional[float] = None,
-                 checkpoint_path: Optional[str] = None):
+                 checkpoint_path: Optional[str] = None,
+                 capabilities: Optional[Sequence[str]] = None):
         self.store = store or RelayStore()
+        # capabilities=() emulates a v1 peer (never echoes the
+        # extension — tests pin the byte-identical fallback with it).
+        self.capabilities = (
+            protocol.KNOWN_CAPABILITIES if capabilities is None
+            else tuple(capabilities)
+        )
         self.scheduler = scheduler
         if batching and scheduler is None:
             from evolu_tpu.server.scheduler import SyncScheduler
@@ -882,7 +908,8 @@ class RelayServer:
         self._handler_cls = type(
             "BoundHandler", (_Handler,),
             {"store": self.store, "scheduler": self.scheduler,
-             "replication": self.replication},
+             "replication": self.replication,
+             "capabilities": self.capabilities},
         )
         self._httpd = _RelayHTTPServer((host, port), self._handler_cls)
         self._thread: Optional[threading.Thread] = None

@@ -66,14 +66,22 @@ def apply_messages_sequential(
     On the C++ backend the whole loop (winner check, upsert, insert)
     runs as one native call returning the XOR mask; on the Python
     backend it is O(n) SQL round trips."""
-    use_native = hasattr(db, "apply_sequential") and not any(
+    from evolu_tpu.core.crdt_types import apply_typed_ops, load_schema
+
+    schema = load_schema(db)
+    typed = (
+        [m for m in messages if schema.is_typed(m.table, m.column)]
+        if schema else []
+    )
+    use_native = hasattr(db, "apply_sequential") and not typed and not any(
         "\x00" in m.timestamp or "\x00" in m.table or "\x00" in m.row
         or "\x00" in m.column
         for m in messages
     )  # the C path's char* ABI is NUL-terminated (binds AND winner
     # compares); NUL-bearing wire fields must take the Python loop to
     # bind full bytes like the reference (the batched production path
-    # is NUL-exact natively).
+    # is NUL-exact natively). Typed batches take the Python loop too:
+    # the native loop would LWW-upsert raw op values into app tables.
     if use_native:
         xor_mask = db.apply_sequential(messages)
         for m, flagged in zip(messages, xor_mask):
@@ -82,10 +90,18 @@ def apply_messages_sequential(
                     timestamp_from_string(m.timestamp), merkle_tree
                 )
         return merkle_tree
+    if typed:
+        # Fold + materialize BEFORE the loop inserts any __message row:
+        # the dedup screen must observe pre-batch state (same contract
+        # as the batched path). xor/insert semantics below stay the
+        # reference's, timestamp-only.
+        apply_typed_ops(db, schema, typed)
     for m in messages:
         rows = db.exec_sql_query(_SELECT_WINNER, (m.table, m.row, m.column))
         t = rows[0]["timestamp"] if rows else None
-        if t is None or t < m.timestamp:
+        if (t is None or t < m.timestamp) and not (
+            schema and schema.is_typed(m.table, m.column)
+        ):
             db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
         if t is None or t != m.timestamp:
             db.run(_INSERT_MESSAGE, (m.timestamp, m.table, m.row, m.column, m.value))
@@ -202,9 +218,21 @@ def _apply_in_txn(db, merkle_tree, messages, planner):
     runs the standard path, so behavior and error surfaces are
     identical either way (test-pinned)."""
     from evolu_tpu.core.packed import PackedReceive
+    from evolu_tpu.core.crdt_types import load_schema
     from evolu_tpu.obs import metrics
 
     if isinstance(messages, PackedReceive):
+        schema = load_schema(db)
+        if schema and schema.has_typed(messages.cells):
+            # Typed cells in a packed batch bounce to the object path
+            # BEFORE any side effect (the r5 packed-receive contract,
+            # extended): the packed C cell-apply would LWW-upsert raw
+            # op values, and the typed fold needs message objects.
+            metrics.inc("evolu_crdt_packed_bounces_total")
+            metrics.inc("evolu_apply_packed_bounces_total")
+            messages = messages.to_messages()
+            metrics.inc("evolu_apply_batches_total", route="object")
+            return _apply_messages_in_txn(db, merkle_tree, messages, planner)
         plan_packed = getattr(planner, "plan_packed", None)
         if plan_packed is not None and hasattr(db, "apply_planned_cells"):
             plan = plan_packed(messages)
@@ -234,6 +262,22 @@ def _apply_messages_in_txn(db, merkle_tree, messages, planner):
     else:
         existing = {}  # the planner owns its winner source (HBM cache)
     plan = planner(messages, existing)
+    from evolu_tpu.core.crdt_types import apply_typed_ops, load_schema
+
+    schema = load_schema(db)
+    typed = (
+        [m for m in messages if schema.is_typed(m.table, m.column)]
+        if schema else []
+    )
+    if typed:
+        # Typed cells: fold new ops into merge state + materialize
+        # (BEFORE the __message insert below — the dedup screen reads
+        # pre-batch state), and strip their LWW upserts from whatever
+        # planner produced the plan (ONE copy: ops.merge).
+        from evolu_tpu.ops.merge import strip_typed_upserts
+
+        apply_typed_ops(db, schema, typed)
+        plan = strip_typed_upserts(plan, messages, schema)
     if len(plan) == 3:
         # Device planner: masks AND per-minute Merkle deltas in one
         # dispatch (no per-message Python hashing).

@@ -63,21 +63,43 @@ def get_existing_tables(db: PySqliteDatabase) -> Set[str]:
 
 def update_db_schema(db: PySqliteDatabase, table_definitions: Iterable[TableDefinition]) -> None:
     """Add-only migration (updateDbSchema.ts:85-103): CREATE missing
-    tables (id TEXT PRIMARY KEY + BLOB columns) or ALTER ... ADD COLUMN."""
+    tables (id TEXT PRIMARY KEY + BLOB columns) or ALTER ... ADD COLUMN.
+
+    CRDT column types (ISSUE 7): a column may be declared with a type
+    suffix — `"votes:counter"`, `"tags:awset"` — which strips off for
+    the DDL (the stored column is a plain BLOB-affinity column holding
+    the MATERIALIZED value) and persists into the `__crdt_schema`
+    registry that routes merge semantics (core/crdt_types.py)."""
+    from evolu_tpu.core.crdt_types import declare_column_types, parse_column_spec
+
     existing = get_existing_tables(db)
+    declarations = []
     for td in table_definitions:
+        parsed = [parse_column_spec(c) for c in td.columns]
+        declarations.extend(
+            (td.name, name, ctype) for name, ctype in parsed if ctype != "lww"
+        )
+        names = [name for name, _ in parsed]
         if td.name in existing:
             have = {r["name"] for r in db.exec_sql_query(f"PRAGMA table_info ({quote_ident(td.name)})")}
-            for col in td.columns:
+            for col in names:
                 if col not in have:
                     db.run(f"ALTER TABLE {quote_ident(td.name)} ADD COLUMN {quote_ident(col)} BLOB")
         else:
-            cols = ", ".join(f"{quote_ident(c)} BLOB" for c in td.columns)
+            cols = ", ".join(f"{quote_ident(c)} BLOB" for c in names)
             db.exec(f'CREATE TABLE {quote_ident(td.name)} ("id" TEXT PRIMARY KEY, {cols})')
+    if declarations:
+        declare_column_types(db, declarations)
 
 
 def delete_all_tables(db: PySqliteDatabase) -> None:
-    """DROP every table (deleteAllTables.ts:6-25)."""
+    """DROP every table (deleteAllTables.ts:6-25) — including the
+    `__crdt_*` schema/state tables, whose per-connection cache must
+    drop with them (a stale typed registry after resetOwner would
+    route merges for tables that no longer exist)."""
+    from evolu_tpu.core.crdt_types import invalidate_schema_cache
+
     rows = db.exec_sql_query("SELECT \"name\" FROM sqlite_schema WHERE type='table'")
     for r in rows:
         db.exec(f"DROP TABLE {quote_ident(r['name'])}")
+    invalidate_schema_cache(db)

@@ -104,6 +104,13 @@ class SyncTransport:
         # connection failure on the learned URL (fail back to the
         # configured relay before declaring offline).
         self._routes: dict = {}
+        # Negotiated wire capabilities per relay URL (sync/protocol.py
+        # capability extension): what the LAST response from that relay
+        # echoed back from our advertised set. Empty/absent = a v1 peer
+        # — typed CRDT traffic still relays byte-identically (ops are
+        # E2EE-opaque), this is the app's signal that the fleet
+        # understands them.
+        self.negotiated_capabilities: dict = {}
         # Reconnect probing state (db.ts:390-412 analog): offline is
         # entered by a swallowed fetch error, left by the first probe
         # success or successful round — either fires on_reconnect.
@@ -269,6 +276,12 @@ class SyncTransport:
                 body = protocol.encode_sync_request(
                     protocol.SyncRequest(encrypted, request.owner.id, node_id, request.merkle_tree)
                 )
+            caps = tuple(self.config.sync_capabilities or ())
+            if caps:
+                # Advertise as appended field-5 bytes: identical on the
+                # fused C and pure encode paths, absent (v1 wire,
+                # byte-identical) when the config advertises nothing.
+                body = body + protocol.encode_request_capabilities(caps)
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))
             return None
@@ -333,6 +346,16 @@ class SyncTransport:
                 self._note_offline()
                 return None
         self._note_online()
+        if caps:
+            try:
+                negotiated = protocol.scan_sync_response_capabilities(response_bytes)
+            except ValueError:
+                negotiated = ()  # decode error surfaces below, on the real decoder
+            self.negotiated_capabilities[url] = negotiated
+            metrics.set_gauge(
+                "evolu_crdt_capability_negotiated",
+                1 if protocol.CAP_CRDT_TYPES in negotiated else 0,
+            )
         try:
             from evolu_tpu.sync import native_crypto
 

@@ -249,6 +249,24 @@ def decode_encrypted_message(data: bytes) -> EncryptedCrdtMessage:
 
 
 # --- SyncRequest (proto:20-25) / SyncResponse (proto:27-30) ---
+#
+# Capability extension (ISSUE 7 — CRDT column types): SyncRequest
+# field 5 / SyncResponse field 3 carry repeated capability-name
+# strings. Negotiation is advisory, not a format fork: typed CRDT ops
+# ride the existing E2EE-opaque message stream (a relay never
+# interprets values), so a peer that doesn't speak the capability
+# still relays typed traffic byte-identically. The fields are emitted
+# ONLY when non-empty, so the capability-less wire stays byte-for-byte
+# the v1 wire (protoc-fixture-pinned); an unknown-capability peer's
+# decoder skips the field (proto3 unknown-field rule — the fused C
+# parsers already do, native/evolu_crypto.cpp:510). A relay answers
+# with the INTERSECTION of the request's capabilities and its own, so
+# a client can tell whether its fleet understands typed snapshots and
+# surface it (sync/client.py records the negotiated set per relay).
+
+CAP_CRDT_TYPES = "crdt-types-v1"
+KNOWN_CAPABILITIES = (CAP_CRDT_TYPES,)
+_MAX_CAPABILITIES = 64  # decode bound: a hostile body must not mint unbounded strings
 
 
 @dataclass(frozen=True)
@@ -257,23 +275,46 @@ class SyncRequest:
     user_id: str
     node_id: str
     merkle_tree: str
+    capabilities: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
 class SyncResponse:
     messages: Tuple[EncryptedCrdtMessage, ...]
     merkle_tree: str
+    capabilities: Tuple[str, ...] = ()
+
+
+def encode_request_capabilities(capabilities: Tuple[str, ...]) -> bytes:
+    """SyncRequest field-5 bytes — appendable to an already-encoded
+    request body (proto3 field order is free), which is how the fused C
+    wire path gains the extension without touching the C encoder."""
+    return b"".join(_string(5, c) for c in capabilities)
+
+
+def encode_response_capabilities(capabilities: Tuple[str, ...]) -> bytes:
+    """SyncResponse field-3 bytes — appended by the relay AFTER the
+    serve path produced the response (fused C or object path alike)."""
+    return b"".join(_string(3, c) for c in capabilities)
+
+
+def _decode_capability(v, caps: List[str]) -> None:
+    if len(caps) >= _MAX_CAPABILITIES:
+        raise ValueError("too many capability entries")
+    caps.append(v.decode("utf-8"))
 
 
 def encode_sync_request(r: SyncRequest) -> bytes:
     out = b"".join(_len_delimited(1, encode_encrypted_message(m)) for m in r.messages)
-    return out + _string(2, r.user_id) + _string(3, r.node_id) + _string(4, r.merkle_tree)
+    out += _string(2, r.user_id) + _string(3, r.node_id) + _string(4, r.merkle_tree)
+    return out + encode_request_capabilities(r.capabilities)
 
 
 @_wire_decoder
 def decode_sync_request(data: bytes) -> SyncRequest:
     messages: List[EncryptedCrdtMessage] = []
     user_id = node_id = merkle_tree = ""
+    capabilities: List[str] = []
     pos = 0
     while pos < len(data):
         num, wt, v, pos = _read_field(data, pos)
@@ -285,12 +326,31 @@ def decode_sync_request(data: bytes) -> SyncRequest:
             node_id = v.decode("utf-8")
         elif num == 4:
             merkle_tree = v.decode("utf-8")
-    return SyncRequest(tuple(messages), user_id, node_id, merkle_tree)
+        elif num == 5:
+            _decode_capability(v, capabilities)
+    return SyncRequest(tuple(messages), user_id, node_id, merkle_tree,
+                       tuple(capabilities))
 
 
 def encode_sync_response(r: SyncResponse) -> bytes:
     out = b"".join(_len_delimited(1, encode_encrypted_message(m)) for m in r.messages)
-    return out + _string(2, r.merkle_tree)
+    return out + _string(2, r.merkle_tree) + encode_response_capabilities(r.capabilities)
+
+
+@_wire_decoder
+def scan_sync_response_capabilities(data: bytes) -> Tuple[str, ...]:
+    """Top-level walk collecting ONLY field-3 capability strings — the
+    client calls this on the raw response bytes before the fused C
+    decrypt paths (which skip the field), so negotiation works
+    identically on every receive route. ValueError-only like every
+    decoder here."""
+    caps: List[str] = []
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 3:
+            _decode_capability(v, caps)
+    return tuple(caps)
 
 
 # --- relay↔relay replication messages (extension — no reference
@@ -729,6 +789,7 @@ def decode_fleet_forward(data: bytes) -> FleetForward:
 def decode_sync_response(data: bytes) -> SyncResponse:
     messages: List[EncryptedCrdtMessage] = []
     merkle_tree = ""
+    capabilities: List[str] = []
     pos = 0
     while pos < len(data):
         num, wt, v, pos = _read_field(data, pos)
@@ -736,4 +797,6 @@ def decode_sync_response(data: bytes) -> SyncResponse:
             messages.append(decode_encrypted_message(v))
         elif num == 2:
             merkle_tree = v.decode("utf-8")
-    return SyncResponse(tuple(messages), merkle_tree)
+        elif num == 3:
+            _decode_capability(v, capabilities)
+    return SyncResponse(tuple(messages), merkle_tree, tuple(capabilities))

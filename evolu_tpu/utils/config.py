@@ -55,6 +55,14 @@ class Config:
     # always relay verbatim, and reference-range traffic is
     # byte-identical either way.
     wire_extensions: bool = True
+    # Wire capabilities advertised in every sync request (field 5 —
+    # sync/protocol.py capability extension, ISSUE 7). The relay echoes
+    # the intersection with its own set; () sends the v1 wire
+    # byte-identically. Advisory: typed CRDT ops are E2EE-opaque and
+    # relay through v1 peers unchanged, so this only SURFACES fleet
+    # support (sync.client.SyncClient.negotiated_capabilities), it
+    # never gates traffic.
+    sync_capabilities: Tuple[str, ...] = ("crdt-types-v1",)
     # -- relay fleet knobs (no reference equivalent). These are LIVE
     # defaults: `RelayServer` / `ReplicationManager` resolve any
     # constructor arg left at None from the process `default_config`

@@ -380,3 +380,178 @@ def test_crash_mid_chunked_receive_restart_converges(tmp_path, seed, crash_at):
                 except Exception:  # noqa: BLE001,S110 - vic may already be disposed
                     pass
         server.stop()
+
+
+def test_mixed_crdt_workload_adversarial_clocks_two_relay_fleet():
+    """ISSUE 7 satellite (ROADMAP #5 small dose): LWW + PN-counter +
+    AW-set columns under regressing/stuttering HLC clocks through a
+    2-relay FLEET episode. Asserts byte-identical convergence of app
+    tables AND __crdt_* merge state, counter EXACTNESS (the materialized
+    value equals the sum of every acked increment), the AW-set add-wins
+    outcome for a concurrent add/remove pair, and the per-type
+    winner-cache contract on the device-backend replica."""
+    import numpy as np
+
+    from evolu_tpu.core import crdt_types as ct
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.utils.config import FleetConfig
+
+    seed = 20250804
+    rng = random.Random(seed)
+    base = int(time.time() * 1000)
+
+    def adversarial_now(sub_seed):
+        r = random.Random(sub_seed)
+        state = {"t": base}
+
+        def now():
+            roll = r.random()
+            if roll < 0.4:
+                pass  # stutter: frozen clock
+            elif roll < 0.6:
+                state["t"] = max(base - 20_000,
+                                 state["t"] - r.randrange(0, 10_000))
+            else:
+                state["t"] += r.randrange(1, 400)
+            return state["t"]
+
+        return now
+
+    schema = {"todo": ("title", "isCompleted"),
+              "metrics": ("name", "clicks:counter", "tags:awset")}
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    b = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    fleet_cfg = FleetConfig(relays=(a.url, b.url), replication_factor=1,
+                            version=1)
+    a.enable_fleet(fleet_cfg)
+    b.enable_fleet(fleet_cfg)
+    replicas = []
+    errors = []
+    try:
+        r1 = create_evolu(schema, config=Config(sync_url=a.url, backend="tpu"))
+        r2 = create_evolu(schema, config=Config(sync_url=b.url, backend="cpu"),
+                          mnemonic=r1.owner.mnemonic)
+        replicas = [r1, r2]
+        for i, r in enumerate(replicas):
+            r.worker.now = adversarial_now(seed + i)
+            r.subscribe_error(errors.append)
+            connect(r)
+        counter_rows = []
+        expected_sum = {}
+        for r in replicas:
+            rid = r.create("metrics", {"name": f"m-{id(r)}"})
+            r.worker.flush()
+            counter_rows.append(rid)
+            expected_sum[rid] = 0
+        lww_rows = []
+        for step in range(70):
+            r = rng.choice(replicas)
+            roll = rng.random()
+            if roll < 0.25 or not lww_rows:
+                lww_rows.append(r.create("todo", {
+                    "title": f"t{step}", "isCompleted": False}))
+            elif roll < 0.40:
+                r.update("todo", rng.choice(lww_rows), {
+                    "title": f"e{step}",
+                    "isCompleted": bool(rng.getrandbits(1))})
+            elif roll < 0.70:
+                rid = rng.choice(counter_rows)
+                d = rng.randrange(-50, 51)
+                r.increment("metrics", rid, "clicks", d)
+                expected_sum[rid] += d
+            elif roll < 0.85:
+                r.set_add("metrics", rng.choice(counter_rows), "tags",
+                          rng.choice("abcd"))
+            else:
+                rid = rng.choice(counter_rows)
+                elem = rng.choice("abcd")
+                r.set_remove("metrics", rid, "tags", elem)
+            r.worker.flush()
+            if rng.random() < 0.5:
+                s = rng.choice(replicas)
+                s.sync()
+                s.worker.flush()
+        _converge(replicas)
+
+        # Concurrent add/remove → ADD WINS: both replicas know tag T1;
+        # r2 removes (observing only T1) while r1 concurrently re-adds.
+        aw_row = counter_rows[0]
+        r1.set_add("metrics", aw_row, "tags", "awinner")
+        r1.worker.flush()
+        _converge(replicas)
+        r2.set_remove("metrics", aw_row, "tags", "awinner")  # observes T1 only
+        r1.set_add("metrics", aw_row, "tags", "awinner")     # concurrent T2
+        r1.worker.flush()
+        r2.worker.flush()
+        _converge(replicas)
+        for r in replicas:
+            r._transport.flush()
+            r.worker.flush()
+
+        # The only tolerated errors are the livelock SyncError guard
+        # (redelivery quirk, reference semantics) — a drift/overflow
+        # error would mean an increment was NOT acked.
+        from evolu_tpu.core.types import SyncError
+        real = [e for e in errors if not isinstance(e, SyncError)]
+        assert not real, real
+
+        dumps = []
+        for r in replicas:
+            dumps.append((
+                r.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"'),
+                r.db.exec('SELECT * FROM "todo" ORDER BY "id"'),
+                r.db.exec('SELECT * FROM "metrics" ORDER BY "id"'),
+                r.db.exec('SELECT * FROM "__crdt_counter" ORDER BY "row","column"'),
+                r.db.exec('SELECT * FROM "__crdt_set" ORDER BY "tag"'),
+                r.db.exec('SELECT * FROM "__crdt_kill" ORDER BY "tag"'),
+            ))
+        assert dumps[0] == dumps[1], "typed state diverged under adversarial clocks"
+
+        # Counter EXACTNESS: materialized value == sum of acked increments.
+        for rid, total in expected_sum.items():
+            got = r1.db.exec_sql_query(
+                'SELECT "clicks" FROM "metrics" WHERE "id" = ?', (rid,)
+            )[0]["clicks"]
+            assert got == total, (rid, got, total)
+
+        # Add-wins outcome: the concurrently re-added element survives.
+        tags = r1.db.exec_sql_query(
+            'SELECT "tags" FROM "metrics" WHERE "id" = ?', (aw_row,))[0]["tags"]
+        assert '"awinner"' in tags, tags
+
+        # Fold integrity: rebuilding state from the full log is a no-op.
+        schema_r1 = ct.load_schema(r1.db)
+        before = r1.db.exec('SELECT * FROM "__crdt_set" ORDER BY "tag"')
+        ct.rebuild_state(r1.db, schema_r1)
+        assert r1.db.exec('SELECT * FROM "__crdt_set" ORDER BY "tag"') == before
+
+        # Winner-cache contract per type on the device replica: slot ==
+        # MAX(timestamp) for LWW and typed cells alike (the xor gate),
+        # while typed app values are the fold (asserted above).
+        cache = r1.worker._planner.cache
+        w1 = np.asarray(cache._w1)
+        w2 = np.asarray(cache._w2)
+        typed_checked = 0
+        for (table, row, col), slot in cache._slots.items():
+            got = r1.db.exec_sql_query(
+                'SELECT MAX("timestamp") AS m FROM "__message" '
+                'WHERE "table" = ? AND "row" = ? AND "column" = ?',
+                (table, row, col))[0]["m"]
+            k1, k2 = int(w1[slot]), int(w2[slot])
+            if k1 == 0 and k2 == 0:
+                assert got is None, (table, row, col)
+                continue
+            cached_ts = timestamp_to_string(
+                Timestamp(k1 >> 16, k1 & 0xFFFF, f"{k2:016x}"))
+            assert cached_ts == got, (table, row, col)
+            if schema_r1.is_typed(table, col):
+                typed_checked += 1
+        # A livelock reset can legitimately empty the cache; the
+        # schedule must merely have engaged it (same tolerance as the
+        # adversarial-clock fleet test above).
+        assert cache._slots or typed_checked == 0
+    finally:
+        for r in replicas:
+            r.dispose()
+        a.stop()
+        b.stop()

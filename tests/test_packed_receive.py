@@ -363,3 +363,74 @@ def test_worker_receive_packed_equals_objects():
     assert s_obj == s_pk
     assert s_obj[0], "no rows applied — the receive leg never ran"
     assert k_obj == k_pk
+
+
+@pytest.mark.skipif(not native_available(), reason="native host unavailable")
+def test_packed_typed_cells_bounce_before_side_effects():
+    """ISSUE 7 satellite: ANY typed cell in a packed batch routes to
+    the object path BEFORE side effects (the r5 packed-receive
+    contract extended to CRDT column types) — the packed C cell-apply
+    would LWW-upsert raw op values, and the typed fold needs message
+    objects. Pinned: plan_packed is NEVER consulted, the bounce
+    counter moves, and the end state equals the pure object path."""
+    from evolu_tpu.core import crdt_types as ct
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.runtime.worker import select_planner
+    from evolu_tpu.storage.schema import update_db_schema
+    from evolu_tpu.core.types import TableDefinition
+
+    rng = random.Random(21)
+    base = 1_700_000_000_000
+    msgs = []
+    for i in range(300):
+        ts = timestamp_to_string(
+            Timestamp(base + i * 977, i % 3, "a1b2c3d4e5f60718"))
+        roll = rng.random()
+        row = f"row{rng.randrange(20)}"
+        if roll < 0.4:
+            msgs.append(CrdtMessage(ts, "todo", row, "votes",
+                                    rng.randrange(-9, 10)))
+        elif roll < 0.6:
+            msgs.append(CrdtMessage(ts, "todo", row, "labels",
+                                    ct.set_add_value(rng.choice("xyz"))))
+        else:
+            msgs.append(CrdtMessage(ts, "todo", row, "title", f"t{i}"))
+    resp = _response_bytes(msgs)
+    pb, _tree = native_crypto.decrypt_response_columns(resp, MN)
+    assert pb is not None
+
+    def mkdb():
+        db = open_database(backend="auto")
+        init_db_model(db, mnemonic=None)
+        update_db_schema(db, [TableDefinition.of(
+            "todo", ("title", "votes:counter", "labels:awset"))])
+        return db
+
+    def dump(db):
+        return (
+            db.exec_sql_query(
+                'SELECT * FROM "__message" ORDER BY "timestamp","table","row","column"',
+                (),
+            ),
+            db.exec_sql_query('SELECT * FROM "todo" ORDER BY "id"', ()),
+            db.exec_sql_query('SELECT * FROM "__crdt_counter" ORDER BY "row","column"', ()),
+            db.exec_sql_query('SELECT * FROM "__crdt_set" ORDER BY "tag"', ()),
+        )
+
+    results = {}
+    for mode in ("objects", "packed"):
+        db = mkdb()
+        planner = select_planner(Config(min_device_batch=64), db)
+        calls = []
+        orig = planner.plan_packed
+        planner.plan_packed = lambda p: (calls.append(1), orig(p))[1]
+        before = metrics.get_counter("evolu_crdt_packed_bounces_total")
+        batch = tuple(msgs) if mode == "objects" else pb
+        tree = apply_messages(db, {}, batch, planner=planner)
+        if mode == "packed":
+            assert not calls, "plan_packed ran on a typed batch"
+            assert metrics.get_counter(
+                "evolu_crdt_packed_bounces_total") == before + 1
+        results[mode] = (dump(db), tree)
+        db.close()
+    assert results["objects"] == results["packed"]

@@ -165,6 +165,7 @@ def test_decoder_fuzz_typed_errors():
         protocol.decode_sync_response,
         protocol.decode_encrypted_message,
         protocol.decode_content,
+        protocol.scan_sync_response_capabilities,
     )
     for _ in range(1500):
         blob = rng.randbytes(rng.randrange(0, 120))
@@ -781,3 +782,75 @@ def test_our_encoder_never_emits_field5_for_floats():
     assert protocol.decode_content(data)[3] == 3.5
     with pytest.raises(TypeError):
         protocol.encode_content("t", "r", "c", 3.5, extensions=False)
+
+
+def test_capability_extension_codec_and_v1_byte_identity():
+    """ISSUE 7: the capability extension (SyncRequest field 5 /
+    SyncResponse field 3) round-trips, is bounded, and — crucially —
+    the capability-LESS wire is byte-for-byte the v1 wire, so a
+    reference peer and every pre-extension fixture stay untouched."""
+    req = protocol.SyncRequest((), "uid", "node", "{}")
+    b0 = protocol.encode_sync_request(req)
+    # No capabilities => no field 5 anywhere (v1 bytes).
+    assert protocol.encode_request_capabilities(()) == b""
+    assert protocol.decode_sync_request(b0).capabilities == ()
+    caps = (protocol.CAP_CRDT_TYPES, "future-cap")
+    b1 = protocol.encode_sync_request(
+        protocol.SyncRequest((), "uid", "node", "{}", caps))
+    assert b1 == b0 + protocol.encode_request_capabilities(caps)
+    assert protocol.decode_sync_request(b1).capabilities == caps
+    # Appending to an externally-encoded body (the fused C path's
+    # route) decodes identically.
+    assert protocol.decode_sync_request(
+        b0 + protocol.encode_request_capabilities(caps)).capabilities == caps
+
+    resp = protocol.SyncResponse((), '{"t":1}')
+    r0 = protocol.encode_sync_response(resp)
+    r1 = protocol.encode_sync_response(
+        protocol.SyncResponse((), '{"t":1}', (protocol.CAP_CRDT_TYPES,)))
+    assert r1 == r0 + protocol.encode_response_capabilities(
+        (protocol.CAP_CRDT_TYPES,))
+    assert protocol.decode_sync_response(r0).capabilities == ()
+    assert protocol.scan_sync_response_capabilities(r0) == ()
+    assert protocol.scan_sync_response_capabilities(r1) == (
+        protocol.CAP_CRDT_TYPES,)
+    # Decode bound: a hostile body cannot mint unbounded strings.
+    flood = r0 + protocol.encode_response_capabilities(("x",) * 65)
+    with pytest.raises(ValueError):
+        protocol.decode_sync_response(flood)
+    with pytest.raises(ValueError):
+        protocol.scan_sync_response_capabilities(flood)
+    # Wire-type abuse stays ValueError (the decorator contract).
+    with pytest.raises(ValueError):
+        protocol.decode_sync_request(b0 + b"\x28\x05")  # field 5 as varint
+
+
+def test_capability_negotiation_v1_relay_fallback():
+    """An unknown-capability (v1) relay answers an advertising client
+    byte-identically to a capability-less exchange; a current relay
+    echoes the intersection appended AFTER the v1 response bytes."""
+    import urllib.request
+
+    from evolu_tpu.server.relay import RelayServer, RelayStore
+
+    def post(url, body):
+        r = urllib.request.urlopen(
+            urllib.request.Request(url, data=body, method="POST"))
+        return r.read()
+
+    body = protocol.encode_sync_request(
+        protocol.SyncRequest((), "ownerX", "node", "{}"))
+    adv = body + protocol.encode_request_capabilities(
+        (protocol.CAP_CRDT_TYPES, "not-a-real-cap"))
+    current = RelayServer(RelayStore()).start()
+    v1 = RelayServer(RelayStore(), capabilities=()).start()
+    try:
+        plain = post(current.url, body)
+        assert protocol.scan_sync_response_capabilities(plain) == ()
+        negotiated = post(current.url, adv)
+        assert negotiated == plain + protocol.encode_response_capabilities(
+            (protocol.CAP_CRDT_TYPES,))
+        assert post(v1.url, adv) == plain  # v1 fallback: byte-identical
+    finally:
+        current.stop()
+        v1.stop()
