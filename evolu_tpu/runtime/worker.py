@@ -621,10 +621,22 @@ class DbWorker:
         self.queries_rows_cache.clear()
         self.queries_raw_cache.clear()
 
+    def _drop_aead_sessions(self) -> None:
+        """Owner identity changed: drop the cached aead-batch-v1
+        session keys (sync/aead.py). Sessions are keyed by mnemonic so
+        a stale entry could never decrypt wrongly — this is retention
+        hygiene (no keys for retired identities) plus a fresh session
+        salt for whatever identity syncs next, mirroring the
+        winner-cache reset invariant on the same transitions."""
+        from evolu_tpu.sync import aead
+
+        aead.reset_sessions()
+
     def _reset_owner(self) -> None:
         """resetOwner.ts:7-21."""
         delete_all_tables(self.db)
         self._drop_winner_cache()
+        self._drop_aead_sessions()
         self._staged_effects.append(self._clear_query_caches)
         self._emit(msg.ReloadAllTabs())
 
@@ -633,6 +645,7 @@ class DbWorker:
         via the first sync against the relay (SURVEY.md §3.5)."""
         delete_all_tables(self.db)
         self._drop_winner_cache()
+        self._drop_aead_sessions()
         self._staged_effects.append(self._clear_query_caches)
         self.owner = init_db_model(self.db, mnemonic)
         self._emit(msg.ReloadAllTabs())

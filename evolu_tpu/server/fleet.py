@@ -48,8 +48,22 @@ storage per relay. This module composes the pieces into a fleet that
   anywhere degrades to incremental anti-entropy — never data loss.
 
 The relay stays E2EE-blind throughout; placement hashes opaque owner
-ids. Observability: the `evolu_fleet_*` families
-(docs/OBSERVABILITY.md) + a `fleet` section under `GET /stats`.
+ids. That blindness is also what makes the `aead-batch-v1` wire
+(docs/WIRE_V2.md) fleet-safe with NO code here: negotiation binds a
+(client, relay) pair per hop, relays never re-encrypt, and every fleet
+surface — hop-guarded forwards, scoped peer pulls, snapshot chunks,
+rebalance installs — carries stored ciphertext verbatim, so v1 and v2
+records cross the fleet identically. The one hop that matters is
+client→serving-relay: on a forward the SERVING relay computes the
+capability echo (it decodes the forwarded body, `relay._do_fleet_
+forward` → `_serve_request`), so a client talking through a
+forwarding front-end negotiates with the relay that actually stores
+its rows; on failover the client re-encodes v2 rounds as v1 itself
+(sync/client.py::retarget — a relay that didn't advertise never
+receives v2). Observability: the `evolu_fleet_*` families
+(docs/OBSERVABILITY.md) + a `fleet` section under `GET /stats`; the
+ingest wire-format mix shows up per serving relay as
+`evolu_crypto_v{1,2}_relay_messages_total`.
 
 `python -m evolu_tpu.server.fleet` runs one fleet relay process (the
 unit `benchmarks/fleet_scaling.py` multiplies into N-process fleets).

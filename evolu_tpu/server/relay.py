@@ -49,9 +49,26 @@ from evolu_tpu.core.timestamp import (
 )
 from evolu_tpu.core.types import NonCanonicalStoreError
 from evolu_tpu.storage.native import open_database
-from evolu_tpu.sync import protocol
+from evolu_tpu.sync import aead, protocol
 
 MAX_BODY_BYTES = 20 * 1024 * 1024  # index.ts:222
+
+
+def _count_ingest_mix(messages) -> None:
+    """Ingest wire-format observability (the relay stays E2EE-blind:
+    the 3-byte version magic is framing, not content). v2 records ride
+    the store/Merkle/replication paths as opaquely as v1 — these
+    counters are how an operator SEES the negotiated fleet actually
+    carrying v2 traffic. Call only on the SERVING relay, after any
+    fleet routing, so each message counts once fleet-wide."""
+    if not messages:
+        return
+    n_v2 = aead.count_v2(messages)
+    if n_v2:
+        metrics.inc("evolu_crypto_v2_relay_messages_total", n_v2)
+    if n_v2 < len(messages):
+        metrics.inc("evolu_crypto_v1_relay_messages_total",
+                    len(messages) - n_v2)
 
 
 def fetch_response_stream(db, user_id, node_id, server_tree, client_tree) -> bytes:
@@ -403,6 +420,12 @@ class _Handler(BaseHTTPRequestHandler):
         if not caps:
             return out
         metrics.inc("evolu_crdt_capability_negotiations_total")
+        for cap in caps:
+            # Per-capability negotiation counts (bounded label set: only
+            # capabilities WE serve ever reach here — never raw client
+            # strings). `aead-batch-v1` echoes are the relay-side signal
+            # that clients may start emitting v2 envelopes.
+            metrics.inc("evolu_crypto_capability_echoes_total", capability=cap)
         return out + protocol.encode_response_capabilities(caps)
 
     def log_message(self, format: str, *args) -> None:
@@ -597,6 +620,13 @@ class _Handler(BaseHTTPRequestHandler):
             out = self._serve_request(request)
             if out is None:
                 return  # 503 backpressure already answered
+            # Ingest-mix counters AFTER routing AND a successful
+            # serve: a 307'd/forwarded request never counts at a
+            # relay whose store it skips, and a 503-shed or errored
+            # round (retried by the client) never counts at all —
+            # each message counts once fleet-wide, at the relay that
+            # actually ingested it.
+            _count_ingest_mix(request.messages)
         except Exception as e:  # noqa: BLE001 - index.ts:231-233
             # The flight dump rides the exception (server-side only —
             # the wire response stays a bare 500, no event leakage).
@@ -775,6 +805,7 @@ class _Handler(BaseHTTPRequestHandler):
                 out = self._serve_request(request)
                 if out is None:
                     return  # 503 backpressure already answered
+                _count_ingest_mix(request.messages)
                 if self.replication is not None and request.messages:
                     self.replication.hint()
                 self._respond(200, self._negotiate_caps(request, out),

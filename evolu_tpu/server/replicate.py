@@ -65,7 +65,7 @@ from evolu_tpu.core.timestamp import (
     timestamp_to_string,
 )
 from evolu_tpu.obs import metrics
-from evolu_tpu.sync import protocol
+from evolu_tpu.sync import aead, protocol
 from evolu_tpu.utils.log import log
 
 # One pull POST covers at most this many owners — bounds request bodies
@@ -764,6 +764,14 @@ class ReplicationManager:
         per-request path handler threads use."""
         if not requests:
             return
+        n_v2 = sum(aead.count_v2(r.messages) for r in requests)
+        if n_v2:
+            # Peer pulls carry stored ciphertext verbatim — an
+            # aead-batch-v1 record replicates as opaquely as an OpenPGP
+            # one (never re-encrypted, never downgraded per hop). This
+            # counter is how an operator confirms v2 traffic actually
+            # crossing the replication surface (docs/OBSERVABILITY.md).
+            metrics.inc("evolu_crypto_v2_replicated_messages_total", n_v2)
         if self.scheduler is not None:
             futures = [
                 self._ingest_pool().submit(self.scheduler.submit, r) for r in requests

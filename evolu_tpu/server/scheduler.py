@@ -61,7 +61,7 @@ import time
 from typing import List, Optional
 
 from evolu_tpu.obs import metrics
-from evolu_tpu.sync import protocol
+from evolu_tpu.sync import aead, protocol
 from evolu_tpu.utils.log import log
 
 
@@ -107,7 +107,10 @@ def _batchable(request: protocol.SyncRequest) -> bool:
     batch (`engine._pack_rows` rejects batch-wide otherwise); anything
     else takes the per-request path, whose host oracle is the error
     surface. Hex-CASE anomalies at canonical width stay batchable —
-    the engine quarantines those owners to the host fold internally."""
+    the engine quarantines those owners to the host fold internally.
+    Message CONTENT never factors in: the relay is E2EE-blind, so an
+    aead-batch-v1 GCM record (sync/aead.py) batches exactly like an
+    OpenPGP one — the engine stores and re-serves either verbatim."""
     return all(len(m.timestamp) == 46 for m in request.messages)
 
 
@@ -295,6 +298,14 @@ class SyncScheduler:
             metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3)
             return
         metrics.inc("evolu_sched_coalesced_requests_total", len(batch))
+        n_v2 = sum(aead.count_v2(p.request.messages) for p in batch)
+        if n_v2:
+            # The fused engine pass just carried v2 ciphertext end to
+            # end (store + Merkle + response re-serve, all opaque) —
+            # the counter operators correlate with the relay-ingest
+            # mix to confirm negotiated traffic rides the BATCHED path,
+            # not the singleton fallback (docs/OBSERVABILITY.md).
+            metrics.inc("evolu_crypto_v2_batched_messages_total", n_v2)
         for p, out in zip(batch, outs):
             p.resolve(out)
         metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3)

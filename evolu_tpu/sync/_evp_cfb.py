@@ -21,7 +21,13 @@ import ctypes
 import ctypes.util
 
 
-def _load():
+def load_libcrypto(bind):
+    """Probe the candidate libcrypto sonames (images differ: 3 vs 1.1
+    vs a loader-path `crypto`) and return the first CDLL that `bind`
+    accepts — bind(lib) declares the caller's EVP prototypes and lets
+    AttributeError escape on a missing symbol. None when no candidate
+    loads+binds. Shared with `_evp_gcm` so the distro-specific probe
+    list lives in exactly one place."""
     names = ["libcrypto.so.3", "libcrypto.so.1.1", "libcrypto.so"]
     found = ctypes.util.find_library("crypto")
     if found:
@@ -29,33 +35,37 @@ def _load():
     for name in names:
         try:
             lib = ctypes.CDLL(name)
-            c = ctypes
-            lib.EVP_CIPHER_CTX_new.restype = c.c_void_p
-            lib.EVP_CIPHER_CTX_new.argtypes = []
-            lib.EVP_CIPHER_CTX_free.restype = None
-            lib.EVP_CIPHER_CTX_free.argtypes = [c.c_void_p]
-            for sym in ("EVP_aes_128_cfb128", "EVP_aes_192_cfb128",
-                        "EVP_aes_256_cfb128"):
-                fn = getattr(lib, sym)
-                fn.restype = c.c_void_p
-                fn.argtypes = []
-            lib.EVP_CipherInit_ex.restype = c.c_int
-            lib.EVP_CipherInit_ex.argtypes = [
-                c.c_void_p, c.c_void_p, c.c_void_p,
-                c.c_char_p, c.c_char_p, c.c_int,
-            ]
-            lib.EVP_CipherUpdate.restype = c.c_int
-            lib.EVP_CipherUpdate.argtypes = [
-                c.c_void_p, c.c_char_p, c.POINTER(c.c_int),
-                c.c_char_p, c.c_int,
-            ]
+            bind(lib)
             return lib
         except (OSError, AttributeError):
             continue
     return None
 
 
-_LIB = _load()
+def _bind_cfb(lib):
+    c = ctypes
+    lib.EVP_CIPHER_CTX_new.restype = c.c_void_p
+    lib.EVP_CIPHER_CTX_new.argtypes = []
+    lib.EVP_CIPHER_CTX_free.restype = None
+    lib.EVP_CIPHER_CTX_free.argtypes = [c.c_void_p]
+    for sym in ("EVP_aes_128_cfb128", "EVP_aes_192_cfb128",
+                "EVP_aes_256_cfb128"):
+        fn = getattr(lib, sym)
+        fn.restype = c.c_void_p
+        fn.argtypes = []
+    lib.EVP_CipherInit_ex.restype = c.c_int
+    lib.EVP_CipherInit_ex.argtypes = [
+        c.c_void_p, c.c_void_p, c.c_void_p,
+        c.c_char_p, c.c_char_p, c.c_int,
+    ]
+    lib.EVP_CipherUpdate.restype = c.c_int
+    lib.EVP_CipherUpdate.argtypes = [
+        c.c_void_p, c.c_char_p, c.POINTER(c.c_int),
+        c.c_char_p, c.c_int,
+    ]
+
+
+_LIB = load_libcrypto(_bind_cfb)
 # NB: a missing libcrypto is reported at first USE, not at import —
 # this module is imported unconditionally by the import-hygiene walk
 # (and speculatively by crypto.py's except branch), and must stay

@@ -60,6 +60,27 @@ def encrypt_messages(messages, mnemonic: str):
     return tuple(out)
 
 
+def encrypt_messages_v2(messages, mnemonic: str):
+    """The aead-batch-v1 twin of `encrypt_messages` (sync/aead.py):
+    session-keyed GCM records instead of per-message OpenPGP S2K. Only
+    the NEGOTIATED push path calls this — the pure loop here is the
+    fallback behind the fused C wire leg, and it raises exactly what
+    the v1 pure loop raises for unencodable values (encode_content owns
+    the TypeError surface in both)."""
+    from evolu_tpu.sync import aead
+
+    session = aead.get_session(mnemonic, records=len(messages))
+    out = []
+    for m in messages:
+        content = protocol.encode_content(m.table, m.row, m.column, m.value)
+        out.append(
+            protocol.EncryptedCrdtMessage(
+                m.timestamp, aead.encrypt_record(session.key, session.salt, content)
+            )
+        )
+    return tuple(out)
+
+
 def decrypt_messages(messages, mnemonic: str):
     """sync.worker.ts:135-173. Canonical rows decrypt on the batched
     C++ path; everything else — including the whole batch when the
@@ -255,33 +276,86 @@ class SyncTransport:
                 self._pending_reconnect = False
                 self._fire_reconnect()
 
+    def _aead_negotiated(self, url: str, caps) -> bool:
+        """v2 emission gate: we advertise aead-batch-v1 AND the LAST
+        response from `url` echoed it back. Everything else — first
+        contact, a v1 relay, a failover target we never spoke to —
+        gets the v1 wire. Decoding needs no gate (records
+        self-describe), so this only ever controls what we WRITE."""
+        return (
+            protocol.CAP_AEAD_BATCH in caps
+            and protocol.CAP_AEAD_BATCH in self.negotiated_capabilities.get(url, ())
+        )
+
+    def _drop_negotiated(self, url: str) -> None:
+        """Invalidate the cached capability set alongside a route
+        invalidation: the relay at `url` is gone/stale, and a failover
+        replica must be treated as un-negotiated (v1) until its own
+        response says otherwise — never send v2 at a relay that didn't
+        advertise it."""
+        if self.negotiated_capabilities.pop(url, None) is not None:
+            metrics.inc("evolu_crypto_capability_invalidations_total")
+
+    def _encode_push(self, request: SyncRequestInput, node_id: str,
+                     caps, use_v2: bool) -> bytes:
+        """One request body. v1: the fused C wire path (byte-identical
+        to the pre-v2 encoder — pinned), pure per-message OpenPGP
+        behind it. v2 (negotiated only): ONE session key schedule +
+        one GCM record per message (`encode_push_request_aead`), pure
+        aead loop behind it. Capabilities append identically on every
+        path; absent caps = the v1 wire byte-for-byte."""
+        from evolu_tpu.sync import native_crypto
+
+        body = None
+        if use_v2 and request.messages:
+            from evolu_tpu.sync import aead
+
+            session = aead.get_session(request.owner.mnemonic,
+                                       records=len(request.messages))
+            body = native_crypto.encode_push_request_aead(
+                request.messages, session.key, session.salt,
+                request.owner.id, node_id, request.merkle_tree,
+            )
+            if body is None:
+                # (encrypt_messages_v2 re-counts the records against a
+                # session it fetches itself — double-counting toward
+                # the rotation bound is conservative and harmless.)
+                encrypted = encrypt_messages_v2(request.messages, request.owner.mnemonic)
+                body = protocol.encode_sync_request(
+                    protocol.SyncRequest(encrypted, request.owner.id, node_id,
+                                         request.merkle_tree)
+                )
+        if body is None:
+            body = native_crypto.encode_push_request(
+                request.messages, request.owner.mnemonic,
+                request.owner.id, node_id, request.merkle_tree,
+            )
+        if body is None:
+            encrypted = encrypt_messages(request.messages, request.owner.mnemonic)
+            body = protocol.encode_sync_request(
+                protocol.SyncRequest(encrypted, request.owner.id, node_id,
+                                     request.merkle_tree)
+            )
+        if caps:
+            # Advertise as appended field-5 bytes: identical on the
+            # fused C and pure encode paths, absent (v1 wire,
+            # byte-identical) when the config advertises nothing.
+            body = body + protocol.encode_request_capabilities(caps)
+        return body
+
     def _sync_round(self, request: SyncRequestInput):
         """One encrypt→POST→decrypt round under the sync lock. Returns
         the decoded (messages, merkle_tree, previous_diff) for the
         caller to hand to on_receive AFTER releasing the lock, or None
         when there is nothing to receive."""
+        caps = tuple(self.config.sync_capabilities or ())
+        owner_id = request.owner.id
+        base = self.config.sync_url
+        url = self._routes.get(owner_id, base)
+        use_v2 = self._aead_negotiated(url, caps)
         try:
-            from evolu_tpu.sync import native_crypto
-
             node_id = timestamp_from_string(request.clock_timestamp).node
-            # Fused wire path: encrypt + SyncRequest assembly in one C
-            # call (byte-compatible with the pure encoder, pinned in
-            # tests); None → the pure per-message path.
-            body = native_crypto.encode_push_request(
-                request.messages, request.owner.mnemonic,
-                request.owner.id, node_id, request.merkle_tree,
-            )
-            if body is None:
-                encrypted = encrypt_messages(request.messages, request.owner.mnemonic)
-                body = protocol.encode_sync_request(
-                    protocol.SyncRequest(encrypted, request.owner.id, node_id, request.merkle_tree)
-                )
-            caps = tuple(self.config.sync_capabilities or ())
-            if caps:
-                # Advertise as appended field-5 bytes: identical on the
-                # fused C and pure encode paths, absent (v1 wire,
-                # byte-identical) when the config advertises nothing.
-                body = body + protocol.encode_request_capabilities(caps)
+            body = self._encode_push(request, node_id, caps, use_v2)
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))
             return None
@@ -289,63 +363,107 @@ class SyncTransport:
         metrics.inc("evolu_sync_request_messages_total", len(request.messages))
         metrics.observe("evolu_sync_request_bytes", len(body),
                         buckets=metrics.SIZE_BUCKETS)
-        owner_id = request.owner.id
-        base = self.config.sync_url
-        url = self._routes.get(owner_id, base)
         log("sync:request", url=url,
             messages=len(request.messages), bytes=len(body))
+
+        class _Abort(Exception):
+            pass
+
+        downgraded = False
+
+        def retarget(new_url: str):
+            """Move this round to another relay. If the body was a v2
+            envelope but the new target is not negotiated for it,
+            silently re-emit the round as v1 — a failover replica must
+            NEVER receive v2 records it didn't advertise for (the
+            regression this guards: 2-relay fleet failover to a v1
+            replica)."""
+            nonlocal url, body, use_v2, downgraded
+            url = new_url
+            if use_v2 and not self._aead_negotiated(new_url, caps):
+                use_v2 = False
+                downgraded = True
+                try:
+                    body = self._encode_push(request, node_id, caps, False)
+                except Exception as e:  # noqa: BLE001 - encode must never
+                    # kill the transport thread; surface and end the round
+                    self.on_error(UnknownError(e))
+                    raise _Abort() from e
+                metrics.inc("evolu_crypto_v1_fallback_total", reason="failover")
+                log("sync:request", "aead downgrade for failover", url=new_url)
+
         followed = False
-        while True:
-            try:
-                response_bytes = self._http_post(url, body)
-                break
-            except urllib.error.HTTPError as e:
-                # A fleet relay answers a non-placed sync POST with
-                # 307 + the authoritative peer URL (server/fleet.py).
-                # Follow AT MOST ONE redirect per request and cache
-                # the learned owner→relay route; each hop's POST keeps
-                # its own full 429/503/connection backoff schedule
-                # inside _http_post, so backpressure at the redirected
-                # relay still backs off normally.
-                location = e.headers.get("Location") if e.headers else None
-                if e.code == 307 and location and not followed:
-                    followed = True
-                    url = urllib.parse.urljoin(url, location)
-                    self._routes[owner_id] = url
-                    metrics.inc("evolu_sync_redirects_total")
-                    log("sync:request", "fleet redirect", url=url)
-                    continue
-                if e.code in (307, 404) and self._routes.pop(owner_id, None):
-                    # A second 307 (ring churn) or a 404 (the learned
-                    # relay no longer serves this owner): the cached
-                    # route is stale. For the 404, retry ONCE at the
-                    # configured relay in this same round.
-                    metrics.inc("evolu_sync_route_invalidations_total")
-                    if e.code == 404 and url != base:
-                        url = base
+        try:
+            while True:
+                try:
+                    response_bytes = self._http_post(url, body)
+                    break
+                except urllib.error.HTTPError as e:
+                    # A fleet relay answers a non-placed sync POST with
+                    # 307 + the authoritative peer URL (server/fleet.py).
+                    # Follow AT MOST ONE redirect per request and cache
+                    # the learned owner→relay route; each hop's POST
+                    # keeps its own full 429/503/connection backoff
+                    # schedule inside _http_post, so backpressure at the
+                    # redirected relay still backs off normally.
+                    location = e.headers.get("Location") if e.headers else None
+                    if e.code == 307 and location and not followed:
+                        followed = True
+                        target = urllib.parse.urljoin(url, location)
+                        self._routes[owner_id] = target
+                        metrics.inc("evolu_sync_redirects_total")
+                        log("sync:request", "fleet redirect", url=target)
+                        retarget(target)
                         continue
-                # The server answered: that's a real error (4xx/5xx),
-                # not offline — surface it so divergence isn't silent.
-                # The transport is demonstrably UP, so clear any
-                # offline state.
-                metrics.inc("evolu_sync_http_errors_total")
-                self._note_online()
-                self.on_error(UnknownError(e))
-                return None
-            except (urllib.error.URLError, OSError):
-                if url != base and self._routes.pop(owner_id, None):
-                    # The LEARNED relay is unreachable — that says
-                    # nothing about the configured one: drop the route
-                    # and fail over to it before declaring offline.
-                    metrics.inc("evolu_sync_route_invalidations_total")
-                    url = base
-                    continue
-                # Offline is not an error (sync.worker.ts:217-227) —
-                # but it arms the reconnect probe.
-                metrics.inc("evolu_sync_offline_rounds_total")
-                self._note_offline()
-                return None
+                    if e.code in (307, 404) and self._routes.pop(owner_id, None):
+                        # A second 307 (ring churn) or a 404 (the
+                        # learned relay no longer serves this owner):
+                        # the cached route is stale — and so is anything
+                        # we thought that relay had negotiated.
+                        metrics.inc("evolu_sync_route_invalidations_total")
+                        self._drop_negotiated(url)
+                        if e.code == 404 and url != base:
+                            retarget(base)
+                            continue
+                    # The server answered: that's a real error
+                    # (4xx/5xx), not offline — surface it so divergence
+                    # isn't silent. The transport is demonstrably UP, so
+                    # clear any offline state.
+                    metrics.inc("evolu_sync_http_errors_total")
+                    self._note_online()
+                    self.on_error(UnknownError(e))
+                    return None
+                except (urllib.error.URLError, OSError):
+                    if url != base and self._routes.pop(owner_id, None):
+                        # The LEARNED relay is unreachable — that says
+                        # nothing about the configured one: drop the
+                        # route (and its negotiated capability set) and
+                        # fail over to it before declaring offline.
+                        metrics.inc("evolu_sync_route_invalidations_total")
+                        self._drop_negotiated(url)
+                        retarget(base)
+                        continue
+                    # Offline is not an error (sync.worker.ts:217-227)
+                    # — but it arms the reconnect probe.
+                    metrics.inc("evolu_sync_offline_rounds_total")
+                    self._note_offline()
+                    return None
+        except _Abort:
+            return None
         self._note_online()
+        # Push-mix counters AFTER the POST landed: a round that ended
+        # offline, errored, or was downgraded mid-flight must count as
+        # what actually reached a relay, not what was first encoded
+        # (the failover downgrade itself is an event — counted in
+        # retarget; `use_v2` here reflects the FINAL body).
+        if request.messages:
+            if use_v2:
+                metrics.inc("evolu_crypto_v2_push_legs_total")
+                metrics.inc("evolu_crypto_v2_push_messages_total",
+                            len(request.messages))
+            elif protocol.CAP_AEAD_BATCH in caps and not downgraded:
+                metrics.inc("evolu_crypto_v1_fallback_total",
+                            reason="not_negotiated")
         if caps:
             try:
                 negotiated = protocol.scan_sync_response_capabilities(response_bytes)
@@ -355,6 +473,10 @@ class SyncTransport:
             metrics.set_gauge(
                 "evolu_crdt_capability_negotiated",
                 1 if protocol.CAP_CRDT_TYPES in negotiated else 0,
+            )
+            metrics.set_gauge(
+                "evolu_crypto_aead_negotiated",
+                1 if protocol.CAP_AEAD_BATCH in negotiated else 0,
             )
         try:
             from evolu_tpu.sync import native_crypto

@@ -19,6 +19,13 @@ the shapes OpenPGP.js can emit for these small messages.
 
 Crypto is host-side work by design (SURVEY.md §5): the TPU kernels
 never see plaintext values, mirroring the E2EE-blind relay.
+
+The ~3µs/msg S2K here is the measured per-message floor of this wire
+format (docs/BENCHMARKS.md). `sync/aead.py` is the negotiated escape
+hatch — session-keyed AES-256-GCM records under the `aead-batch-v1`
+capability — and `aead.decrypt_content` is the dispatch that lets
+stored logs mix both formats; this module stays the reference-parity
+format and the only one un-negotiated peers ever receive.
 """
 
 from __future__ import annotations

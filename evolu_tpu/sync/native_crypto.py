@@ -26,14 +26,16 @@ from __future__ import annotations
 
 import ctypes
 import struct
+from array import array
 from typing import List, Optional, Sequence, Tuple
 
 from evolu_tpu.core.types import CrdtMessage
 from evolu_tpu.sync import protocol
-from evolu_tpu.sync.crypto import decrypt_symmetric
+from evolu_tpu.sync.aead import decrypt_content
 from evolu_tpu.utils.native_loader import load_native_library
 
 _INT64_LO, _INT64_HI = -(1 << 63), (1 << 63) - 1
+_AEAD_NATIVE = False  # set by _configure when the built .so has the v2 leg
 
 
 def _configure(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
@@ -69,6 +71,26 @@ def _configure(lib: ctypes.CDLL) -> Optional[ctypes.CDLL]:
         c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
     ]
     lib.ehc_free.argtypes = [c.c_void_p]
+    # aead-batch-v1 leg (ISSUE 8). Guarded: a stale binary without the
+    # symbol (no toolchain to rebuild) must not veto the whole v1
+    # library — the v2 entry points then answer None (pure path).
+    global _AEAD_NATIVE
+    try:
+        lib.ehc_aead_encrypt_wire_batch.restype = c.c_int
+        lib.ehc_aead_encrypt_wire_batch.argtypes = [
+            c.c_int64,
+            c.c_char_p, c.POINTER(c.c_int32),  # timestamps
+            c.c_char_p, c.POINTER(c.c_int32),  # tables
+            c.c_char_p, c.POINTER(c.c_int32),  # rows
+            c.c_char_p, c.POINTER(c.c_int32),  # columns
+            c.c_char_p, c.POINTER(c.c_int32),  # string values
+            c.POINTER(c.c_int8), c.POINTER(c.c_int64), c.POINTER(c.c_double),
+            c.c_char_p, c.c_char_p,  # key32, salt16
+            c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
+        ]
+        _AEAD_NATIVE = True
+    except AttributeError:
+        _AEAD_NATIVE = False
     if not lib.ehc_available():
         return None
     return lib
@@ -186,6 +208,167 @@ def encode_push_request(
     rc = lib.ehc_encrypt_wire_batch(
         n, b"".join(ts_parts), ts_lens, blob, lens, vkinds, ivals, dvals,
         pw, len(pw), ctypes.byref(out_p), ctypes.byref(out_len),
+    )
+    if rc != 0:
+        return None
+    try:
+        stream = ctypes.string_at(out_p.value, out_len.value)
+    finally:
+        lib.ehc_free(out_p)
+    return (
+        stream
+        + protocol._string(2, user_id)
+        + protocol._string(3, node_id)
+        + protocol._string(4, merkle_tree)
+    )
+
+
+# Exact-type → wire kind for the columnar packer. 4 = not packable
+# (bytes, str/int subclasses, anything exotic) → the Python oracle owns
+# the error surface. bool IS exact here (2: varint like int); a bool in
+# an array("q") slot is its 0/1 int value by the buffer protocol.
+_VKIND_OF = {type(None): 0, str: 1, bool: 2, int: 2, float: 3}
+
+
+def _pack_columns(messages: Sequence):
+    """Columnar packing for the aead wire leg — one blob + length array
+    PER FIELD instead of the v1 interleave. The per-message Python
+    share is the binding cost of the v2 leg (the C side dropped to one
+    GCM per record), so every pass here is a comprehension or a map —
+    no per-message interpreter loop with method-call dispatch (that
+    shape measured ~2× slower). int64 range policing is delegated to
+    `array("q")`'s own OverflowError: one C-level check instead of two
+    Python comparisons per message.
+    None when any value needs the Python oracle's error surface."""
+    enc = str.encode
+    try:
+        tsb = [enc(m.timestamp) for m in messages]
+        tb = [enc(m.table) for m in messages]
+        rb = [enc(m.row) for m in messages]
+        cb = [enc(m.column) for m in messages]
+    except (TypeError, AttributeError):
+        return None  # non-string field → oracle raises canonically
+    kind_of = _VKIND_OF
+    vals = [m.value for m in messages]
+    kinds = [kind_of.get(type(v), 4) for v in vals]
+    if 4 in kinds:
+        return None  # unencodable somewhere → oracle raises
+    try:
+        ivals = array("q", [v if k == 2 else 0 for k, v in zip(kinds, vals)])
+    except OverflowError:
+        return None  # beyond int64 → oracle raises the canonical TypeError
+    dvals = array("d", [v if k == 3 else 0.0 for k, v in zip(kinds, vals)])
+    sparts = [enc(v) if k == 1 else b"" for k, v in zip(kinds, vals)]
+    join = b"".join
+    i32 = ctypes.c_int32
+    lens = array("i", map(len, tsb)) + array("i", map(len, tb)) \
+        + array("i", map(len, rb)) + array("i", map(len, cb)) \
+        + array("i", map(len, sparts))
+    n = len(tsb)
+    la = (i32 * len(lens)).from_buffer(lens)
+    return (
+        join(tsb), la, join(tb), n, join(rb), join(cb), join(sparts),
+        (ctypes.c_int8 * n).from_buffer(array("b", kinds)),
+        (ctypes.c_int64 * n).from_buffer(ivals),
+        (ctypes.c_double * n).from_buffer(dvals),
+    )
+
+
+_PY_PUSH = False  # resolved lazily: False=untried, None=unavailable
+
+
+def _py_push_fn():
+    """The CPython-ABI encode lane (`ehc_aead_encrypt_push_py` via
+    ctypes.PyDLL — PyDLL keeps the GIL, which the extraction phase
+    requires; the C side drops it itself for the seal loop so other
+    threads overlap the crypto). Enabled only after `ehc_py_abi_probe`
+    validates the
+    self-declared PyObject layout against a live str on THIS
+    interpreter — any drift (debug build, free-threading, future
+    CPython) silently falls back to the blob packer. None when
+    unavailable."""
+    global _PY_PUSH
+    if _PY_PUSH is not False:
+        return _PY_PUSH
+    _PY_PUSH = None
+    if load_library() is None or not _AEAD_NATIVE:
+        return None
+    import os
+
+    from evolu_tpu.utils.native_loader import NATIVE_DIR
+
+    try:
+        c = ctypes
+        plib = c.PyDLL(os.path.join(NATIVE_DIR, "libevolu_crypto.so"))
+        probe = plib.ehc_py_abi_probe
+        probe.restype = c.c_int
+        probe.argtypes = [c.py_object]
+        if probe("x") != 0:
+            return None
+        fn = plib.ehc_aead_encrypt_push_py
+        fn.restype = c.c_int
+        fn.argtypes = [
+            c.py_object, c.c_int64, c.c_char_p, c.c_char_p,
+            c.POINTER(c.c_void_p), c.POINTER(c.c_int64),
+        ]
+        _PY_PUSH = fn
+    except (OSError, AttributeError, ctypes.ArgumentError):
+        _PY_PUSH = None
+    return _PY_PUSH
+
+
+def encode_push_request_aead(
+    messages: Sequence, key: bytes, salt: bytes, user_id: str, node_id: str,
+    merkle_tree: str,
+) -> Optional[bytes]:
+    """The v2 twin of `encode_push_request`: the whole SyncRequest body
+    with ONE session key schedule and one GCM per message, byte-
+    compatible with `protocol.encode_sync_request` over
+    `aead.encrypt_record` contents. Two native lanes: the CPython-ABI
+    extraction (`ehc_aead_encrypt_push_py`, zero per-message Python)
+    and the columnar blob ABI (`ehc_aead_encrypt_wire_batch`) behind
+    it. None → pure path (library or symbol unavailable, or a value
+    that needs the oracle's error surface)."""
+    lib = load_library()
+    if lib is None or not _AEAD_NATIVE:
+        return None
+    fn = _py_push_fn()
+    if fn is not None:
+        if not isinstance(messages, (tuple, list)):
+            messages = tuple(messages)
+        out_p = ctypes.c_void_p()
+        out_len = ctypes.c_int64()
+        rc = fn(messages, len(messages), key, salt,
+                ctypes.byref(out_p), ctypes.byref(out_len))
+        if rc == 0:
+            try:
+                stream = ctypes.string_at(out_p.value, out_len.value)
+            finally:
+                lib.ehc_free(out_p)
+            return (
+                stream
+                + protocol._string(2, user_id)
+                + protocol._string(3, node_id)
+                + protocol._string(4, merkle_tree)
+            )
+        # rc != 0: shape demotion — the blob packer (then the oracle)
+        # owns the canonical error surface.
+    packed = _pack_columns(messages)
+    if packed is None:
+        return None
+    ts_blob, lens, t_blob, n, r_blob, c_blob, s_blob, vkinds, ivals, dvals = packed
+    p32 = ctypes.POINTER(ctypes.c_int32)
+    base = ctypes.cast(lens, p32)
+    out_p = ctypes.c_void_p()
+    out_len = ctypes.c_int64()
+    rc = lib.ehc_aead_encrypt_wire_batch(
+        n, ts_blob, base,
+        t_blob, ctypes.cast(ctypes.byref(lens, 4 * n), p32),
+        r_blob, ctypes.cast(ctypes.byref(lens, 8 * n), p32),
+        c_blob, ctypes.cast(ctypes.byref(lens, 12 * n), p32),
+        s_blob, ctypes.cast(ctypes.byref(lens, 16 * n), p32),
+        vkinds, ivals, dvals, key, salt,
+        ctypes.byref(out_p), ctypes.byref(out_len),
     )
     if rc != 0:
         return None
@@ -336,7 +519,7 @@ def decrypt_response(response_bytes: bytes, password: str):
         ct_off, ct_len = item
         ct = response_bytes[ct_off : ct_off + ct_len]
         table, row, column, value = protocol.decode_content(
-            decrypt_symmetric(ct, password)
+            decrypt_content(ct, password)
         )
         out.append(CrdtMessage(timestamp, table, row, column, value))
     return tuple(out), tree
@@ -377,8 +560,11 @@ def decrypt_response_columns(response_bytes: bytes, password: str):
 
 
 def _pure_one(m, password: str) -> CrdtMessage:
+    # decrypt_content dispatches v1 OpenPGP vs aead-batch-v1 records by
+    # the self-describing magic — the oracle reads BOTH unconditionally
+    # (negotiation gates emission, never decoding).
     table, row, column, value = protocol.decode_content(
-        decrypt_symmetric(m.content, password)
+        decrypt_content(m.content, password)
     )
     return CrdtMessage(m.timestamp, table, row, column, value)
 

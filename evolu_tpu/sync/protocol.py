@@ -265,7 +265,16 @@ def decode_encrypted_message(data: bytes) -> EncryptedCrdtMessage:
 # surface it (sync/client.py records the negotiated set per relay).
 
 CAP_CRDT_TYPES = "crdt-types-v1"
-KNOWN_CAPABILITIES = (CAP_CRDT_TYPES,)
+# Batched-AEAD v2 sync payload (ISSUE 8, sync/aead.py): a NEGOTIATED
+# pair replaces per-message OpenPGP S2K with session-keyed AES-256-GCM
+# records. Unlike crdt-types-v1 this capability GATES emission: a
+# client only sends v2 records to a relay whose LAST response echoed
+# it back (sync/client.py), and any failover to a relay that didn't
+# advertise re-encodes the round as v1. Decoding is unconditional —
+# records self-describe via a magic prefix — so negotiation only
+# controls what gets written, never what can be read.
+CAP_AEAD_BATCH = "aead-batch-v1"
+KNOWN_CAPABILITIES = (CAP_CRDT_TYPES, CAP_AEAD_BATCH)
 _MAX_CAPABILITIES = 64  # decode bound: a hostile body must not mint unbounded strings
 
 

@@ -24,6 +24,7 @@
 // Makefile links the versioned soname directly, mirroring its
 // libsqlite3 pattern.
 
+#include <array>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -53,6 +54,11 @@ int EVP_DecryptInit_ex(EVP_CIPHER_CTX *, const EVP_CIPHER *, ENGINE *,
                        const unsigned char *, const unsigned char *);
 int EVP_DecryptUpdate(EVP_CIPHER_CTX *, unsigned char *, int *,
                       const unsigned char *, int);
+// AEAD leg (aead-batch-v1, sync/aead.py): AES-256-GCM + ctrl/final.
+const EVP_CIPHER *EVP_aes_256_gcm(void);
+int EVP_CIPHER_CTX_ctrl(EVP_CIPHER_CTX *, int, int, void *);
+int EVP_EncryptFinal_ex(EVP_CIPHER_CTX *, unsigned char *, int *);
+int EVP_DecryptFinal_ex(EVP_CIPHER_CTX *, unsigned char *, int *);
 
 EVP_MD_CTX *EVP_MD_CTX_new(void);
 void EVP_MD_CTX_free(EVP_MD_CTX *);
@@ -92,23 +98,49 @@ inline uint8_t *put_pkt_hdr(uint8_t *p, int tag, size_t n) {
   return p;
 }
 
+// EVP_CIPHER_CTX_ctrl codes (stable across OpenSSL 1.1 / 3.x; the
+// AEAD aliases share the GCM values).
+constexpr int CTRL_GCM_GET_TAG = 0x10, CTRL_GCM_SET_TAG = 0x11;
+
 struct Ctxs {
   EVP_CIPHER_CTX *cipher = nullptr;
   EVP_MD_CTX *md = nullptr;
   const EVP_CIPHER *aes = nullptr;
   const EVP_MD *sha256 = nullptr;
   const EVP_MD *sha1 = nullptr;
-  bool ok() const { return cipher && md && aes && sha256 && sha1; }
+  // aead-batch-v1 state: a dedicated GCM context so the CFB context's
+  // reuse pattern is untouched. `gcm_keyed` tracks whether gcm_ctx
+  // currently holds `gcm_key` with its AES key schedule expanded — a
+  // leg under ONE session key then pays the schedule once and each
+  // record re-inits with the nonce alone (the whole point of the
+  // per-session key schedule).
+  EVP_CIPHER_CTX *gcm_ctx = nullptr;
+  const EVP_CIPHER *gcm = nullptr;
+  bool gcm_keyed = false;
+  uint8_t gcm_key[32] = {0};
+  // Per-call HKDF cache: one derivation per distinct session salt per
+  // leg (the Python side keeps the cross-call cache). `last_salt` is
+  // the hot lane: a leg's records virtually always share ONE session
+  // salt, so the per-record cost is a 16-byte compare, not a string
+  // key + map probe.
+  std::unordered_map<std::string, std::array<uint8_t, 32>> aead_keys;
+  uint8_t last_salt[16] = {0};
+  uint8_t last_key[32] = {0};
+  bool has_last_salt = false;
+  bool ok() const { return cipher && md && aes && sha256 && sha1 && gcm_ctx && gcm; }
   Ctxs() {
     cipher = EVP_CIPHER_CTX_new();
     md = EVP_MD_CTX_new();
     aes = EVP_aes_256_cfb128();
     sha256 = EVP_sha256();
     sha1 = EVP_sha1();
+    gcm_ctx = EVP_CIPHER_CTX_new();
+    gcm = EVP_aes_256_gcm();
   }
   ~Ctxs() {
     if (cipher) EVP_CIPHER_CTX_free(cipher);
     if (md) EVP_MD_CTX_free(md);
+    if (gcm_ctx) EVP_CIPHER_CTX_free(gcm_ctx);
   }
 };
 
@@ -155,6 +187,166 @@ bool sha1_oneshot(Ctxs &cx, const uint8_t *data, size_t n, uint8_t out[20]) {
   return true;
 }
 
+// ---- aead-batch-v1 (sync/aead.py — the v2 record format) ----
+//
+//   [0]  magic 0x45 0x32 0x01 ("E2" + version; bit 7 of byte 0 is
+//        clear, so v2 records and OpenPGP packet streams are
+//        structurally disjoint — decrypt_one dispatches on it)
+//   [3]  salt[16] (HKDF session salt)  [19] nonce[12]
+//   [31] AES-256-GCM ciphertext ‖ tag[16]
+// Plaintext = the CrdtMessageContent protobuf (same bytes the v1
+// literal packet carries).
+
+constexpr size_t AEAD_SALT = 16, AEAD_NONCE = 12, AEAD_TAG = 16;
+constexpr size_t AEAD_OVERHEAD = 3 + AEAD_SALT + AEAD_NONCE + AEAD_TAG;  // 47
+// MUST match sync/aead.py::HKDF_INFO byte for byte.
+constexpr char AEAD_HKDF_INFO[] = "evolu-tpu aead-batch-v1 key";
+
+inline bool is_aead_record(const uint8_t *d, size_t n) {
+  return n >= 3 && d[0] == 0x45 && d[1] == 0x32 && d[2] == 0x01;
+}
+
+// HMAC-SHA-256 over (m1 ‖ m2), hand-rolled on the digest ABI (the
+// legacy HMAC() one-shot is deprecated in OpenSSL 3 and the EVP_MAC
+// API does not exist in 1.1 — the block construction is version-proof).
+bool hmac_sha256(Ctxs &cx, const uint8_t *key, size_t key_len,
+                 const uint8_t *m1, size_t l1, const uint8_t *m2, size_t l2,
+                 uint8_t out[32]) {
+  uint8_t k0[64] = {0};
+  if (key_len > 64) {
+    unsigned int dl = 0;
+    if (!EVP_DigestInit_ex(cx.md, cx.sha256, nullptr) ||
+        !EVP_DigestUpdate(cx.md, key, key_len) ||
+        !EVP_DigestFinal_ex(cx.md, k0, &dl) || dl != 32)
+      return false;
+  } else {
+    memcpy(k0, key, key_len);
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; i++) { ipad[i] = k0[i] ^ 0x36; opad[i] = k0[i] ^ 0x5C; }
+  uint8_t inner[32];
+  unsigned int dl = 0;
+  if (!EVP_DigestInit_ex(cx.md, cx.sha256, nullptr) ||
+      !EVP_DigestUpdate(cx.md, ipad, 64) ||
+      (l1 && !EVP_DigestUpdate(cx.md, m1, l1)) ||
+      (l2 && !EVP_DigestUpdate(cx.md, m2, l2)) ||
+      !EVP_DigestFinal_ex(cx.md, inner, &dl) || dl != 32)
+    return false;
+  if (!EVP_DigestInit_ex(cx.md, cx.sha256, nullptr) ||
+      !EVP_DigestUpdate(cx.md, opad, 64) ||
+      !EVP_DigestUpdate(cx.md, inner, 32) ||
+      !EVP_DigestFinal_ex(cx.md, out, &dl) || dl != 32)
+    return false;
+  return true;
+}
+
+// RFC 5869, one 32-byte block: PRK = HMAC(salt, secret);
+// OKM = HMAC(PRK, info ‖ 0x01). Bit-identical to aead.hkdf_sha256.
+bool hkdf_sha256(Ctxs &cx, const uint8_t *secret, size_t secret_len,
+                 const uint8_t *salt16, uint8_t out[32]) {
+  uint8_t prk[32];
+  if (!hmac_sha256(cx, salt16, AEAD_SALT, secret, secret_len, nullptr, 0, prk))
+    return false;
+  static const uint8_t one = 1;
+  return hmac_sha256(cx, prk, 32,
+                     reinterpret_cast<const uint8_t *>(AEAD_HKDF_INFO),
+                     sizeof(AEAD_HKDF_INFO) - 1, &one, 1, out);
+}
+
+// Session key for a record's salt, HKDF'd once per distinct salt per
+// call (the cross-call cache lives in Python, keyed the same way).
+bool aead_key_for(Ctxs &cx, const uint8_t *pw, size_t pw_len,
+                  const uint8_t *salt16, uint8_t out[32]) {
+  if (cx.has_last_salt && memcmp(cx.last_salt, salt16, AEAD_SALT) == 0) {
+    memcpy(out, cx.last_key, 32);
+    return true;
+  }
+  std::string k(reinterpret_cast<const char *>(salt16), AEAD_SALT);
+  auto it = cx.aead_keys.find(k);
+  if (it == cx.aead_keys.end()) {
+    std::array<uint8_t, 32> key;
+    if (!hkdf_sha256(cx, pw, pw_len, salt16, key.data())) return false;
+    it = cx.aead_keys.emplace(std::move(k), key).first;
+  }
+  memcpy(cx.last_salt, salt16, AEAD_SALT);
+  memcpy(cx.last_key, it->second.data(), 32);
+  cx.has_last_salt = true;
+  memcpy(out, it->second.data(), 32);
+  return true;
+}
+
+// (Re)key the GCM context only when the session key changes; records
+// under the current key re-init with the nonce alone (no AES key
+// schedule). `enc` selects direction — a call only ever runs one.
+bool gcm_ready(Ctxs &cx, const uint8_t key[32], const uint8_t *nonce, bool enc) {
+  if (!cx.gcm_keyed || memcmp(cx.gcm_key, key, 32) != 0) {
+    int ok = enc ? EVP_EncryptInit_ex(cx.gcm_ctx, cx.gcm, nullptr, key, nonce)
+                 : EVP_DecryptInit_ex(cx.gcm_ctx, cx.gcm, nullptr, key, nonce);
+    if (!ok) return false;
+    memcpy(cx.gcm_key, key, 32);
+    cx.gcm_keyed = true;
+    return true;
+  }
+  return enc ? EVP_EncryptInit_ex(cx.gcm_ctx, nullptr, nullptr, nullptr, nonce)
+             : EVP_DecryptInit_ex(cx.gcm_ctx, nullptr, nullptr, nullptr, nonce);
+}
+
+// Decrypt + verify ONE v2 record into `plain` (resized to the content
+// length). false = demote to the Python oracle (which owns the exact
+// PgpError surface for truncation/auth failure).
+bool aead_open_record(Ctxs &cx, const uint8_t *msg, size_t clen,
+                      const uint8_t *password, size_t pw_len,
+                      std::vector<uint8_t> &plain) {
+  if (clen < AEAD_OVERHEAD) return false;
+  const uint8_t *salt = msg + 3, *nonce = msg + 3 + AEAD_SALT;
+  const uint8_t *ct = msg + 3 + AEAD_SALT + AEAD_NONCE;
+  size_t ct_len = clen - AEAD_OVERHEAD;
+  uint8_t key[32], tag[AEAD_TAG];
+  memcpy(tag, msg + clen - AEAD_TAG, AEAD_TAG);
+  if (!aead_key_for(cx, password, pw_len, salt, key)) return false;
+  if (!gcm_ready(cx, key, nonce, /*enc=*/false)) { cx.gcm_keyed = false; return false; }
+  plain.resize(ct_len ? ct_len : 1);
+  int len = 0, fl = 0;
+  if (ct_len && !EVP_DecryptUpdate(cx.gcm_ctx, plain.data(), &len, ct,
+                                   int(ct_len))) {
+    cx.gcm_keyed = false;
+    return false;
+  }
+  if (EVP_CIPHER_CTX_ctrl(cx.gcm_ctx, CTRL_GCM_SET_TAG, AEAD_TAG, tag) != 1 ||
+      EVP_DecryptFinal_ex(cx.gcm_ctx, plain.data() + len, &fl) != 1 ||
+      size_t(len + fl) != ct_len) {
+    // A failed final leaves ctx state undefined enough that the next
+    // record must re-run the full keyed init.
+    cx.gcm_keyed = false;
+    return false;
+  }
+  plain.resize(ct_len);
+  return true;
+}
+
+// Seal ONE content plaintext as a v2 record into dst (sized c + 47).
+bool aead_seal_record(Ctxs &cx, const uint8_t key[32], const uint8_t *salt16,
+                      const uint8_t *nonce12, const uint8_t *pt, size_t c,
+                      uint8_t *dst) {
+  dst[0] = 0x45; dst[1] = 0x32; dst[2] = 0x01;
+  memcpy(dst + 3, salt16, AEAD_SALT);
+  memcpy(dst + 3 + AEAD_SALT, nonce12, AEAD_NONCE);
+  uint8_t *ct = dst + 3 + AEAD_SALT + AEAD_NONCE;
+  if (!gcm_ready(cx, key, nonce12, /*enc=*/true)) { cx.gcm_keyed = false; return false; }
+  int len = 0, fl = 0;
+  if (c && !EVP_EncryptUpdate(cx.gcm_ctx, ct, &len, pt, int(c))) {
+    cx.gcm_keyed = false;
+    return false;
+  }
+  if (EVP_EncryptFinal_ex(cx.gcm_ctx, ct + len, &fl) != 1 ||
+      size_t(len + fl) != c ||
+      EVP_CIPHER_CTX_ctrl(cx.gcm_ctx, CTRL_GCM_GET_TAG, AEAD_TAG, ct + c) != 1) {
+    cx.gcm_keyed = false;
+    return false;
+  }
+  return true;
+}
+
 // ---- CrdtMessageContent protobuf encode (protocol.py:139-172) ----
 
 // vkind: 0 = None, 1 = str (in blob), 2 = int/bool (ival), 3 = double.
@@ -188,6 +380,53 @@ uint8_t *put_content(uint8_t *p, const uint8_t *strs, const int32_t lens[4],
     p = wire_put_varint(p, uint64_t(lens[3]));
     memcpy(p, s, size_t(lens[3]));
     p += lens[3];
+  } else if (vkind == 2) {
+    *p++ = uint8_t(ival >= INT32_LO && ival <= INT32_HI ? (5 << 3) : (7 << 3));
+    p = wire_put_varint(p, uint64_t(ival));
+  } else if (vkind == 3) {
+    *p++ = uint8_t((6 << 3) | 1);
+    uint64_t bits;
+    memcpy(&bits, &dval, 8);
+    for (int i = 0; i < 8; i++) *p++ = uint8_t(bits >> (8 * i));
+  }
+  return p;
+}
+
+// Per-column twins of content_size/put_content for the aead wire leg
+// (its Python packer ships one blob per column — b"".join of per-field
+// comprehensions is measurably cheaper than interleaving in a Python
+// loop, and the per-message Python share is the binding cost there).
+size_t content_size_cols(int32_t tl, int32_t rl, int32_t cl, int32_t sl,
+                         int8_t vkind, int64_t ival) {
+  size_t n = 1 + wire_varint_size(uint64_t(tl)) + size_t(tl) +
+             1 + wire_varint_size(uint64_t(rl)) + size_t(rl) +
+             1 + wire_varint_size(uint64_t(cl)) + size_t(cl);
+  if (vkind == 1) {
+    n += 1 + wire_varint_size(uint64_t(sl)) + size_t(sl);
+  } else if (vkind == 2) {
+    n += 1 + wire_varint_size(uint64_t(ival));
+  } else if (vkind == 3) {
+    n += 1 + 8;
+  }
+  return n;
+}
+
+uint8_t *put_str_field(uint8_t *p, int field, const uint8_t *s, int32_t len) {
+  *p++ = uint8_t((field << 3) | 2);
+  p = wire_put_varint(p, uint64_t(len));
+  memcpy(p, s, size_t(len));
+  return p + len;
+}
+
+uint8_t *put_content_cols(uint8_t *p, const uint8_t *t, int32_t tl,
+                          const uint8_t *r, int32_t rl, const uint8_t *c,
+                          int32_t cl, const uint8_t *s, int32_t sl,
+                          int8_t vkind, int64_t ival, double dval) {
+  p = put_str_field(p, 1, t, tl);
+  p = put_str_field(p, 2, r, rl);
+  p = put_str_field(p, 3, c, cl);
+  if (vkind == 1) {
+    p = put_str_field(p, 4, s, sl);
   } else if (vkind == 2) {
     *p++ = uint8_t(ival >= INT32_LO && ival <= INT32_HI ? (5 << 3) : (7 << 3));
     p = wire_put_varint(p, uint64_t(ival));
@@ -393,6 +632,292 @@ int ehc_encrypt_wire_batch(int64_t n, const uint8_t *ts_blob,
   return 0;
 }
 
+// aead-batch-v1 push leg: encrypt a batch STRAIGHT INTO SyncRequest
+// wire form under ONE session key — the v2 twin of
+// ehc_encrypt_wire_batch. The key schedule runs once (key32/salt16
+// come from the Python-side AeadSession, HKDF'd once per owner per
+// session); each message costs one nonce + one small GCM. Inputs are
+// per-column blobs (timestamps, tables, rows, columns, string values)
+// with per-column length arrays; vkinds/ivals/dvals as in the v1 ABI
+// (s_lens[i] is only read when vkinds[i]==1). Output: the concatenated
+// `messages` field-1 stream, caller appends fields 2/3/4 (+5).
+// Returns 0 on success, nonzero on any failure (→ pure Python path).
+int ehc_aead_encrypt_wire_batch(
+    int64_t n, const uint8_t *ts_blob, const int32_t *ts_lens,
+    const uint8_t *t_blob, const int32_t *t_lens, const uint8_t *r_blob,
+    const int32_t *r_lens, const uint8_t *c_blob, const int32_t *c_lens,
+    const uint8_t *s_blob, const int32_t *s_lens, const int8_t *vkinds,
+    const int64_t *ivals, const double *dvals, const uint8_t *key32,
+    const uint8_t *salt16, uint8_t **out_blob, int64_t *out_len) {
+  Ctxs cx;
+  if (!cx.ok() || n < 0) return 1;
+  std::vector<size_t> clen(static_cast<size_t>(n)), inner(static_cast<size_t>(n));
+  size_t out_total = 0;
+  for (int64_t i = 0; i < n; i++) {
+    if (t_lens[i] < 0 || r_lens[i] < 0 || c_lens[i] < 0 || ts_lens[i] < 0 ||
+        (vkinds[i] == 1 && s_lens[i] < 0))
+      return 1;
+    size_t c = content_size_cols(t_lens[i], r_lens[i], c_lens[i],
+                                 vkinds[i] == 1 ? s_lens[i] : 0, vkinds[i],
+                                 ivals[i]);
+    size_t ct = c + AEAD_OVERHEAD;
+    size_t in = 1 + wire_varint_size(uint64_t(ts_lens[i])) + size_t(ts_lens[i]) +
+                1 + wire_varint_size(ct) + ct;
+    clen[size_t(i)] = c;
+    inner[size_t(i)] = in;
+    out_total += 1 + wire_varint_size(in) + in;
+  }
+  uint8_t *out = static_cast<uint8_t *>(malloc(out_total ? out_total : 1));
+  if (!out) return 1;
+  // One RNG call for the whole batch: a 12-byte nonce per record.
+  std::vector<uint8_t> rnd(size_t(n) * AEAD_NONCE);
+  if (n && !RAND_bytes(rnd.data(), int(rnd.size()))) { free(out); return 1; }
+
+  std::vector<uint8_t> plainbuf;
+  const uint8_t *ts = ts_blob, *t = t_blob, *r = r_blob, *cc = c_blob,
+                *s = s_blob;
+  uint8_t *p = out;
+  for (int64_t i = 0; i < n; i++) {
+    size_t c = clen[size_t(i)];
+    *p++ = 0x0A;  // SyncRequest.messages, field 1, wt 2
+    p = wire_put_varint(p, uint64_t(inner[size_t(i)]));
+    *p++ = 0x0A;  // EncryptedCrdtMessage.timestamp
+    p = wire_put_varint(p, uint64_t(ts_lens[i]));
+    memcpy(p, ts, size_t(ts_lens[i]));
+    p += ts_lens[i];
+    ts += ts_lens[i];
+    *p++ = 0x12;  // EncryptedCrdtMessage.content, field 2, wt 2
+    p = wire_put_varint(p, uint64_t(c + AEAD_OVERHEAD));
+    int32_t sl = vkinds[i] == 1 ? s_lens[i] : 0;
+    plainbuf.resize(c ? c : 1);
+    uint8_t *end = put_content_cols(plainbuf.data(), t, t_lens[i], r, r_lens[i],
+                                    cc, c_lens[i], s, sl, vkinds[i], ivals[i],
+                                    dvals[i]);
+    if (size_t(end - plainbuf.data()) != c ||
+        !aead_seal_record(cx, key32, salt16, rnd.data() + AEAD_NONCE * i,
+                          plainbuf.data(), c, p)) {
+      free(out);
+      return 1;
+    }
+    p += c + AEAD_OVERHEAD;
+    t += t_lens[i]; r += r_lens[i]; cc += c_lens[i];
+    if (vkinds[i] == 1) s += s_lens[i];
+  }
+  if (size_t(p - out) != out_total) { free(out); return 1; }
+  *out_blob = out;
+  *out_len = int64_t(out_total);
+  return 0;
+}
+
+}  // extern "C"
+
+// ---- CPython ABI fast lane (aead push encode) ----
+//
+// Self-declared like the OpenSSL ABI at the top of this file: the .so
+// is only ever dlopen'd from inside a CPython process, so these
+// symbols resolve from the already-loaded interpreter. The binding
+// side (sync/native_crypto.py) calls through ctypes.PyDLL so the GIL
+// is HELD for the whole call — mandatory for every function below.
+// Why: the Python-side columnar packer costs ~0.9µs/msg (attr access,
+// per-string encode, length arrays — more than the ENTIRE C crypto
+// leg after the S2K removal). Extracting fields here instead reads
+// each str's cached UTF-8 in place (zero-copy for ASCII), turning the
+// residual Python share into ~5 C-API calls per message.
+// Safety: `ehc_py_abi_probe` verifies the assumed PyObject layout
+// (ob_type at offset 8, non-debug non-free-threaded build) against a
+// live str before the lane is enabled; any drift disables it and the
+// blob ABI above stays the path. Exact types only — a str/int
+// subclass or any error demotes the whole batch (return 2) to the
+// Python packer, which owns the canonical error surface.
+
+extern "C" {
+struct PyObj {
+  long long ob_refcnt;  // Py_ssize_t (union in 3.12+, same size/offset)
+  void *ob_type;
+};
+PyObj *PySequence_GetItem(PyObj *, long long);
+PyObj *PyObject_GetAttr(PyObj *, PyObj *);
+PyObj *PyUnicode_FromString(const char *);
+const char *PyUnicode_AsUTF8AndSize(PyObj *, long long *);
+long long PyLong_AsLongLong(PyObj *);
+double PyFloat_AsDouble(PyObj *);
+void Py_DecRef(PyObj *);
+PyObj *PyErr_Occurred(void);
+void PyErr_Clear(void);
+void *PyEval_SaveThread(void);
+void PyEval_RestoreThread(void *);
+extern char PyUnicode_Type, PyLong_Type, PyFloat_Type, PyBool_Type;
+extern char _Py_NoneStruct;
+}
+
+namespace {
+
+struct PyRefs {
+  std::vector<PyObj *> refs;
+  ~PyRefs() {
+    for (PyObj *o : refs) Py_DecRef(o);
+  }
+  PyObj *keep(PyObj *o) {
+    if (o) refs.push_back(o);
+    return o;
+  }
+};
+
+// Drop the GIL for a pure-C region (the seal loop touches no Python
+// state — only Row fields and the strs' cached UTF-8 buffers, pinned
+// alive by PyRefs; str is immutable, so concurrent threads can't
+// move the bytes out from under us). Scoped so EVERY exit path —
+// including the error returns inside the loop — restores the GIL
+// before PyRefs' Py_DecRefs run (reverse destruction order).
+struct GilScope {
+  void *tstate;
+  GilScope() : tstate(PyEval_SaveThread()) {}
+  ~GilScope() { PyEval_RestoreThread(tstate); }
+};
+
+// Exact-str extraction: → utf8 pointer + BYTE length (the interned
+// rep CPython caches on the object — no copy for compact ASCII).
+inline bool py_str(PyObj *o, const char **s, long long *n) {
+  if (!o || o->ob_type != static_cast<void *>(&PyUnicode_Type)) return false;
+  *s = PyUnicode_AsUTF8AndSize(o, n);
+  if (!*s) { PyErr_Clear(); return false; }  // lone surrogates etc.
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Layout sanity gate for the self-declared CPython ABI: called with a
+// known one-char str; any mismatch (debug build, free-threaded
+// layout, future drift) returns nonzero and the binding never uses
+// the lane. MUST be called via PyDLL (GIL held).
+int ehc_py_abi_probe(PyObj *sample) {
+  if (!sample || sample->ob_type != static_cast<void *>(&PyUnicode_Type))
+    return 1;
+  long long n = 0;
+  const char *s = PyUnicode_AsUTF8AndSize(sample, &n);
+  if (!s) { PyErr_Clear(); return 2; }
+  return (n == 1 && s[0] == 'x') ? 0 : 3;
+}
+
+// aead-batch-v1 push leg over the message OBJECTS: extraction +
+// content assembly + seal in one GIL-held call. `messages` is the
+// CrdtMessage sequence; key32/salt16 from the Python AeadSession.
+// Output: the SyncRequest field-1 stream (caller appends fields
+// 2/3/4). Returns 0 ok; 2 = shape demotion (any non-exact type,
+// int64 overflow, surrogate) → caller falls back to the blob packer.
+int ehc_aead_encrypt_push_py(PyObj *messages, int64_t n,
+                             const uint8_t *key32, const uint8_t *salt16,
+                             uint8_t **out_blob, int64_t *out_len) {
+  Ctxs cx;
+  if (!cx.ok() || n < 0 || !messages) return 1;
+  PyRefs names;
+  PyObj *a_ts = names.keep(PyUnicode_FromString("timestamp"));
+  PyObj *a_t = names.keep(PyUnicode_FromString("table"));
+  PyObj *a_r = names.keep(PyUnicode_FromString("row"));
+  PyObj *a_c = names.keep(PyUnicode_FromString("column"));
+  PyObj *a_v = names.keep(PyUnicode_FromString("value"));
+  if (!a_ts || !a_t || !a_r || !a_c || !a_v) { PyErr_Clear(); return 1; }
+
+  struct Row {
+    const char *ts, *t, *r, *c, *s;
+    long long tsl, tl, rl, cl, sl;
+    int8_t vkind;
+    int64_t ival;
+    double dval;
+  };
+  std::vector<Row> rows(static_cast<size_t>(n));
+  PyRefs held;  // every attr value stays alive until assembly is done
+  held.refs.reserve(static_cast<size_t>(n) * 5 + 1);
+  size_t out_total = 0;
+  std::vector<size_t> clen(static_cast<size_t>(n)), inner(static_cast<size_t>(n));
+  for (int64_t i = 0; i < n; i++) {
+    PyObj *m = held.keep(PySequence_GetItem(messages, i));
+    if (!m) { PyErr_Clear(); return 2; }
+    Row &w = rows[size_t(i)];
+    if (!py_str(held.keep(PyObject_GetAttr(m, a_ts)), &w.ts, &w.tsl) ||
+        !py_str(held.keep(PyObject_GetAttr(m, a_t)), &w.t, &w.tl) ||
+        !py_str(held.keep(PyObject_GetAttr(m, a_r)), &w.r, &w.rl) ||
+        !py_str(held.keep(PyObject_GetAttr(m, a_c)), &w.c, &w.cl)) {
+      PyErr_Clear();
+      return 2;
+    }
+    PyObj *v = held.keep(PyObject_GetAttr(m, a_v));
+    if (!v) { PyErr_Clear(); return 2; }
+    void *vt = v->ob_type;
+    w.s = nullptr; w.sl = 0; w.ival = 0; w.dval = 0.0;
+    if (static_cast<void *>(v) == static_cast<void *>(&_Py_NoneStruct)) {
+      w.vkind = 0;
+    } else if (vt == static_cast<void *>(&PyUnicode_Type)) {
+      if (!py_str(v, &w.s, &w.sl)) return 2;
+      w.vkind = 1;
+    } else if (vt == static_cast<void *>(&PyLong_Type) ||
+               vt == static_cast<void *>(&PyBool_Type)) {
+      w.ival = PyLong_AsLongLong(v);
+      if (w.ival == -1 && PyErr_Occurred()) { PyErr_Clear(); return 2; }
+      w.vkind = 2;
+    } else if (vt == static_cast<void *>(&PyFloat_Type)) {
+      w.dval = PyFloat_AsDouble(v);
+      w.vkind = 3;
+    } else {
+      return 2;  // exotic value → the Python packer/oracle decides
+    }
+    size_t c = content_size_cols(int32_t(w.tl), int32_t(w.rl), int32_t(w.cl),
+                                 int32_t(w.sl), w.vkind, w.ival);
+    size_t ct = c + AEAD_OVERHEAD;
+    size_t in = 1 + wire_varint_size(uint64_t(w.tsl)) + size_t(w.tsl) +
+                1 + wire_varint_size(ct) + ct;
+    clen[size_t(i)] = c;
+    inner[size_t(i)] = in;
+    out_total += 1 + wire_varint_size(in) + in;
+  }
+
+  uint8_t *out = static_cast<uint8_t *>(malloc(out_total ? out_total : 1));
+  if (!out) return 1;
+  // Extraction is done: the seal loop below is pure C (the Rows point
+  // into strs PyRefs keeps alive), so other Python threads may run.
+  GilScope gil;
+  std::vector<uint8_t> rnd(size_t(n) * AEAD_NONCE);
+  if (n && !RAND_bytes(rnd.data(), int(rnd.size()))) { free(out); return 1; }
+  std::vector<uint8_t> plainbuf;
+  uint8_t *p = out;
+  for (int64_t i = 0; i < n; i++) {
+    const Row &w = rows[size_t(i)];
+    size_t c = clen[size_t(i)];
+    *p++ = 0x0A;  // SyncRequest.messages, field 1, wt 2
+    p = wire_put_varint(p, uint64_t(inner[size_t(i)]));
+    *p++ = 0x0A;  // EncryptedCrdtMessage.timestamp
+    p = wire_put_varint(p, uint64_t(w.tsl));
+    memcpy(p, w.ts, size_t(w.tsl));
+    p += w.tsl;
+    *p++ = 0x12;  // EncryptedCrdtMessage.content, field 2, wt 2
+    p = wire_put_varint(p, uint64_t(c + AEAD_OVERHEAD));
+    plainbuf.resize(c ? c : 1);
+    uint8_t *end = put_content_cols(
+        plainbuf.data(), reinterpret_cast<const uint8_t *>(w.t), int32_t(w.tl),
+        reinterpret_cast<const uint8_t *>(w.r), int32_t(w.rl),
+        reinterpret_cast<const uint8_t *>(w.c), int32_t(w.cl),
+        reinterpret_cast<const uint8_t *>(w.s), int32_t(w.sl), w.vkind,
+        w.ival, w.dval);
+    if (size_t(end - plainbuf.data()) != c ||
+        !aead_seal_record(cx, key32, salt16, rnd.data() + AEAD_NONCE * i,
+                          plainbuf.data(), c, p)) {
+      free(out);
+      return 1;
+    }
+    p += c + AEAD_OVERHEAD;
+  }
+  if (size_t(p - out) != out_total) { free(out); return 1; }
+  *out_blob = out;
+  *out_len = int64_t(out_total);
+  return 0;
+}
+
+}  // extern "C"
+
+extern "C" {
+
 namespace {
 
 // New-format definite-length packet walk. Returns false on anything
@@ -519,6 +1044,16 @@ bool decrypt_one(Ctxs &cx, const uint8_t *msg, size_t clen,
                  const uint8_t *password, size_t pw_len,
                  std::vector<uint8_t> &plain, std::vector<Pkt> &pkts,
                  std::vector<Pkt> &inner, Content &c) {
+  if (is_aead_record(msg, clen)) {
+    // aead-batch-v1 record: session-keyed GCM instead of per-message
+    // S2K. Every decrypt entry point (batch, fused response, fused
+    // columns) gains v2 through this one dispatch; any failure —
+    // truncation, bad tag — demotes to the Python oracle, which owns
+    // the exact PgpError surface.
+    if (!aead_open_record(cx, msg, clen, password, pw_len, plain))
+      return false;
+    return decode_content(plain.data(), plain.size(), c);
+  }
   static const uint8_t zero_iv[16] = {0};
   pkts.clear();
   if (!read_packets(msg, clen, pkts)) return false;
@@ -807,6 +1342,11 @@ int ehc_decrypt_response_columns(const uint8_t *resp, int64_t resp_len,
   std::vector<int32_t> cell_ids, vlens, cell_lens;
   std::string vkinds, ts_slab, vblob, cell_blob;
   std::unordered_map<std::string, int32_t> intern;
+  // Cold syncs intern ~one cell per row: pre-size for the worst case
+  // (a v2 record is ≥90 wire bytes) so the map never rehashes
+  // mid-batch — rehash churn measured as a visible share of the
+  // unique-cell decode.
+  intern.reserve(size_t(resp_len / 90) + 8);
   std::string keybuf;
 
   size_t pos = 0;
@@ -865,15 +1405,13 @@ int ehc_decrypt_response_columns(const uint8_t *resp, int64_t resp_len,
     if (c.tl) keybuf.append(reinterpret_cast<const char *>(c.t), c.tl);
     if (c.rl) keybuf.append(reinterpret_cast<const char *>(c.r), c.rl);
     if (c.cl) keybuf.append(reinterpret_cast<const char *>(c.c), c.cl);
-    auto it = intern.find(keybuf);
-    int32_t cid;
-    if (it != intern.end()) {
-      cid = it->second;
-    } else {
+    // try_emplace hashes once for both the hit and the miss lane
+    // (find+emplace double-hashed every unique cell).
+    auto ins = intern.try_emplace(keybuf, int32_t(intern.size()));
+    int32_t cid = ins.first->second;
+    if (ins.second) {  // newly interned triple
       if (!utf8_ok(c.t, c.tl) || !utf8_ok(c.r, c.rl) || !utf8_ok(c.c, c.cl))
-        return 3;
-      cid = int32_t(intern.size());
-      intern.emplace(keybuf, cid);
+        return 3;  // whole batch → object path; the map dies with us
       cell_lens.push_back(int32_t(c.tl));
       cell_lens.push_back(int32_t(c.rl));
       cell_lens.push_back(int32_t(c.cl));

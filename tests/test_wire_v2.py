@@ -1,0 +1,493 @@
+"""Batched-AEAD v2 sync wire (`aead-batch-v1`, ISSUE 8) — sync/aead.py
++ the C twin in native/evolu_crypto.cpp.
+
+Pins the four contracts the capability rests on:
+- format disjointness + parity: a v2 record can never parse as OpenPGP
+  (and vice versa), and the pure/native legs produce interchangeable
+  bytes — either side decrypts the other's records.
+- tamper surface: mutation/truncation anywhere in a record or its
+  carrying wire raises ONLY ValueError (framing) / PgpError (record),
+  never wedges, never partially applies a leg.
+- mixed logs: one owner negotiated and one not must land the exact
+  SQLite end state of an all-v1 oracle (records self-describe; the
+  store, Merkle algebra, and apply path are version-blind).
+- downgrade: a failover to a relay that did not advertise the
+  capability silently re-emits v1 — v2 records must never reach a
+  non-negotiated relay (2-relay fleet regression).
+"""
+
+import random
+
+import pytest
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.storage import apply_messages
+from evolu_tpu.sync import aead, native_crypto, protocol
+from evolu_tpu.sync.client import decrypt_messages, encrypt_messages_v2
+from evolu_tpu.sync.crypto import PgpError, encrypt_symmetric
+
+from tests.test_apply import MNEMONIC as MN, dump, make_db
+
+# Every CrdtValue kind, NULs (the char*-ABI trap), unicode, int64
+# edges, float specials — the same adversarial matrix the v1 parity
+# tests use.
+VALUES = [
+    None, "", "x", "héllo ✓ café", "with\x00nul\x00s", "日本語",
+    True, False, 0, 1, -1, 2**31 - 1, -(2**31), 2**63 - 1, -(2**63),
+    3.14159, -0.0, 1e308, float("inf"),
+]
+
+
+def _msgs(values=VALUES):
+    return tuple(
+        CrdtMessage(f"ts{i}", "todo\x00tbl", f"row-{i}", "col\x00umn", v)
+        for i, v in enumerate(values)
+    )
+
+
+def _canon(m):
+    v = int(m.value) if isinstance(m.value, bool) else m.value
+    return CrdtMessage(m.timestamp, m.table, m.row, m.column, v)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_sessions():
+    aead.reset_sessions()
+    yield
+    aead.reset_sessions()
+
+
+# --- record format ---
+
+
+def test_record_roundtrip_and_format_disjointness():
+    s = aead.get_session(MN)
+    for pt in (b"", b"\x00", b"content \x00 with NULs \xff", b"x" * 5000):
+        rec = aead.encrypt_record(s.key, s.salt, pt)
+        assert aead.is_v2_record(rec)
+        assert aead.decrypt_record(rec, MN) == pt
+        assert aead.decrypt_content(rec, MN) == pt
+        # A v2 record is NOT an OpenPGP packet stream: byte 0 has bit 7
+        # clear, which no valid CTB can.
+        with pytest.raises(PgpError):
+            from evolu_tpu.sync.crypto import decrypt_symmetric
+
+            decrypt_symmetric(rec, MN)
+    # ...and an OpenPGP message is NOT a v2 record: the dispatch sends
+    # it down the v1 path, where it decrypts fine.
+    ct = encrypt_symmetric(b"v1 payload", MN)
+    assert not aead.is_v2_record(ct)
+    assert aead.decrypt_content(ct, MN) == b"v1 payload"
+    # Wrong key is tamper-shaped: PgpError, not a third type.
+    rec = aead.encrypt_record(s.key, s.salt, b"secret")
+    with pytest.raises(PgpError):
+        aead.decrypt_record(rec, "wrong mnemonic words")
+
+
+def test_session_rotates_before_gcm_nonce_bound():
+    """Random 96-bit nonces cap a GCM key at 2^32 invocations (NIST
+    SP 800-38D); the session must retire itself WELL under that. A
+    request that would cross SESSION_RECORD_LIMIT mints a fresh
+    salt+key, and records sealed under the retired key stay
+    decryptable (the salt rides every record)."""
+    s1 = aead.get_session(MN, records=aead.SESSION_RECORD_LIMIT - 1)
+    assert aead.get_session(MN) is s1  # still under the bound
+    rec = aead.encrypt_record(s1.key, s1.salt, b"old key epoch")
+    s2 = aead.get_session(MN, records=2)  # would cross → rotate
+    assert s2 is not s1 and s2.salt != s1.salt and s2.key != s1.key
+    assert s2.used == 2
+    assert aead.decrypt_record(rec, MN) == b"old key epoch"
+
+
+def test_session_caching_and_reset():
+    s1 = aead.get_session(MN)
+    assert aead.get_session(MN) is s1  # one HKDF per (owner, session)
+    other = aead.get_session("other words")
+    assert other.key != s1.key and other.salt != s1.salt
+    aead.reset_sessions()
+    s2 = aead.get_session(MN)
+    assert s2 is not s1 and s2.salt != s1.salt  # fresh salt, fresh key
+    # Records from the RETIRED session still decrypt (salt rides every
+    # record; the decrypt side re-derives on miss).
+    rec = aead.encrypt_record(s1.key, s1.salt, b"old session")
+    aead.reset_sessions()
+    assert aead.decrypt_record(rec, MN) == b"old session"
+
+
+# --- pure <-> native parity ---
+
+
+@pytest.mark.skipif(not native_crypto.native_available(),
+                    reason="libevolu_crypto unavailable")
+def test_native_encode_pure_decrypt_parity():
+    """`ehc_aead_encrypt_wire_batch` bytes must be a decodable
+    SyncRequest whose records the PURE oracle opens to the exact
+    contents — the two HKDF/GCM implementations must interoperate
+    bit-for-bit (same info string, same record layout)."""
+    msgs = _msgs()
+    s = aead.get_session(MN)
+    body = native_crypto.encode_push_request_aead(
+        msgs, s.key, s.salt, "user-1", "f" * 16, '{"h":1}')
+    assert body is not None
+    req = protocol.decode_sync_request(body)
+    assert (req.user_id, req.node_id, req.merkle_tree) == ("user-1", "f" * 16, '{"h":1}')
+    assert len(req.messages) == len(msgs)
+    for m, e in zip(msgs, req.messages):
+        assert e.timestamp == m.timestamp
+        assert aead.is_v2_record(e.content)
+        got = protocol.decode_content(aead.decrypt_record(e.content, MN))
+        assert got == (m.table, m.row, m.column,
+                       int(m.value) if isinstance(m.value, bool) else m.value)
+    # Trailing scalar fields identical to the pure encoder's.
+    tail = protocol.encode_sync_request(
+        protocol.SyncRequest((), "user-1", "f" * 16, '{"h":1}'))
+    assert body.endswith(tail)
+    # Nonces are per-record random: no two records share one, and a
+    # re-encode of the same batch never repeats bytes.
+    nonces = {e.content[19:31] for e in req.messages}
+    assert len(nonces) == len(msgs)
+    body2 = native_crypto.encode_push_request_aead(
+        msgs, s.key, s.salt, "user-1", "f" * 16, '{"h":1}')
+    assert body2 != body
+
+
+@pytest.mark.skipif(not native_crypto.native_available(),
+                    reason="libevolu_crypto unavailable")
+def test_pure_encode_native_decrypt_parity():
+    """The reverse leg: PURE v2 records served in a response must
+    decode through the fused C paths to the canonical messages (the C
+    `decrypt_one` dispatches on the record magic)."""
+    msgs = tuple(
+        CrdtMessage(
+            timestamp_to_string(
+                Timestamp(1_700_000_000_000 + i * 1000, i % 4, "a1b2c3d4e5f60718")),
+            "todo", f"row-{i:05d}", "title", v)
+        for i, v in enumerate(VALUES)
+    )
+    enc = encrypt_messages_v2(msgs, MN)
+    resp = protocol.encode_sync_response(protocol.SyncResponse(enc, '{"t":9}'))
+    fused = native_crypto.decrypt_response(resp, MN)
+    assert fused is not None
+    got, tree = fused
+    assert tree == '{"t":9}'
+    assert got == tuple(_canon(m) for m in msgs)
+    # And the object-path oracle agrees.
+    assert decrypt_messages(enc, MN) == tuple(_canon(m) for m in msgs)
+
+
+# --- tamper surface ---
+
+
+def test_flipped_bit_pinned_cases():
+    """One deliberate bit flip in EVERY region of a record — salt,
+    nonce, ciphertext, tag — must surface as PgpError (the auth tag
+    covers the whole record; a flipped salt derives a wrong key, which
+    is indistinguishable from tamper). A flipped MAGIC demotes the
+    record to the OpenPGP parser, whose malformed-packet answer is
+    PgpError too — the surface never widens."""
+    s = aead.get_session(MN)
+    rec = aead.encrypt_record(s.key, s.salt, b"pinned payload")
+    for off in (0, 1, 2, 3, 10, 19, 25, 31, len(rec) - 16, len(rec) - 1):
+        bad = bytearray(rec)
+        bad[off] ^= 0x40
+        with pytest.raises(PgpError):
+            aead.decrypt_content(bytes(bad), MN)
+
+
+def test_truncated_envelope_pinned_cases():
+    """Every truncation point — inside the header, inside the
+    ciphertext, inside the tag — raises PgpError; prefix-extensions
+    raise too (the tag authenticates exact length)."""
+    s = aead.get_session(MN)
+    rec = aead.encrypt_record(s.key, s.salt, b"pinned payload")
+    for k in (3, 4, 18, 19, 30, 31, 46, len(rec) - 17, len(rec) - 1):
+        with pytest.raises(PgpError):
+            aead.decrypt_record(rec[:k], MN)
+    with pytest.raises(PgpError):
+        aead.decrypt_record(rec + b"\x00", MN)
+    # The 46-byte boundary case: a record with EMPTY plaintext is
+    # exactly RECORD_OVERHEAD long and valid…
+    empty = aead.encrypt_record(s.key, s.salt, b"")
+    assert len(empty) == aead.RECORD_OVERHEAD
+    assert aead.decrypt_record(empty, MN) == b""
+    # …one byte shorter is the canonical truncation error.
+    with pytest.raises(PgpError):
+        aead.decrypt_record(empty[:-1], MN)
+
+
+def test_mutation_fuzz_record_native_matches_oracle():
+    """120 trials of bit flips / deletions / insertions on a v2 record:
+    the native batch path must produce the oracle's value or raise the
+    oracle's error type — never a third outcome, never a wedge."""
+    rng = random.Random(0x0E2)
+    s = aead.get_session(MN)
+    base = [
+        aead.encrypt_record(
+            s.key, s.salt, protocol.encode_content("todo", f"r{i}", "title", v))
+        for i, v in enumerate(["fuzz-me", 42, None, 2.5])
+    ]
+    native_ok = native_crypto.native_available()
+    for trial in range(120):
+        ct = bytearray(rng.choice(base))
+        for _ in range(rng.randint(1, 4)):
+            op = rng.random()
+            if op < 0.5 and ct:
+                ct[rng.randrange(len(ct))] ^= 1 << rng.randrange(8)
+            elif op < 0.75 and len(ct) > 2:
+                del ct[rng.randrange(len(ct))]
+            else:
+                ct.insert(rng.randrange(len(ct) + 1), rng.randrange(256))
+        enc = (protocol.EncryptedCrdtMessage("t", bytes(ct)),)
+        try:
+            oracle = protocol.decode_content(aead.decrypt_content(bytes(ct), MN))
+        except (PgpError, ValueError) as e:
+            oracle = type(e)
+        assert oracle in (PgpError, ValueError) or isinstance(oracle, tuple)
+        if not native_ok:
+            continue
+        try:
+            (m,) = native_crypto.decrypt_batch(enc, MN)
+            got = (m.table, m.row, m.column, m.value)
+        except (PgpError, ValueError) as e:
+            got = type(e)
+        assert got == oracle, f"trial {trial}: oracle {oracle!r} vs got {got!r}"
+
+
+def test_mutation_fuzz_response_wire_never_diverges():
+    """Mutations of FULL response bytes carrying v2 records: whenever
+    the fused C walker accepts the wire, its outcome equals the pure
+    decode+decrypt outcome exactly (value or error type); a None means
+    production runs the pure path, equal by definition."""
+    if not native_crypto.native_available():
+        pytest.skip("libevolu_crypto unavailable")
+    rng = random.Random(0x5A17)
+    enc = encrypt_messages_v2(_msgs(["a", 7, None]), MN)
+    base = protocol.encode_sync_response(protocol.SyncResponse(enc, '{"x":1}'))
+    for trial in range(120):
+        b = bytearray(base)
+        for _ in range(rng.randint(1, 5)):
+            op = rng.random()
+            if op < 0.6 and b:
+                b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+            elif op < 0.8 and len(b) > 2:
+                del b[rng.randrange(len(b))]
+            else:
+                b.insert(rng.randrange(len(b) + 1), rng.randrange(256))
+        data = bytes(b)
+        try:
+            fused = native_crypto.decrypt_response(data, MN)
+        except (PgpError, ValueError) as e:
+            fused = type(e)
+        if fused is None:
+            continue
+        try:
+            resp = protocol.decode_sync_response(data)
+            oracle = (decrypt_messages(resp.messages, MN), resp.merkle_tree)
+        except (PgpError, ValueError) as e:
+            oracle = type(e)
+        assert fused == oracle, f"trial {trial}"
+
+
+def test_tampered_leg_is_one_error_never_partial():
+    """Tamper ANYWHERE in a multi-record leg surfaces as ONE PgpError
+    for the whole leg — the decrypt raises before anything is
+    returned, so the apply layer never sees a partial batch (exactly
+    the v1 per-message MDC contract)."""
+    msgs = _msgs(["a", "b", "c", 1, 2.5, None])
+    enc = list(encrypt_messages_v2(msgs, MN))
+    bad = bytearray(enc[3].content)
+    bad[-1] ^= 0x01  # inside the GCM tag
+    enc[3] = protocol.EncryptedCrdtMessage(enc[3].timestamp, bytes(bad))
+    with pytest.raises(PgpError):
+        decrypt_messages(tuple(enc), MN)
+    if native_crypto.native_available():
+        resp = protocol.encode_sync_response(
+            protocol.SyncResponse(tuple(enc), "{}"))
+        with pytest.raises(PgpError):
+            native_crypto.decrypt_response(resp, MN)
+
+
+# --- mixed v1/v2 logs ---
+
+
+def test_mixed_batch_end_state_matches_all_v1_oracle():
+    """One owner negotiated (v2 records), one not (v1 OpenPGP), pushed
+    through the REAL relay serve path and pulled cold: the decrypted
+    messages and the applied SQLite end state must be byte-identical
+    to an all-v1 oracle run of the same logical messages. The store,
+    Merkle algebra, and apply path never see the wire version."""
+    from evolu_tpu.server.relay import RelayStore
+    from tests.test_apply import random_messages
+
+    rng = random.Random(42)
+    msgs_a = tuple(random_messages(rng, 60))
+    msgs_b = tuple(random_messages(rng, 60))
+
+    def encrypted(msgs, v2):
+        from evolu_tpu.sync.client import encrypt_messages
+
+        return (encrypt_messages_v2 if v2 else encrypt_messages)(msgs, MN)
+
+    def run(owner_wire):  # {"A": v2?, "B": v2?} → (decrypted, dumps)
+        store = RelayStore()
+        try:
+            decrypted, dumps = {}, {}
+            for owner, v2 in owner_wire.items():
+                msgs = msgs_a if owner == "A" else msgs_b
+                store.sync(protocol.SyncRequest(
+                    encrypted(msgs, v2), owner, "b" * 16, "{}"))
+            for owner in owner_wire:
+                resp = store.sync(protocol.SyncRequest((), owner, "c" * 16, "{}"))
+                got = decrypt_messages(resp.messages, MN)
+                decrypted[owner] = got
+                db = make_db()
+                apply_messages(db, {}, got)
+                dumps[owner] = dump(db)
+            return decrypted, dumps
+        finally:
+            store.close()
+
+    mixed = run({"A": True, "B": False})
+    oracle = run({"A": False, "B": False})
+    assert mixed[0] == oracle[0]  # same decrypted CrdtMessages…
+    assert mixed[1] == oracle[1]  # …and the same SQLite end state
+
+
+# --- v1 wire byte-identity when not negotiated ---
+
+
+def test_v1_wire_byte_exact_when_capability_not_negotiated():
+    """With `aead-batch-v1` absent from the negotiated set the
+    transport's encode MUST be the pre-PR path: the fused C v1
+    encoder's exact output plus the PR-7 capability suffix — and with
+    nothing advertised, the v1 wire byte-for-byte (extends the PR-7
+    byte-identity pin; the OpenPGP salts are the only nondeterminism,
+    so the message-less framing is pinned to exact bytes and the
+    message-bearing path is pinned to the exact encoder call)."""
+    from evolu_tpu.core.types import Owner
+    from evolu_tpu.runtime.messages import SyncRequestInput
+    from evolu_tpu.sync.client import SyncTransport
+    from evolu_tpu.sync.crypto import decrypt_symmetric
+    from evolu_tpu.utils.config import Config
+
+    owner = Owner(id="owner-1", mnemonic=MN)
+    tr = SyncTransport(Config(sync_url="http://127.0.0.1:9"), lambda *a: None)
+    try:
+        node = "a1b2c3d4e5f60718"
+        empty = SyncRequestInput((), "unused", '{"h":1}', owner)
+        # Message-less round: fully deterministic — pin exact bytes.
+        v1_bytes = protocol.encode_sync_request(
+            protocol.SyncRequest((), owner.id, node, '{"h":1}'))
+        assert tr._encode_push(empty, node, (), False) == v1_bytes
+        caps = tuple(protocol.KNOWN_CAPABILITIES)
+        assert tr._encode_push(empty, node, caps, False) == (
+            v1_bytes + protocol.encode_request_capabilities(caps))
+        # Message-bearing round, capability advertised but NOT
+        # negotiated: every record is strict OpenPGP (decrypts via the
+        # v1-only oracle; no v2 magic anywhere) and the body is the
+        # pre-PR layout — v1 messages stream + scalar tail + suffix.
+        push = SyncRequestInput(_msgs(["x", 1, None]), "unused", "{}", owner)
+        body = tr._encode_push(push, node, caps, False)
+        suffix = protocol.encode_request_capabilities(caps)
+        assert body.endswith(suffix)
+        req = protocol.decode_sync_request(body)
+        assert req.capabilities == caps
+        for e in req.messages:
+            assert not aead.is_v2_record(e.content)
+            decrypt_symmetric(e.content, MN)  # raises if not OpenPGP
+        # The gate itself: an un-echoed relay never selects v2.
+        assert not tr._aead_negotiated("http://x/", caps)
+        tr.negotiated_capabilities["http://x/"] = (protocol.CAP_CRDT_TYPES,)
+        assert not tr._aead_negotiated("http://x/", caps)
+        tr.negotiated_capabilities["http://x/"] = (protocol.CAP_AEAD_BATCH,)
+        assert tr._aead_negotiated("http://x/", caps)
+        assert not tr._aead_negotiated("http://x/", ())  # not advertised
+    finally:
+        tr.stop()
+
+
+# --- negotiation + failover downgrade ---
+
+
+def test_v2_only_after_negotiation_then_fleet_failover_downgrades():
+    """The emission gate end-to-end through a 2-relay fleet: the
+    client sends v1 until the relay's echo lands, v2 after — and a
+    FAILOVER to a replica that never advertised the capability
+    silently re-emits the round as v1 (regression: the cached
+    negotiated set must be invalidated alongside the cached route;
+    a v2 record must never reach a non-negotiated relay)."""
+    from evolu_tpu.api import model
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.runtime.client import create_evolu
+    from evolu_tpu.server.relay import RelayServer, RelayStore
+    from evolu_tpu.sync.client import connect
+    from evolu_tpu.utils.config import Config, FleetConfig
+
+    SCHEMA = {"todo": ("title", "isCompleted", *model.COMMON_COLUMNS)}
+
+    def stored_contents(server):
+        return [
+            bytes(r["content"]) for r in
+            server.store.db.exec_sql_query('SELECT content FROM "message"')
+        ]
+
+    # A = current relay (advertises aead-batch-v1); B = a v1 replica
+    # (echoes nothing). rf=2 places every owner on both, so either
+    # serves locally — the failover under test is the CLIENT's.
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    b = RelayServer(RelayStore(), capabilities=(), peers=[],
+                    replication_interval_s=30).start()
+    cfg = FleetConfig(relays=(a.url, b.url), replication_factor=2, version=1)
+    a.enable_fleet(cfg)
+    b.enable_fleet(cfg)
+    evolu = None
+    try:
+        evolu = create_evolu(SCHEMA, config=Config(sync_url=b.url))
+        tr = connect(evolu)
+        owner = evolu.owner.id
+        # The learned route points at A (as a fleet 307 would have
+        # left it) — rounds go to A while it lives.
+        tr._routes[owner] = a.url + "/"
+
+        def round_trip():
+            evolu.worker.flush(); tr.flush(); evolu.worker.flush()
+
+        # Round 1: nothing negotiated yet — v1 wire, but A's echo
+        # lands the capability set.
+        evolu.create("todo", {"title": "r1", "isCompleted": False})
+        round_trip()
+        assert protocol.CAP_AEAD_BATCH in tr.negotiated_capabilities[a.url + "/"]
+        assert not any(aead.is_v2_record(c) for c in stored_contents(a))
+        # Round 2: negotiated — v2 records land at A.
+        evolu.create("todo", {"title": "r2", "isCompleted": False})
+        round_trip()
+        assert any(aead.is_v2_record(c) for c in stored_contents(a))
+        # A dies. The next round must fail over to the configured
+        # relay B and re-emit ITSELF as v1 — B never advertised.
+        a.stop()
+        errors = []
+        evolu.subscribe_error(errors.append)
+        before = metrics.get_counter(
+            "evolu_crypto_v1_fallback_total", reason="failover")
+        evolu.create("todo", {"title": "r3", "isCompleted": False})
+        round_trip()
+        assert not errors
+        assert a.url + "/" not in tr.negotiated_capabilities
+        contents_b = stored_contents(b)
+        assert contents_b, "failover round never reached relay B"
+        assert not any(aead.is_v2_record(c) for c in contents_b), \
+            "v2 record sent to a relay that did not advertise aead-batch-v1"
+        assert metrics.get_counter(
+            "evolu_crypto_v1_fallback_total", reason="failover") == before + 1
+        # B's echo is capability-less: the gate stays v1 at B.
+        assert protocol.CAP_AEAD_BATCH not in tr.negotiated_capabilities.get(
+            b.url, ())
+    finally:
+        if evolu is not None:
+            evolu.dispose()
+        b.stop()
+        try:
+            a.stop()
+        except Exception:
+            pass
