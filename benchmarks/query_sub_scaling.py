@@ -1,0 +1,243 @@
+"""Mutation→notify latency vs live-subscription count (ISSUE 9).
+
+The reference re-runs every subscribed query after every mutation
+(query.ts:31-76); r9 gates that sweep on the merge planner's
+changed-set (runtime/worker.py::_query × storage/deps.py). This bench
+sweeps 10/100/1k/10k subscriptions over three write shapes and
+measures the worker's mutation→notify wall time (one Send carrying the
+mutation plus the full subscribed-query sweep, handled synchronously):
+
+  table_disjoint — subscriptions read "todo", the write lands in
+                   "other": every query skips without a read.
+  row_disjoint   — per-row detail subscriptions (`WHERE "id" = ?`),
+                   the write lands in an unsubscribed row: every query
+                   skips on the static id constraint.
+  overlap        — unconstrained list subscriptions over the written
+                   table: nothing can skip; measures pure gate
+                   overhead (must stay within 1.1× of ungated).
+
+`--smoke` is the CI oracle-parity gate: twin workers (gated vs
+re-run-everything) with pinned HLC nodes run one mixed schedule —
+disjoint/overlapping Sends, a canonical and a NON-CANONICAL Receive
+(host-oracle bounce), a rollback, eviction churn — and every output
+(patch streams, pushes) plus the SQLite end state must be identical,
+with the skip counters proven engaged.
+
+Prints ONE JSON line; numbers live in docs/BENCHMARKS.md (r9).
+"""
+
+import itertools
+import json
+import os
+import statistics
+import sys
+import time
+from dataclasses import replace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_string
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.core.types import CrdtClock, CrdtMessage, NewCrdtMessage, TableDefinition
+from evolu_tpu.obs import metrics
+from evolu_tpu.runtime import messages as msg
+from evolu_tpu.runtime.worker import DbWorker
+from evolu_tpu.storage.clock import read_clock, update_clock
+from evolu_tpu.storage.native import open_database
+from evolu_tpu.utils.config import Config
+
+MNEMONIC = ("abandon abandon abandon abandon abandon abandon "
+            "abandon abandon abandon abandon abandon about")
+EMPTY_TREE = merkle_tree_to_string(create_initial_merkle_tree())
+TDS = (
+    TableDefinition.of("todo", ("title", "done")),
+    TableDefinition.of("other", ("name",)),
+)
+SEED_ROWS = int(os.environ.get("QSS_SEED_ROWS", 512))
+
+
+def counting_now(base=1_700_000_000_000, step=7):
+    c = itertools.count()
+    return lambda: base + step * next(c)
+
+
+def make_worker(gated: bool):
+    db = open_database(":memory:")
+    outputs, pushes = [], []
+    cfg = Config(backend="cpu", winner_cache=False, query_invalidation=gated)
+    w = DbWorker(db, config=cfg, on_output=outputs.append,
+                 post_sync=pushes.append, now=counting_now())
+    w.start(MNEMONIC)
+    w.stop()  # drive handle() synchronously: no queue/thread noise
+    clock = read_clock(db)
+    with db.transaction():  # pin the node id → twin-run determinism
+        update_clock(db, CrdtClock(
+            replace(clock.timestamp, node="00c0ffee00c0ffee"), clock.merkle_tree))
+    w.handle(msg.UpdateDbSchema(TDS))
+    seed = tuple(NewCrdtMessage("todo", f"seed{i:05d}", "title", f"t{i:05d}")
+                 for i in range(SEED_ROWS))
+    w.handle(msg.Send(seed, (), ()))
+    w.handle(msg.Send((NewCrdtMessage("other", "o0", "name", "n0"),), (), ()))
+    outputs.clear()
+    pushes.clear()
+    return w, outputs, pushes
+
+
+def q_detail(i):
+    return msg.serialize_query(
+        'SELECT "id", "title", "done" FROM "todo" WHERE "id" = ?',
+        (f"seed{i:05d}",))
+
+
+def q_list(i):
+    # Distinct strings, unconstrained rows: the un-gateable shape.
+    return msg.serialize_query(
+        'SELECT "id", "title" FROM "todo" WHERE "done" = ? ORDER BY "title"',
+        (i,))
+
+
+def subscriptions(scenario: str, n: int):
+    if scenario == "row_disjoint":
+        return tuple(q_detail(i) for i in range(n))
+    if scenario == "overlap":
+        return tuple(q_list(i) for i in range(n))
+    # table_disjoint: realistic mix of detail + list, all reading todo.
+    return tuple(q_detail(i) if i % 2 else q_list(i) for i in range(n))
+
+
+def mutation(scenario: str, rep: int):
+    if scenario == "table_disjoint":
+        return NewCrdtMessage("other", "o0", "name", f"v{rep}")
+    if scenario == "row_disjoint":
+        # seed00000..: every detail sub targets its own row; write one
+        # PAST the subscribed range.
+        return NewCrdtMessage("todo", "unsubscribed-row", "title", f"v{rep}")
+    return NewCrdtMessage("todo", "seed00007", "done", rep)
+
+
+def run_scenario(scenario: str, n: int, gated: bool, reps: int):
+    w, outputs, _ = make_worker(gated)
+    qs = subscriptions(scenario, n)
+    w.handle(msg.Query(qs))  # establish baselines (+ dependency index)
+    outputs.clear()
+    lat = []
+    for rep in range(reps):
+        cmd = msg.Send((mutation(scenario, rep),), (), qs)
+        t0 = time.perf_counter()
+        w.handle(cmd)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    for o in outputs:
+        if isinstance(o, msg.OnError):
+            raise AssertionError(f"bench schedule errored: {o.error!r}")
+    w.db.close()
+    lat.sort()
+    return {
+        "p50_ms": round(statistics.median(lat), 4),
+        "p99_ms": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 4),
+    }
+
+
+def full_bench():
+    sweep = [int(x) for x in os.environ.get(
+        "QSS_SWEEP", "10,100,1000,10000").split(",")]
+    detail = {"seed_rows": SEED_ROWS, "sweep": sweep, "scenarios": {}}
+    for scenario in ("table_disjoint", "row_disjoint", "overlap"):
+        per_n = {}
+        for n in sweep:
+            reps = int(os.environ.get("QSS_REPS", 10 if n >= 10_000 else 30))
+            gated = run_scenario(scenario, n, True, reps)
+            naive = run_scenario(scenario, n, False, reps)
+            per_n[n] = {
+                "gated": gated, "ungated": naive,
+                "speedup_p50": round(naive["p50_ms"] / max(gated["p50_ms"], 1e-9), 2),
+            }
+        detail["scenarios"][scenario] = per_n
+    top = max(n for n in detail["scenarios"]["row_disjoint"])
+    print(json.dumps({
+        "metric": "query_sub_scaling_speedup_p50_at_max_subs",
+        "value": detail["scenarios"]["row_disjoint"][top]["speedup_p50"],
+        "unit": "x",
+        "detail": detail,
+    }))
+
+
+# -- smoke: the oracle-parity gate -------------------------------------
+
+
+def remote_ts(i, counter=0, upper=False):
+    s = timestamp_to_string(
+        Timestamp(1_700_000_000_000 + i, counter, "00000000000000ab"))
+    return s[:30] + s[30:].upper() if upper else s
+
+
+def smoke_schedule():
+    qs = tuple([q_detail(i) for i in range(16)]
+               + [q_list(i) for i in range(16)]
+               + [msg.serialize_query('SELECT "id", "name" FROM "other" ORDER BY "id"')])
+    canonical = tuple(
+        CrdtMessage(remote_ts(i, counter=i), "todo", f"rem{i % 3}", "title", f"m{i}")
+        for i in range(6))
+    non_canonical = tuple(
+        CrdtMessage(remote_ts(50 + i, counter=i, upper=True),
+                    "todo", "seed00003", "done", i)
+        for i in range(3))
+    steps = [msg.Query(qs)]
+    for rep in range(4):
+        steps += [
+            msg.Send((mutation("table_disjoint", rep),), (), qs),
+            msg.Send((mutation("row_disjoint", rep),), (), qs),
+            msg.Send((mutation("overlap", rep),), (f"cb{rep}",), qs),
+            msg.Query(qs),
+        ]
+    steps += [
+        msg.Receive(canonical, EMPTY_TREE), msg.Query(qs),
+        msg.Receive(non_canonical, EMPTY_TREE), msg.Query(qs),
+        # rollback: un-encodable value refuses before any write
+        msg.Send((NewCrdtMessage("todo", "seed00001", "title", b"\x00"),), (), qs),
+        msg.Query(qs),
+        msg.EvictQueries(qs[:4]),
+        msg.Query(qs),
+        msg.Sync(qs),
+    ]
+    return steps
+
+
+def smoke():
+    before = {k: metrics.get_counter(k) for k in (
+        "evolu_query_skipped_by_table_total",
+        "evolu_query_skipped_by_rows_total",
+        "evolu_query_skipped_clean_total")}
+    w_gated, out_g, push_g = make_worker(True)
+    w_naive, out_n, push_n = make_worker(False)
+    for cmd in smoke_schedule():
+        w_gated.handle(cmd)
+        w_naive.handle(cmd)
+    errs_g = [o for o in out_g if isinstance(o, msg.OnError)]
+    errs_n = [o for o in out_n if isinstance(o, msg.OnError)]
+    assert [type(e.error).__name__ for e in errs_g] == \
+        [type(e.error).__name__ for e in errs_n], "error streams diverged"
+    stream_g = [o for o in out_g if not isinstance(o, msg.OnError)]
+    stream_n = [o for o in out_n if not isinstance(o, msg.OnError)]
+    assert stream_g == stream_n, "gated patch stream != re-run-everything oracle"
+    assert push_g == push_n, "sync pushes diverged"
+    for sql in ('SELECT * FROM "__message" ORDER BY "timestamp"',
+                'SELECT * FROM "todo" ORDER BY "id"',
+                'SELECT * FROM "other" ORDER BY "id"'):
+        assert w_gated.db.exec(sql) == w_naive.db.exec(sql), "end state diverged"
+    for name, b in before.items():
+        assert metrics.get_counter(name) > b, f"{name} never engaged"
+    n_onquery = sum(1 for o in stream_g if isinstance(o, msg.OnQuery))
+    print(json.dumps({
+        "metric": "query_sub_scaling_smoke",
+        "value": 1,
+        "unit": "ok",
+        "detail": {"outputs": len(stream_g), "onquery": n_onquery,
+                   "parity": "byte-identical"},
+    }))
+
+
+if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        smoke()
+    else:
+        full_bench()

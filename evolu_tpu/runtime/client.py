@@ -376,11 +376,13 @@ class Evolu:
         """Another process reset/restored the shared DB file: re-run
         every subscribed query (the worker recomputes against the new
         file state and posts patches, which notify listeners — same
-        flow as OnReceive), then the embedder callback."""
+        flow as OnReceive), then the embedder callback. full=True: the
+        foreign write never entered this worker's change log, so the
+        r9 invalidation gate must not be consulted."""
         with self._lock:
             queries = tuple(self._subscribed)
         if queries:
-            self.worker.post(msg.Query(queries))
+            self.worker.post(msg.Query(queries, full=True))
         if self._on_reload is not None:
             self._on_reload()
 

@@ -43,6 +43,15 @@ def apply_patch(prev: Sequence[Dict[str, Any]], ops: Sequence[dict]) -> List[Dic
     """
     rows: List[Dict[str, Any]] = list(prev)
     for op in ops:
+        if op["path"] == "":
+            # Root replace: the worker had no cached baseline for this
+            # query (first run, or its cache entry was LRU-evicted), so
+            # it emits the whole result — correct against ANY client
+            # state, unlike index ops diffed from an empty baseline.
+            if op["op"] != "replace":  # pragma: no cover - never emitted
+                raise ValueError(f"unsupported root op: {op['op']}")
+            rows = list(op["value"])
+            continue
         idx = int(op["path"].lstrip("/"))
         kind = op["op"]
         if kind == "replace":

@@ -55,6 +55,11 @@ class Send:
 @dataclass(frozen=True)
 class Query:
     queries: tuple  # SqlQueryString
+    # full=True bypasses changed-set gating (ISSUE 9) and re-executes
+    # unconditionally — for refreshes whose trigger the worker cannot
+    # see in its change log (another process wrote the shared DB file,
+    # e.g. the reload watcher). Defaults keep the wire shape.
+    full: bool = False
 
 
 @dataclass(frozen=True)

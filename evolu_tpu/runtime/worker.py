@@ -43,6 +43,8 @@ from evolu_tpu.storage.apply import (
     apply_messages_chunked,
     plan_batch,
 )
+from evolu_tpu.storage.changes import ChangedSet
+from evolu_tpu.storage.deps import query_dependencies
 from evolu_tpu.storage.clock import read_clock, update_clock
 from evolu_tpu.storage.schema import delete_all_tables, init_db_model, update_db_schema
 from evolu_tpu.storage.sqlite import PySqliteDatabase
@@ -53,6 +55,9 @@ from evolu_tpu.utils.log import logger
 
 def _now_millis() -> int:
     return int(time.time() * 1000)
+
+
+_MISSING = object()  # pop sentinel: a cached [] must still count
 
 
 def select_planner(config: Config, db: Optional[PySqliteDatabase] = None) -> Callable:
@@ -215,10 +220,25 @@ class DbWorker:
         # success, evicted and cleared together — a desynced pair would
         # suppress or duplicate patches).
         self.queries_raw_cache: Dict[str, tuple] = {}
+        # r9 incremental invalidation (ISSUE 9). The change log is a
+        # short list of (seq, ChangedSet) batches; each tracked query
+        # remembers the seq it last executed at (`_query_seen`), so
+        # gating = "did anything after my seq touch my read set?"
+        # (`storage/deps.py` provides the read set). `_query_lru`
+        # orders queries by last use for the Config.query_cache_max
+        # bound; an execution with no cached baseline always emits a
+        # root-replace (see `_query`), so eviction needs no tombstones.
+        self._query_deps: Dict[str, object] = {}
+        self._query_seen: Dict[str, int] = {}
+        self._query_lru: Dict[str, None] = {}
+        self._change_log: List[tuple] = []
+        self._change_seq: int = 0
         self._planner = select_planner(self.config, self.db)
         self._staged_effects: List = []
         self._staged_cache: Dict[str, List[dict]] = {}
         self._staged_raw: Dict[str, tuple] = {}
+        self._staged_changes: ChangedSet = ChangedSet()
+        self._staged_seen: set = set()
         self._queue: "queue.Queue[object]" = queue.Queue()
         self._thread: Optional[threading.Thread] = None
         self._stop = object()
@@ -293,10 +313,27 @@ class DbWorker:
     def handle(self, command: object) -> None:
         """Dispatch one command inside one transaction; errors roll back
         and surface as OnError (db.worker.ts:57-73)."""
+        t0 = time.perf_counter()
         self._staged_effects = []
         self._staged_cache: Dict[str, List[dict]] = {}
         self._staged_raw: Dict[str, tuple] = {}
+        self._staged_changes = ChangedSet()
+        self._staged_seen = set()
         metrics.inc("evolu_worker_commands_total", command=type(command).__name__)
+        try:
+            self._handle_inner(command)
+        finally:
+            if isinstance(command, (msg.Send, msg.Receive, msg.Query)):
+                # The mutation→notify latency surface (ISSUE 9): local
+                # mutations notify within their Send; remote ones are a
+                # Receive plus the follow-up Query sweep.
+                metrics.observe(
+                    "evolu_query_notify_latency_ms",
+                    (time.perf_counter() - t0) * 1e3,
+                    command=type(command).__name__,
+                )
+
+    def _handle_inner(self, command: object) -> None:
         try:
             from contextlib import nullcontext
 
@@ -311,15 +348,23 @@ class DbWorker:
                 elif isinstance(command, msg.Receive):
                     self._receive(command)
                 elif isinstance(command, msg.Query):
-                    self._query(command.queries)
+                    # full=True = refresh whose trigger the change log
+                    # cannot see (e.g. another process wrote the shared
+                    # DB file): bypass gating.
+                    self._query(command.queries,
+                                gated=not getattr(command, "full", False))
                 elif isinstance(command, msg.EvictQueries):
                     for q in command.queries:
-                        self.queries_rows_cache.pop(q, None)
-                        self.queries_raw_cache.pop(q, None)
+                        self._evict_query_entry(q)
                 elif isinstance(command, msg.Sync):
                     self._sync(command)
                 elif isinstance(command, msg.UpdateDbSchema):
                     update_db_schema(self.db, command.table_definitions)
+                    # DDL plus possible pre-declaration typed folds
+                    # (crdt_types._fold_predeclaration_ops) touch app
+                    # tables in ways no message batch describes: the
+                    # "don't know" arm of the invalidation contract.
+                    self._staged_changes.mark_unknown()
                 elif isinstance(command, msg.ResetOwner):
                     self._reset_owner()
                 elif isinstance(command, msg.RestoreOwner):
@@ -348,6 +393,12 @@ class DbWorker:
                 # these commands so e.g. a failed Query cannot wipe a
                 # warm cache.
                 _notify_plan_failure(self._planner)
+            # Commit the staged changed-set even on failure: for a
+            # rolled-back transaction it is a harmless superset (extra
+            # re-execution, never staleness); for a chunked receive it
+            # covers the chunks that DID commit. Seen-epoch updates are
+            # dropped — queries staged this command re-verify next time.
+            self._commit_staged_changes()
             if self._manages_own_transactions(command):
                 # Chunked receive: earlier chunks COMMITTED before the
                 # failure — their staged effects (OnReceive, so query
@@ -364,9 +415,117 @@ class DbWorker:
                 # flush would hang on a dead loop)
                 pass
             return
+        self._commit_staged_changes()
+        # Seen-epochs commit with the caches: after _commit_staged_changes
+        # the current seq covers this command's own writes, which every
+        # query staged this command already observed (the sweep runs
+        # after the apply inside _send) or was verified disjoint from.
+        for q in self._staged_seen:
+            self._query_seen[q] = self._change_seq
         self.queries_rows_cache.update(self._staged_cache)
         self.queries_raw_cache.update(self._staged_raw)
+        self._enforce_query_cache_cap()
+        if self._staged_seen or isinstance(command, msg.EvictQueries):
+            metrics.set_gauge("evolu_query_subscriptions",
+                              len(self.queries_rows_cache))
         self._flush_staged_effects()
+
+    # -- incremental-invalidation bookkeeping (ISSUE 9) --
+
+    def _commit_staged_changes(self) -> None:
+        if not self._staged_changes:
+            return
+        self._change_seq += 1
+        self._change_log.append((self._change_seq, self._staged_changes))
+        self._staged_changes = ChangedSet()
+        if len(self._change_log) > 64:
+            self._compact_change_log()
+
+    def _compact_change_log(self) -> None:
+        """Drop entries every tracked query has seen; if stale one-shot
+        seen-epochs still pin history, merge the oldest half into one
+        cumulative entry whose seq is the max member seq — still
+        greater than any seen value predating any member, so queries
+        behind it observe the union (a superset: conservative)."""
+        floor = min(self._query_seen.values(), default=self._change_seq)
+        log = [(s, e) for s, e in self._change_log if s > floor]
+        if len(log) > 64:
+            half = len(log) // 2
+            merged = ChangedSet()
+            for _s, e in log[:half]:
+                merged.merge(e)
+            log = [(log[half - 1][0], merged)] + log[half:]
+        self._change_log = log
+
+    def _staged_changes_or_none(self):
+        """The apply-layer recording target — None when invalidation is
+        disabled, so the reference-fallback configuration pays zero
+        per-message recording cost (record_batch no-ops on None)."""
+        return self._staged_changes if self.config.query_invalidation else None
+
+    def _evict_query_entry(self, q: str) -> None:
+        """Unsubscribed (EvictQueries): drop every per-query structure."""
+        self.queries_rows_cache.pop(q, None)
+        self.queries_raw_cache.pop(q, None)
+        self._query_deps.pop(q, None)
+        self._query_seen.pop(q, None)
+        self._query_lru.pop(q, None)
+
+    def _enforce_query_cache_cap(self) -> None:
+        """Bound the per-query caches to Config.query_cache_max by
+        least-recently-executed eviction, so churned one-shot query
+        strings cannot grow the worker without bound. A still-subscribed
+        query that loses its entry self-heals on its next execution
+        with a root-replace patch (emitted whenever there is no cached
+        baseline — including an empty result, so a subscriber holding
+        rows from before the eviction can never be left stale)."""
+        cap = self.config.query_cache_max
+        if not cap:
+            return
+        evicted = 0
+        while len(self.queries_rows_cache) > cap and self._query_lru:
+            q = next(iter(self._query_lru))
+            del self._query_lru[q]
+            had_entry = self.queries_rows_cache.pop(q, _MISSING)
+            self.queries_raw_cache.pop(q, None)
+            self._query_deps.pop(q, None)
+            self._query_seen.pop(q, None)
+            if had_entry is not _MISSING:
+                evicted += 1  # LRU residue of failed queries don't count
+        if evicted:
+            metrics.inc("evolu_query_cache_evictions_total", evicted)
+        if len(self._query_lru) > 2 * cap:
+            # Failed/never-cached queries leave LRU-only residue; sweep
+            # it on the rare overflow.
+            for q in list(self._query_lru):
+                if len(self._query_lru) <= 2 * cap:
+                    break
+                if q not in self.queries_rows_cache:
+                    del self._query_lru[q]
+                    self._query_deps.pop(q, None)
+                    self._query_seen.pop(q, None)
+
+    def _pending_since(self, seen: int, memo: Dict[int, object]):
+        """Shared gate state for every query last verified at epoch
+        `seen`: `"clean"` (nothing written since), `"conservative"`
+        (an unattributable write — every gated query must re-execute),
+        or `(tables, rows)` of the merged pending ChangedSet. Memoized
+        per sweep so the change-log merge runs once per distinct
+        epoch, not once per query."""
+        pend = ChangedSet()
+        for s, e in self._change_log:
+            if s > seen:
+                pend.merge(e)
+        if self._staged_changes:
+            pend.merge(self._staged_changes)
+        if pend.conservative:
+            state = "conservative"
+        elif not pend.tables:
+            state = "clean"
+        else:
+            state = (pend.tables, pend.rows)
+        memo[seen] = state
+        return state
 
     def _flush_staged_effects(self) -> None:
         for effect in self._staged_effects:
@@ -402,7 +561,9 @@ class DbWorker:
             stamped.append(
                 CrdtMessage(timestamp_to_string(t), m.table, m.row, m.column, m.value)
             )
-        tree = apply_messages(self.db, clock.merkle_tree, stamped, planner=self._planner)
+        tree = apply_messages(self.db, clock.merkle_tree, stamped,
+                              planner=self._planner,
+                              changes=self._staged_changes_or_none())
         next_clock = CrdtClock(t, tree)
         update_clock(self.db, next_clock)
         self._push(
@@ -489,13 +650,15 @@ class DbWorker:
                 tree = apply_messages_chunked(
                     self.db, clock.merkle_tree, messages, chunk_size=chunk,
                     planner=self._planner, on_chunk=persist,
+                    changes=self._staged_changes_or_none(),
                 )
                 # persist() already wrote the final clock with this tree
                 # and staged the OnReceive.
                 clock = CrdtClock(t, tree)
             else:
                 tree = apply_messages(
-                    self.db, clock.merkle_tree, messages, planner=self._planner
+                    self.db, clock.merkle_tree, messages,
+                    planner=self._planner, changes=self._staged_changes_or_none(),
                 )
                 clock = CrdtClock(t, tree)
                 update_clock(self.db, clock)
@@ -530,21 +693,41 @@ class DbWorker:
             )
         )
 
-    def _query(self, queries: Sequence[str], on_complete_ids: Sequence[str] = ()) -> None:
+    def _query(self, queries: Sequence[str], on_complete_ids: Sequence[str] = (),
+               gated: bool = True) -> None:
         """query.ts:16-76: run, diff vs cache, post non-empty patches.
 
+        r9 (ISSUE 9) gates the sweep on the changed-set: a query whose
+        read tables (storage/deps.py, from SQLite's own compiled
+        program) are disjoint from everything written since its last
+        run skips WITHOUT a read or a byte compare; a query with a
+        static `"id" = ?` constraint additionally skips row-disjoint
+        writes. Every "don't know" — unknown deps, unknown rows,
+        conservative change, no baseline — falls through to execution,
+        so the emitted patch stream is byte-identical to re-running
+        everything (bench-gated in benchmarks/query_sub_scaling.py).
+        `gated=False` (explicit Sync refresh, Query(full=True))
+        re-executes unconditionally.
+
         With the packed reader (C++ backend), the raw result bytes are
-        the change detector: a subscribed query whose bytes match the
-        cached bytes skips dict materialization AND the rfc6902 diff
-        entirely — the dominant cost of the reactive re-execution loop
-        (SURVEY hot loop #4; measured r4: ~65 ms per 10k-row query on
-        the per-cell path vs ~4 ms raw read + compare). Byte equality
-        is EXACT here, not approximate: the only value whose
-        deep-equality differs from bit-equality is REAL NaN, and
-        SQLite converts NaN to NULL at bind time so no queried row can
-        hold one (pinned in test_runtime.py; -0.0→0.0 rewrites emit a
-        patch the deep-equal would skip — a real write happened, so
-        the extra patch is harmless)."""
+        the change detector for executed queries: a subscribed query
+        whose bytes match the cached bytes skips dict materialization
+        AND the rfc6902 diff entirely — the dominant cost of the
+        reactive re-execution loop (SURVEY hot loop #4; measured r4:
+        ~65 ms per 10k-row query on the per-cell path vs ~4 ms raw
+        read + compare). Byte equality is EXACT here, not approximate:
+        the only value whose deep-equality differs from bit-equality
+        is REAL NaN, and SQLite converts NaN to NULL at bind time so
+        no queried row can hold one (pinned in test_runtime.py;
+        -0.0→0.0 rewrites emit a patch the deep-equal would skip — a
+        real write happened, so the extra patch is harmless).
+
+        A query with NO cached baseline (first run, or LRU-evicted
+        under Config.query_cache_max) emits a ROOT-REPLACE patch
+        (`{"op": "replace", "path": "", "value": rows}`) instead of
+        index ops diffed against []: index ops are only correct when
+        the subscriber also starts from [], which an evicted-but-live
+        subscription does not."""
         patches = []
         raw_capable = hasattr(self.db, "exec_sql_query_packed_raw")
         if raw_capable:
@@ -552,16 +735,86 @@ class DbWorker:
                 unpack_changed_rows,
                 unpack_packed_rows,
             )
+        gate = gated and self.config.query_invalidation
+        build_deps = self.config.query_invalidation
+        pending_memo: Dict[int, object] = {}
+        n_exec = n_clean = n_table = n_rows = n_cons = 0
+        # The gate is INLINED in this loop with every dict hoisted to a
+        # local: at 10^4 subscriptions per sweep the skip path's cost
+        # IS the mutation→notify latency for disjoint writes, and a
+        # per-query method call + attribute loads measurably dominate
+        # it (profiled: ~2× the set ops). Verdict semantics — sound by
+        # construction, any uncertainty re-executes: no baseline /
+        # unknown deps ⇒ run; conservative epoch or unknown-table deps
+        # ⇒ run (counted conservative); table-disjoint ⇒ skip;
+        # table-overlap with a static id-filter disjoint from the
+        # changed rows ⇒ skip; anything else ⇒ run.
+        lru = self._query_lru
+        staged_seen_add = self._staged_seen.add
+        query_seen_get = self._query_seen.get
+        deps_get = self._query_deps.get
+        rows_cache, staged_cache = self.queries_rows_cache, self._staged_cache
+        memo_get = pending_memo.get
         for q in queries:
+            lru.pop(q, None)
+            lru[q] = None
+            if gate:
+                run = True
+                seen = query_seen_get(q)
+                if seen is not None and (q in rows_cache or q in staged_cache):
+                    state = memo_get(seen)
+                    if state is None:
+                        state = self._pending_since(seen, pending_memo)
+                    if state == "clean":
+                        n_clean += 1
+                        run = False
+                    elif state == "conservative":
+                        n_cons += 1
+                    else:
+                        deps = deps_get(q)
+                        read_tables = deps.tables if deps is not None else None
+                        if read_tables is None:
+                            if deps is not None:
+                                n_cons += 1  # EXPLAIN walk gave up
+                        else:
+                            pend_tables, pend_rows = state
+                            if pend_tables.isdisjoint(read_tables):
+                                n_table += 1
+                                run = False
+                            else:
+                                row_filters = deps.row_filters
+                                for t in read_tables:
+                                    if t not in pend_tables:
+                                        continue
+                                    changed = pend_rows.get(t)
+                                    if changed is None:
+                                        break  # unknown rows: run
+                                    flt = row_filters.get(t)
+                                    if flt is None or not changed.isdisjoint(flt):
+                                        break  # true overlap: run
+                                else:
+                                    n_rows += 1
+                                    run = False
+                if not run:
+                    staged_seen_add(q)
+                    continue  # skipped: no read, no compare, no patch
+            staged_seen_add(q)
+            n_exec += 1
             sql, parameters = msg.deserialize_query(q)
+            if build_deps and q not in self._query_deps:
+                # First execution builds the dependency index entry;
+                # query_dependencies never raises (its own failures
+                # degrade to unknown), so the statement's real error
+                # surface stays with the execution below.
+                self._query_deps[q] = query_dependencies(self.db, sql, parameters)
             entry = None
+            cached = q in self._staged_cache or q in self.queries_rows_cache
             if raw_capable:
                 raw, offs = self.db.exec_sql_query_packed_raw(
                     sql, parameters, with_offsets=True
                 )
                 entry = (raw, offs)
                 prev_entry = self._staged_raw.get(q, self.queries_raw_cache.get(q))
-                cached = q in self._staged_cache or q in self.queries_rows_cache
                 if cached and prev_entry is not None and prev_entry[0] == raw:
                     self._staged_raw[q] = prev_entry
                     continue  # unchanged — no parse, no diff, no patch
@@ -582,7 +835,14 @@ class DbWorker:
             else:
                 rows = self.db.exec_sql_query(sql, parameters)
                 prev = self._staged_cache.get(q, self.queries_rows_cache.get(q, []))
-            ops = create_patch(prev, rows)
+            if cached:
+                ops = create_patch(prev, rows)
+            else:
+                # No cached baseline (first run, or LRU-evicted): emit
+                # the whole result — EVEN an empty one. A subscriber
+                # may hold non-empty rows from before the eviction,
+                # and only a root-replace converges it from any state.
+                ops = [{"op": "replace", "path": "", "value": rows}]
             # Stage rows BEFORE raw: an exception between unpack and here
             # leaves both staged caches at their old values — staging raw
             # first would let the OnError commit path pair NEW bytes with
@@ -592,13 +852,29 @@ class DbWorker:
                 self._staged_raw[q] = entry
             if ops:
                 patches.append((q, ops))
+        # Counters batched per sweep: at 10^4 subscriptions a per-query
+        # metrics lock would cost more than the skips save.
+        if n_exec:
+            metrics.inc("evolu_query_executed_total", n_exec)
+        if n_clean:
+            metrics.inc("evolu_query_skipped_clean_total", n_clean)
+        if n_table:
+            metrics.inc("evolu_query_skipped_by_table_total", n_table)
+        if n_rows:
+            metrics.inc("evolu_query_skipped_by_rows_total", n_rows)
+        if n_cons:
+            metrics.inc("evolu_query_conservative_total", n_cons)
         if patches or on_complete_ids:
             self._emit(msg.OnQuery(tuple(patches), tuple(on_complete_ids)))
 
     def _sync(self, command: msg.Sync) -> None:
         """sync.ts:20-69: optional query refresh, then a pull-only round."""
         if command.queries:
-            self._query(command.queries)
+            # Ungated: an explicit sync refresh exists to pick up state
+            # the worker did not write itself (another process on a
+            # shared DB file; the reference's load/online/focus
+            # re-runs). The byte compare still suppresses no-op patches.
+            self._query(command.queries, gated=False)
         if self.sync_lock.is_pending_or_held():
             return
         clock = read_clock(self.db)
@@ -620,6 +896,12 @@ class DbWorker:
     def _clear_query_caches(self) -> None:
         self.queries_rows_cache.clear()
         self.queries_raw_cache.clear()
+        self._query_deps.clear()
+        self._query_seen.clear()
+        self._query_lru.clear()
+        # The change log only gates queries with a seen-epoch; all were
+        # just cleared, so history is dead weight (seq stays monotonic).
+        self._change_log.clear()
 
     def _drop_aead_sessions(self) -> None:
         """Owner identity changed: drop the cached aead-batch-v1
@@ -634,6 +916,7 @@ class DbWorker:
 
     def _reset_owner(self) -> None:
         """resetOwner.ts:7-21."""
+        self._staged_changes.mark_unknown()  # DDL wipe: unattributable
         delete_all_tables(self.db)
         self._drop_winner_cache()
         self._drop_aead_sessions()
@@ -643,6 +926,7 @@ class DbWorker:
     def _restore_owner(self, mnemonic: str) -> None:
         """restoreOwner.ts:9-23 — wipe, re-seed identity; history returns
         via the first sync against the relay (SURVEY.md §3.5)."""
+        self._staged_changes.mark_unknown()  # DDL wipe: unattributable
         delete_all_tables(self.db)
         self._drop_winner_cache()
         self._drop_aead_sessions()

@@ -59,15 +59,20 @@ def _upsert_sql(table: str, column: str) -> str:
 
 
 def apply_messages_sequential(
-    db: PySqliteDatabase, merkle_tree: dict, messages: Sequence[CrdtMessage]
+    db: PySqliteDatabase, merkle_tree: dict, messages: Sequence[CrdtMessage],
+    changes=None,
 ) -> dict:
     """The reference loop, message by message.
 
     On the C++ backend the whole loop (winner check, upsert, insert)
     runs as one native call returning the XOR mask; on the Python
-    backend it is O(n) SQL round trips."""
+    backend it is O(n) SQL round trips. `changes` is an optional
+    `storage.changes.ChangedSet` implementing the invalidation
+    contract (ISSUE 9)."""
     from evolu_tpu.core.crdt_types import apply_typed_ops, load_schema
+    from evolu_tpu.storage.changes import record_batch, record_typed_tables
 
+    record_batch(changes, messages)
     schema = load_schema(db)
     typed = (
         [m for m in messages if schema.is_typed(m.table, m.column)]
@@ -95,6 +100,7 @@ def apply_messages_sequential(
         # the dedup screen must observe pre-batch state (same contract
         # as the batched path). xor/insert semantics below stay the
         # reference's, timestamp-only.
+        record_typed_tables(changes)
         apply_typed_ops(db, schema, typed)
     for m in messages:
         rows = db.exec_sql_query(_SELECT_WINNER, (m.table, m.row, m.column))
@@ -177,18 +183,26 @@ def apply_messages(
     merkle_tree: dict,
     messages: Sequence[CrdtMessage],
     planner=None,
+    changes=None,
 ) -> dict:
     """Batched apply with end state identical to the sequential oracle.
 
     `planner` defaults to the host `plan_batch`; the TPU runtime passes
-    a device planner with the same contract.
+    a device planner with the same contract. `changes` (optional
+    `storage.changes.ChangedSet`) collects the (table, rowId) pairs
+    this apply touches — the query-invalidation contract (ISSUE 9):
+    recording happens here at the apply level, so EVERY plan route
+    (device kernel, winner cache, `merge._host_fallback`, hot-owner,
+    host oracle, packed `eh_apply_planned_cells`) reports identically,
+    and any unrecognizable batch escalates to conservative full
+    invalidation inside `record_batch`.
     """
     if not len(messages):
         return merkle_tree
     planner = planner or plan_batch
     try:
         with db.transaction():  # whole-batch atomicity, like the reference's dbTransaction
-            return _apply_in_txn(db, merkle_tree, messages, planner)
+            return _apply_in_txn(db, merkle_tree, messages, planner, changes)
     except BaseException:
         # A planner that mutates its own state at plan time (the HBM
         # winner cache) is now ahead of the rolled-back SQLite; let it
@@ -209,7 +223,7 @@ def _notify_plan_failure(planner) -> None:
         on_failed()
 
 
-def _apply_in_txn(db, merkle_tree, messages, planner):
+def _apply_in_txn(db, merkle_tree, messages, planner, changes=None):
     """Dispatch inside the transaction: a PackedReceive batch (the
     fused receive leg) takes the columnar plan+apply when both the
     planner and the backend support it; everything else — and every
@@ -220,7 +234,12 @@ def _apply_in_txn(db, merkle_tree, messages, planner):
     from evolu_tpu.core.packed import PackedReceive
     from evolu_tpu.core.crdt_types import load_schema
     from evolu_tpu.obs import metrics
+    from evolu_tpu.storage.changes import record_batch
 
+    # Record BEFORE routing: the touched (table, row) set is the same
+    # on every route, and recording first means a route that later
+    # fails half-way still lands in a superset changed-set.
+    record_batch(changes, messages)
     if isinstance(messages, PackedReceive):
         schema = load_schema(db)
         if schema and schema.has_typed(messages.cells):
@@ -232,7 +251,8 @@ def _apply_in_txn(db, merkle_tree, messages, planner):
             metrics.inc("evolu_apply_packed_bounces_total")
             messages = messages.to_messages()
             metrics.inc("evolu_apply_batches_total", route="object")
-            return _apply_messages_in_txn(db, merkle_tree, messages, planner)
+            return _apply_messages_in_txn(db, merkle_tree, messages, planner,
+                                          changes)
         plan_packed = getattr(planner, "plan_packed", None)
         if plan_packed is not None and hasattr(db, "apply_planned_cells"):
             plan = plan_packed(messages)
@@ -247,10 +267,10 @@ def _apply_in_txn(db, merkle_tree, messages, planner):
         metrics.inc("evolu_apply_packed_bounces_total")
         messages = messages.to_messages()
     metrics.inc("evolu_apply_batches_total", route="object")
-    return _apply_messages_in_txn(db, merkle_tree, messages, planner)
+    return _apply_messages_in_txn(db, merkle_tree, messages, planner, changes)
 
 
-def _apply_messages_in_txn(db, merkle_tree, messages, planner):
+def _apply_messages_in_txn(db, merkle_tree, messages, planner, changes=None):
     # `fetches_winners` may sit on the planner function or, for bound
     # methods (DeviceWinnerCache.plan_batch), on the owning instance.
     owner = getattr(planner, "__self__", None)
@@ -275,7 +295,9 @@ def _apply_messages_in_txn(db, merkle_tree, messages, planner):
         # pre-batch state), and strip their LWW upserts from whatever
         # planner produced the plan (ONE copy: ops.merge).
         from evolu_tpu.ops.merge import strip_typed_upserts
+        from evolu_tpu.storage.changes import record_typed_tables
 
+        record_typed_tables(changes)
         apply_typed_ops(db, schema, typed)
         plan = strip_typed_upserts(plan, messages, schema)
     if len(plan) == 3:
@@ -347,6 +369,7 @@ def apply_messages_chunked(
     chunk_size: int = 1 << 20,
     planner=None,
     on_chunk=None,
+    changes=None,
 ) -> dict:
     """Blockwise apply for batches too large for one device dispatch.
 
@@ -373,7 +396,8 @@ def apply_messages_chunked(
         chunk = messages[i : i + chunk_size]
         try:
             with db.transaction():
-                next_tree = apply_messages(db, merkle_tree, chunk, planner)
+                next_tree = apply_messages(db, merkle_tree, chunk, planner,
+                                           changes=changes)
                 if on_chunk is not None:
                     on_chunk(next_tree, applied + len(chunk))
         except Exception as e:

@@ -90,6 +90,23 @@ class Config:
     # restart (RelayServer(checkpoint_interval_s=...) →
     # snapshot.CheckpointWriter). None disables.
     checkpoint_interval_s: "float | None" = None
+    # Changed-set-gated incremental query invalidation (ISSUE 9,
+    # runtime/worker.py::_query × storage/deps.py × storage/changes.py):
+    # subscribed queries whose read tables are disjoint from a
+    # mutation's changed set skip re-execution entirely, and queries
+    # with a static `"id" = ?` constraint skip row-disjoint writes.
+    # Patch streams are byte-identical to the re-run-everything path
+    # (conservative full invalidation on every "don't know"); False
+    # restores the reference's unconditional re-execution.
+    query_invalidation: bool = True
+    # Bound on the worker's per-query caches (rows/raw bytes/dependency
+    # index/seen-epoch): least-recently-executed entries are evicted
+    # past this many distinct queries, so churned one-shot query
+    # strings cannot grow the worker without bound. An evicted-but-
+    # still-subscribed query self-heals on its next run via a
+    # root-replace patch (correct against any client state). None =
+    # unbounded (the pre-r9 behavior).
+    query_cache_max: "int | None" = 32768
     # After a swallowed offline sync failure, probe the relay's
     # GET /ping starting at this cadence in seconds (backing off 2x per
     # failure up to 30s); the first success fires the reconnect hook

@@ -555,3 +555,173 @@ def test_mixed_crdt_workload_adversarial_clocks_two_relay_fleet():
             r.dispose()
         a.stop()
         b.stop()
+
+
+def test_no_stale_query_results_adversarial_clocks_host_bounce():
+    """ISSUE 9 satellite (ROADMAP #5 small dose): one seeded adversarial
+    episode through the changed-set-gated query invalidation layer —
+    regressing/stuttering HLC `now`, a NON-CANONICAL remote batch
+    bouncing to the host oracle mid-stream (winner-cache invalidation
+    included: backend="tpu"), a rolled-back Send, and eviction churn —
+    driving TWIN workers (gated vs the re-run-everything oracle) over
+    the identical command schedule. NO stale query result may ever be
+    delivered: the gated worker's output stream must be byte-identical
+    to the oracle's at every step, and at the end every cached
+    subscription must equal a fresh SQL read of the live database."""
+    from dataclasses import replace as dc_replace
+
+    from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_string
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.core.types import (CrdtClock, CrdtMessage, NewCrdtMessage,
+                                      TableDefinition)
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.runtime import messages as msg
+    from evolu_tpu.runtime.worker import DbWorker
+    from evolu_tpu.storage.clock import read_clock, update_clock
+    from evolu_tpu.storage.native import open_database
+
+    seed = 20260804
+    base = 1_700_000_000_000
+    empty_tree = merkle_tree_to_string(create_initial_merkle_tree())
+    mnemonic = ("abandon abandon abandon abandon abandon abandon "
+                "abandon abandon abandon abandon abandon about")
+    tds = (TableDefinition.of("todo", ("title", "done")),
+           TableDefinition.of("other", ("name",)))
+
+    def adversarial_now(sub_seed):
+        """Deterministic hostile wall clock (same envelope as the fleet
+        episode above): 40% frozen, 20% bounded regression, else small
+        advances. Gating never changes how often the worker samples
+        `now`, so twin workers with the same sub_seed stamp identical
+        timestamps — any divergence would itself be a bug."""
+        r = random.Random(sub_seed)
+        state = {"t": base}
+
+        def now():
+            roll = r.random()
+            if roll < 0.4:
+                pass  # stutter: frozen clock
+            elif roll < 0.6:
+                state["t"] = max(base - 20_000,
+                                 state["t"] - r.randrange(0, 5_000))
+            else:
+                state["t"] += r.randrange(1, 400)
+            return state["t"]
+
+        return now
+
+    def make_worker(gated):
+        db = open_database(":memory:")
+        outputs, pushes = [], []
+        w = DbWorker(db, config=Config(backend="tpu", query_invalidation=gated),
+                     on_output=outputs.append, post_sync=pushes.append,
+                     now=adversarial_now(seed))
+        w.start(mnemonic)
+        w.stop()  # drive handle() synchronously: deterministic twin runs
+        clock = read_clock(db)
+        with db.transaction():  # pin the HLC node id across the twins
+            update_clock(db, CrdtClock(
+                dc_replace(clock.timestamp, node="00c0ffee00c0ffee"),
+                clock.merkle_tree))
+        w.handle(msg.UpdateDbSchema(tds))
+        outputs.clear()
+        return w, outputs, pushes
+
+    def remote_ts(i, counter=0, upper=False):
+        s = timestamp_to_string(
+            Timestamp(base + i, counter, "00000000000000ab"))
+        return s[:30] + s[30:].upper() if upper else s
+
+    qs = tuple(
+        [msg.serialize_query('SELECT "id", "title", "done" FROM "todo" '
+                             'WHERE "id" = ?', (f"row{i}",)) for i in range(8)]
+        + [msg.serialize_query('SELECT "id", "title" FROM "todo" '
+                               'WHERE "done" = ? ORDER BY "title"', (i,))
+           for i in range(4)]
+        + [msg.serialize_query('SELECT "id", "name" FROM "other" ORDER BY "id"')])
+
+    rng = random.Random(seed)
+    schedule = [msg.Query(qs)]
+    for step in range(48):
+        roll = rng.random()
+        if roll < 0.40:
+            table, row = ("todo", f"row{rng.randrange(12)}") if roll < 0.30 \
+                else ("other", f"o{rng.randrange(3)}")
+            col = "title" if table == "todo" else "name"
+            schedule.append(msg.Send(
+                (NewCrdtMessage(table, row, col, f"v{step}"),), (), qs))
+        elif roll < 0.55:
+            schedule.append(msg.Send(
+                (NewCrdtMessage("todo", f"row{rng.randrange(12)}", "done",
+                                rng.randrange(2)),), (f"cb{step}",), qs))
+        elif roll < 0.70:
+            schedule.append(msg.Query(qs))
+        elif roll < 0.80:
+            batch = tuple(
+                CrdtMessage(remote_ts(1000 + step * 10 + j, counter=j),
+                            "todo", f"rem{j % 2}", "title", f"m{step}.{j}")
+                for j in range(3))
+            schedule.append(msg.Receive(batch, empty_tree))
+            schedule.append(msg.Query(qs))
+        elif roll < 0.90:
+            schedule.append(msg.EvictQueries((rng.choice(qs),)))
+            schedule.append(msg.Query(qs))
+        else:
+            # un-encodable value: the Send rolls back before any write
+            schedule.append(msg.Send(
+                (NewCrdtMessage("todo", "row0", "title", b"\x00"),), (), qs))
+            schedule.append(msg.Query(qs))
+    # The named mid-stream hostile case: NON-CANONICAL hex timestamps
+    # bounce the batch to the host oracle and invalidate winner-cache
+    # cells; more gated sweeps follow it.
+    schedule[len(schedule) // 2:len(schedule) // 2] = [
+        msg.Receive(tuple(
+            CrdtMessage(remote_ts(9000 + j, counter=j, upper=True),
+                        "todo", "row1", "done", j) for j in range(3)),
+            empty_tree),
+        msg.Query(qs),
+    ]
+
+    skips_before = sum(metrics.get_counter(k) for k in (
+        "evolu_query_skipped_by_table_total",
+        "evolu_query_skipped_by_rows_total",
+        "evolu_query_skipped_clean_total"))
+    bounces_before = metrics.get_counter("evolu_merge_host_fallbacks_total")
+    w_gated, out_g, push_g = make_worker(True)
+    w_naive, out_n, push_n = make_worker(False)
+    try:
+        for cmd in schedule:
+            w_gated.handle(cmd)
+            w_naive.handle(cmd)
+        # Byte-identical delivery: same outputs (OnError compared by
+        # type — exception objects don't compare equal), same pushes.
+        assert [type(o).__name__ for o in out_g] \
+            == [type(o).__name__ for o in out_n]
+        stream_g = [o for o in out_g if not isinstance(o, msg.OnError)]
+        stream_n = [o for o in out_n if not isinstance(o, msg.OnError)]
+        assert stream_g == stream_n, \
+            "gated patch stream diverged from the re-exec oracle"
+        assert push_g == push_n
+        for sql in ('SELECT * FROM "__message" ORDER BY "timestamp"',
+                    'SELECT * FROM "todo" ORDER BY "id"',
+                    'SELECT * FROM "other" ORDER BY "id"'):
+            assert w_gated.db.exec(sql) == w_naive.db.exec(sql)
+        # Direct no-staleness oracle: every cached subscription equals
+        # a fresh read of the live database RIGHT NOW.
+        for q in qs:
+            if q not in w_gated.queries_rows_cache:
+                continue  # evicted by churn; next sweep root-replaces
+            sql, params = msg.deserialize_query(q)
+            assert w_gated.queries_rows_cache[q] \
+                == w_gated.db.exec_sql_query(sql, params), q
+        # The episode actually exercised the gate (skips happened) AND
+        # the named hostile route (host-oracle bounce mid-stream).
+        assert sum(metrics.get_counter(k) for k in (
+            "evolu_query_skipped_by_table_total",
+            "evolu_query_skipped_by_rows_total",
+            "evolu_query_skipped_clean_total")) > skips_before
+        assert metrics.get_counter(
+            "evolu_merge_host_fallbacks_total") > bounces_before
+    finally:
+        w_gated.db.close()
+        w_naive.db.close()

@@ -1,0 +1,806 @@
+"""Changed-set-gated incremental query invalidation (ISSUE 9).
+
+Layers under test:
+- `storage/deps.py`: table extraction from SQLite's compiled program
+  (EXPLAIN opcode walk) and the sound static `"id" = ?` row filters;
+- `storage/changes.py`: the ChangedSet contract (over-approximation,
+  "don't know" escalation, row-set cap);
+- `runtime/worker.py::_query` gating: table-disjoint / row-disjoint /
+  clean skips, conservative fallbacks, LRU cache bounding with
+  root-replace self-healing, the `Query(full=True)` bypass, and —
+  the acceptance criterion — BYTE-IDENTICAL output streams between a
+  gated worker and the re-run-everything oracle over schedules that
+  cross every apply path (object, packed, host-fallback, typed CRDT,
+  rollback, chunked receive).
+
+The dual-worker harness drives `handle()` synchronously with a fixed
+mnemonic and deterministic clocks, so two workers fed the same command
+schedule must emit equal outputs regardless of gating.
+"""
+
+import itertools
+
+import pytest
+
+from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_string
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.core.types import CrdtMessage, NewCrdtMessage, TableDefinition
+from evolu_tpu.obs import metrics
+from evolu_tpu.runtime import messages as msg
+from evolu_tpu.runtime.jsonpatch import apply_patch
+from evolu_tpu.runtime.worker import DbWorker
+from evolu_tpu.storage.changes import ROW_SET_CAP, ChangedSet
+from evolu_tpu.storage.deps import query_dependencies
+from evolu_tpu.storage.native import open_database
+from evolu_tpu.storage.sqlite import PySqliteDatabase
+from evolu_tpu.utils.config import Config
+
+MNEMONIC = ("abandon abandon abandon abandon abandon abandon "
+            "abandon abandon abandon abandon abandon about")
+EMPTY_TREE = merkle_tree_to_string(create_initial_merkle_tree())
+
+SCHEMA_TDS = (
+    TableDefinition.of("todo", ("title", "done", "createdAt", "createdBy",
+                                "updatedAt", "isDeleted")),
+    TableDefinition.of("other", ("name", "createdAt", "createdBy",
+                                 "updatedAt", "isDeleted")),
+)
+
+
+def q_str(sql, params=()):
+    return msg.serialize_query(sql, params)
+
+
+def counting_now(base=1_700_000_000_000, step=7):
+    c = itertools.count()
+    return lambda: base + step * next(c)
+
+
+def make_worker(**cfg_kw):
+    cfg_kw.setdefault("backend", "cpu")
+    cfg_kw.setdefault("winner_cache", False)
+    db = open_database(":memory:")
+    outputs = []
+    pushes = []
+    w = DbWorker(db, config=Config(**cfg_kw), on_output=outputs.append,
+                 post_sync=pushes.append, now=counting_now())
+    w.start(MNEMONIC)
+    w.stop()  # drive handle() synchronously from here on
+    # Pin the (otherwise random) HLC node id so twin workers fed the
+    # same schedule stamp identical timestamps.
+    from dataclasses import replace
+
+    from evolu_tpu.storage.clock import read_clock, update_clock
+    from evolu_tpu.core.types import CrdtClock
+
+    clock = read_clock(db)
+    with db.transaction():
+        update_clock(db, CrdtClock(
+            replace(clock.timestamp, node="00c0ffee00c0ffee"),
+            clock.merkle_tree))
+    outputs.clear()
+    w.handle(msg.UpdateDbSchema(SCHEMA_TDS))
+    return w, outputs, pushes
+
+
+def remote_ts(i, counter=0, node="00000000000000ab", upper=False):
+    s = timestamp_to_string(
+        Timestamp(1_700_000_000_000 + i, counter, node))
+    if upper:
+        s = s[:30] + s[30:].upper()
+    return s
+
+
+# --- storage/deps.py -------------------------------------------------
+
+
+@pytest.fixture(params=["python", "native"])
+def dep_db(request):
+    if request.param == "native":
+        from evolu_tpu.storage.native import native_available
+
+        if not native_available():
+            pytest.skip("native backend unavailable")
+    db = open_database(":memory:", backend=request.param)
+    db.exec('CREATE TABLE "todo" ("id" TEXT PRIMARY KEY, "title", "done")')
+    db.exec('CREATE TABLE "cat" ("id" TEXT PRIMARY KEY, "name")')
+    db.exec('CREATE INDEX "idx_todo_title" ON "todo" ("title")')
+    yield db
+    db.close()
+
+
+def test_deps_single_table(dep_db):
+    d = query_dependencies(dep_db, 'SELECT "id", "title" FROM "todo" WHERE "done" = ?', (1,))
+    assert d.tables == frozenset({"todo"})
+    assert d.row_filters == {}
+
+
+def test_deps_covering_index_maps_to_owning_table(dep_db):
+    # Satisfied via idx_todo_title: the cursor opens the INDEX btree;
+    # sqlite_master.tbl_name must map it back to "todo".
+    d = query_dependencies(dep_db, 'SELECT "title" FROM "todo" ORDER BY "title"', ())
+    assert d.tables == frozenset({"todo"})
+
+
+def test_deps_join_and_subquery(dep_db):
+    d = query_dependencies(
+        dep_db,
+        'SELECT "todo"."id" FROM "todo" inner join "cat" on "cat"."id" = "todo"."done" '
+        'WHERE exists (SELECT 1 FROM "cat" WHERE "cat"."name" = ?)',
+        ("x",),
+    )
+    assert d.tables == frozenset({"todo", "cat"})
+
+
+def test_deps_unknown_for_schema_reads_and_nondeterminism(dep_db):
+    assert query_dependencies(
+        dep_db, "SELECT name FROM sqlite_master", ()).tables is None
+    assert query_dependencies(
+        dep_db, 'SELECT "id" FROM "todo" WHERE "done" = random()', ()).tables is None
+    assert query_dependencies(
+        dep_db, "SELECT CURRENT_TIMESTAMP", ()).tables is None
+    # Broken SQL: deps never raise; the execution owns the error.
+    assert query_dependencies(dep_db, "SELECT * FROM missing", ()).tables is None
+
+
+def test_deps_id_row_filters(dep_db):
+    d = query_dependencies(dep_db, 'SELECT * FROM "todo" WHERE "id" = ?', ("a",))
+    assert d.row_filters == {"todo": frozenset({"a"})}
+    d = query_dependencies(
+        dep_db, 'SELECT * FROM "todo" WHERE "id" in (?, ?) AND "done" = ?',
+        ("a", "b", 1))
+    assert d.row_filters == {"todo": frozenset({"a", "b"})}
+    # Qualified attribution inside a join; the unconstrained side stays
+    # unfiltered (any write to it must re-execute).
+    d = query_dependencies(
+        dep_db,
+        'SELECT "todo"."id" FROM "todo" inner join "cat" on "cat"."id" = "todo"."done" '
+        'WHERE "todo"."id" = ?', ("a",))
+    assert d.row_filters == {"todo": frozenset({"a"})}
+    # Unqualified id in a join is ambiguous: no attribution.
+    d = query_dependencies(
+        dep_db,
+        'SELECT "todo"."title" FROM "todo" inner join "cat" on "cat"."id" = "todo"."done" '
+        'WHERE "id" = ?', ("a",))
+    assert d.row_filters == {}
+
+
+def test_deps_row_filter_refuses_unsound_shapes(dep_db):
+    # Top-level OR: the id conjunct no longer bounds the row set.
+    d = query_dependencies(
+        dep_db, 'SELECT * FROM "todo" WHERE ("id" = ? or "done" = ?)', ("a", 1))
+    assert d.tables == frozenset({"todo"}) and d.row_filters == {}
+    # String literal could hide placeholders: indexing unmappable.
+    d = query_dependencies(
+        dep_db, 'SELECT * FROM "todo" WHERE "id" = ? AND "title" != \'x?y\'', ("a",))
+    assert d.row_filters == {}
+    # Predicate-only WHERE (no id): table-level only.
+    d = query_dependencies(
+        dep_db, 'SELECT * FROM "todo" WHERE "done" is not 1', ())
+    assert d.row_filters == {}
+    # A subquery can read the SAME table through a second unconstrained
+    # cursor: the id conjunct bounds only the outer cursor (review
+    # finding — previously skipped row-disjoint writes and left the
+    # cached scalar stale forever).
+    d = query_dependencies(
+        dep_db,
+        'SELECT (SELECT count(*) FROM "todo") AS n, "title" FROM "todo" '
+        'WHERE "id" = ?', ("a",))
+    assert d.tables == frozenset({"todo"}) and d.row_filters == {}
+    # Non-str bound values: SQLite TEXT affinity matches id 5 against
+    # the row whose id is '5', but set disjointness over {5} vs {'5'}
+    # would wrongly skip (review finding).
+    d = query_dependencies(
+        dep_db, 'SELECT * FROM "todo" WHERE "id" = ?', (5,))
+    assert d.row_filters == {}
+    d = query_dependencies(
+        dep_db, 'SELECT * FROM "todo" WHERE "id" in (?, ?)', ("a", 5))
+    assert d.row_filters == {}
+    # Self-join: the second, UNCONSTRAINED cursor over the same table
+    # makes the qualified id filter unsound (review finding) — the
+    # plain join in test_deps_id_row_filters must keep its filter.
+    d = query_dependencies(
+        dep_db,
+        'SELECT "x"."title" FROM "todo" JOIN "todo" AS "x" '
+        'ON "x"."done" = "todo"."id" WHERE "todo"."id" = ?', ("a",))
+    assert d.tables == frozenset({"todo"}) and d.row_filters == {}
+
+
+def test_deps_row_filter_refuses_depth0_or(dep_db):
+    # AND binds tighter than OR: in `a OR b AND "id" = ?` the id
+    # equality is a conjunct of the OR's right arm, NOT of the WHERE
+    # (review finding — a write to a row matching `a` changed the
+    # result while the row gate skipped re-execution). Any depth-0 OR
+    # must drop row filters; table gating still applies.
+    d = query_dependencies(
+        dep_db,
+        'SELECT * FROM "todo" WHERE "done" = ? OR "title" = ? AND "id" = ?',
+        ("x", "t", "a"))
+    assert d.tables == frozenset({"todo"}) and d.row_filters == {}
+    # SQLite tokenizes without surrounding spaces: ' or ' with
+    # mandatory spaces misses these (review finding).
+    d = query_dependencies(
+        dep_db,
+        'SELECT * FROM "todo" WHERE "done"=?or"title"=? AND "id" = ?',
+        ("x", "t", "a"))
+    assert d.tables == frozenset({"todo"}) and d.row_filters == {}
+    d = query_dependencies(
+        dep_db,
+        'SELECT * FROM "todo" WHERE "done" = ?OR("title") = ? AND "id" = ?',
+        ("x", "t", "a"))
+    assert d.tables == frozenset({"todo"}) and d.row_filters == {}
+    # Comment bytes must not feed the scanner: a '(' or '"' inside
+    # /*...*/ skews depth/quote tracking past the real OR (review
+    # finding). Comments bail outright.
+    d = query_dependencies(
+        dep_db,
+        'SELECT * FROM "todo" WHERE "done" = ? /*(*/ OR /*)*/ '
+        '"title" = ? AND "id" = ?', ("x", "t", "ra"))
+    assert d.tables == frozenset({"todo"}) and d.row_filters == {}
+    d = query_dependencies(
+        dep_db,
+        'SELECT * FROM "todo" WHERE "done" = ? /*"*/ OR /*"*/ '
+        '"title" = ? AND "id" = ?', ("x", "t", "ra"))
+    assert d.tables == frozenset({"todo"}) and d.row_filters == {}
+    # BETWEEN's AND is an operand separator, not a conjunct boundary:
+    # `"a" BETWEEN ? AND "id" = ?` parses as `("a" BETWEEN ? AND "id")
+    # = ?` — the id equality is the BETWEEN's upper bound, not a
+    # top-level conjunct (review finding). Bail like OR.
+    d = query_dependencies(
+        dep_db,
+        'SELECT * FROM "todo" WHERE "done" BETWEEN ? AND "id" = ?',
+        ("x", "ra"))
+    assert d.tables == frozenset({"todo"}) and d.row_filters == {}
+    d = query_dependencies(
+        dep_db,
+        'SELECT * FROM "todo" WHERE "done" between ? and ? AND "id" = ?',
+        ("a", "z", "ra"))
+    assert d.row_filters == {}  # conservative: any depth-0 BETWEEN bails
+    # An identifier merely CONTAINING "or" is not the keyword: an
+    # unquoted column like `priority` must not trip the bail, and the
+    # plain AND-of-equalities shape keeps its filter.
+    dep_db.exec('CREATE TABLE "orders" ("id" TEXT PRIMARY KEY, "priority")')
+    d = query_dependencies(
+        dep_db, 'SELECT * FROM "orders" WHERE priority = ? AND "id" = ?',
+        ("x", "a"))
+    assert d.row_filters == {"orders": frozenset({"a"})}
+
+
+def test_deps_zero_arg_datetime_degrades(dep_db):
+    # datetime()/date()/time()/julianday()/strftime('%s') default to
+    # 'now': clock-dependent with no table write (review finding).
+    for fn in ("datetime()", "date()", "julianday()"):
+        d = query_dependencies(
+            dep_db, f'SELECT "title" FROM "todo" WHERE "title" > {fn}')
+        assert d.tables is None, fn
+
+
+def test_deps_internal_tables_outside_contract_degrade(dep_db):
+    # "__clock" is written by update_clock OUTSIDE the apply layer —
+    # invisible to the changed-set contract, so reading it must force
+    # re-execution (review finding). "__message" IS recorded: gated.
+    dep_db.exec('CREATE TABLE "__clock" ("timestamp", "merkle_tree")')
+    dep_db.exec('CREATE TABLE "__message" ("timestamp" TEXT PRIMARY KEY)')
+    d = query_dependencies(dep_db, 'SELECT "timestamp" FROM "__clock"')
+    assert d.tables is None
+    d = query_dependencies(dep_db, 'SELECT "timestamp" FROM "__message"')
+    assert d.tables == frozenset({"__message"})
+
+
+# --- storage/changes.py ----------------------------------------------
+
+
+def test_changed_set_contract():
+    c = ChangedSet()
+    assert not c
+    c.add_cell("t", "r1")
+    c.add_cell("t", "r2")
+    assert c and c.rows["t"] == {"r1", "r2"}
+    c.add_table("t")  # unknown rows dominate
+    c.add_cell("t", "r3")
+    assert c.rows["t"] is None
+    d = ChangedSet()
+    d.add_cell("u", "x")
+    d.mark_unknown()
+    c.merge(d)
+    assert c.conservative and c.rows["u"] == {"x"}
+
+
+def test_changed_set_row_cap_escalates():
+    c = ChangedSet()
+    for i in range(ROW_SET_CAP + 10):
+        c.add_cell("t", f"r{i}")
+    assert c.rows["t"] is None  # degraded to all-rows, never dropped
+
+
+# --- worker gating ----------------------------------------------------
+
+
+def snap_counters():
+    names = ("evolu_query_executed_total", "evolu_query_skipped_clean_total",
+             "evolu_query_skipped_by_table_total",
+             "evolu_query_skipped_by_rows_total",
+             "evolu_query_conservative_total")
+    return {n: metrics.get_counter(n) for n in names}
+
+
+def counter_delta(before, name):
+    return metrics.get_counter(name) - before[name]
+
+
+def test_table_disjoint_and_clean_skips():
+    w, outputs, _ = make_worker()
+    q = q_str('SELECT "id", "title" FROM "todo" ORDER BY "title"')
+    w.handle(msg.Send((NewCrdtMessage("todo", "r1", "title", "a"),), (), (q,)))
+    assert any(isinstance(o, msg.OnQuery) for o in outputs)
+    outputs.clear()
+
+    before = snap_counters()
+    w.handle(msg.Query((q,)))  # nothing changed since: clean skip
+    assert counter_delta(before, "evolu_query_skipped_clean_total") == 1
+    assert not outputs
+
+    # A write to a DIFFERENT table skips without any read.
+    before = snap_counters()
+    w.handle(msg.Send((NewCrdtMessage("other", "o1", "name", "x"),), (), (q,)))
+    assert counter_delta(before, "evolu_query_skipped_by_table_total") == 1
+    assert not any(isinstance(o, msg.OnQuery) for o in outputs)
+    outputs.clear()
+
+    # A write to the read table executes and patches.
+    before = snap_counters()
+    w.handle(msg.Send((NewCrdtMessage("todo", "r1", "title", "b"),), (), (q,)))
+    assert counter_delta(before, "evolu_query_executed_total") >= 1
+    assert any(isinstance(o, msg.OnQuery) for o in outputs)
+    assert w.queries_rows_cache[q][0]["title"] == "b"
+
+
+def test_row_disjoint_skip_and_overlap():
+    w, outputs, _ = make_worker()
+    qa = q_str('SELECT "id", "title" FROM "todo" WHERE "id" = ?', ("ra",))
+    qb = q_str('SELECT "id", "title" FROM "todo" WHERE "id" = ?', ("rb",))
+    w.handle(msg.Send((NewCrdtMessage("todo", "ra", "title", "a"),
+                       NewCrdtMessage("todo", "rb", "title", "b")), (), (qa, qb)))
+    outputs.clear()
+
+    before = snap_counters()
+    w.handle(msg.Send((NewCrdtMessage("todo", "ra", "title", "a2"),), (), (qa, qb)))
+    # qb is row-disjoint from the write; qa must execute and patch.
+    assert counter_delta(before, "evolu_query_skipped_by_rows_total") == 1
+    assert counter_delta(before, "evolu_query_executed_total") == 1
+    patches = [o for o in outputs if isinstance(o, msg.OnQuery)]
+    assert len(patches) == 1
+    assert [p[0] for p in patches[0].queries_patches] == [qa]
+    assert w.queries_rows_cache[qa][0]["title"] == "a2"
+    assert w.queries_rows_cache[qb][0]["title"] == "b"
+
+
+def test_or_query_is_not_row_gated():
+    # Reviewer repro: WHERE "done" = ? OR "title" = ? AND "id" = ?
+    # parses as `done=? OR (title=? AND id=?)` — a write to a DIFFERENT
+    # row can flip the OR arm and change the result, so the id equality
+    # must not produce a row filter. Pre-fix, the write below was
+    # skipped-by-rows and the subscription went permanently stale.
+    w, outputs, _ = make_worker()
+    q = q_str('SELECT "id", "title" FROM "todo" '
+              'WHERE "done" = ? OR "title" = ? AND "id" = ?',
+              ("x", "t-other", "ra"))
+    w.handle(msg.Send((NewCrdtMessage("todo", "ra", "title", "t-a"),), (), (q,)))
+    outputs.clear()
+
+    before = snap_counters()
+    w.handle(msg.Send((NewCrdtMessage("todo", "rb", "done", "x"),), (), (q,)))
+    assert counter_delta(before, "evolu_query_skipped_by_rows_total") == 0
+    assert counter_delta(before, "evolu_query_executed_total") >= 1
+    patches = [o for o in outputs if isinstance(o, msg.OnQuery)]
+    assert patches, "OR-bearing query wrongly row-gated: stale subscription"
+    assert [r["id"] for r in w.queries_rows_cache[q]] == ["rb"]
+
+
+def test_conservative_paths_always_execute():
+    w, outputs, _ = make_worker()
+    # Unknown deps (schema read): every mutation re-executes it.
+    qm = q_str("SELECT COUNT(*) AS n FROM sqlite_master")
+    w.handle(msg.Query((qm,)))
+    before = snap_counters()
+    w.handle(msg.Send((NewCrdtMessage("todo", "r1", "title", "a"),), (), (qm,)))
+    assert counter_delta(before, "evolu_query_conservative_total") == 1
+    assert counter_delta(before, "evolu_query_executed_total") == 1
+
+    # UpdateDbSchema marks the change log conservative: even a
+    # table-disjoint query must re-execute once afterwards.
+    qt = q_str('SELECT "id" FROM "todo" ORDER BY "id"')
+    w.handle(msg.Query((qt,)))
+    w.handle(msg.UpdateDbSchema(
+        (TableDefinition.of("third", ("name",)),)))
+    before = snap_counters()
+    w.handle(msg.Query((qt,)))
+    assert counter_delta(before, "evolu_query_conservative_total") == 1
+    assert counter_delta(before, "evolu_query_executed_total") == 1
+
+
+def test_full_flag_and_sync_refresh_bypass_gating():
+    w, outputs, _ = make_worker()
+    q = q_str('SELECT "id", "title" FROM "todo" ORDER BY "id"')
+    w.handle(msg.Send((NewCrdtMessage("todo", "r1", "title", "a"),), (), (q,)))
+    outputs.clear()
+    # A FOREIGN write the change log cannot see (another process on a
+    # shared DB file in production; direct SQL here).
+    w.db.run('UPDATE "todo" SET "title" = ? WHERE "id" = ?', ("foreign", "r1"))
+    w.handle(msg.Query((q,)))  # gated: skips, stale cache tolerated
+    assert not outputs
+    w.handle(msg.Query((q,), full=True))  # bypass: picks the write up
+    assert any(isinstance(o, msg.OnQuery) for o in outputs)
+    assert w.queries_rows_cache[q][0]["title"] == "foreign"
+    outputs.clear()
+    # Sync refresh is equally ungated.
+    w.db.run('UPDATE "todo" SET "title" = ? WHERE "id" = ?', ("foreign2", "r1"))
+    w.handle(msg.Sync((q,)))
+    assert any(isinstance(o, msg.OnQuery) for o in outputs)
+    assert w.queries_rows_cache[q][0]["title"] == "foreign2"
+
+
+def test_failed_send_rollback_semantics():
+    """Two failure shapes: a Send refused BEFORE any write (wire
+    encodability screen) records nothing — the DB is untouched, so a
+    clean skip afterwards is correct, not stale. A command that fails
+    AFTER its apply recorded changes commits the recorded superset
+    (handle()'s failure path), so later sweeps re-verify."""
+    w, outputs, _ = make_worker()
+    q = q_str('SELECT "id", "title" FROM "todo" ORDER BY "id"')
+    w.handle(msg.Send((NewCrdtMessage("todo", "r1", "title", "a"),), (), (q,)))
+    outputs.clear()
+    w.handle(msg.Send((NewCrdtMessage("todo", "r1", "title", b"bytes"),), (), (q,)))
+    assert any(isinstance(o, msg.OnError) for o in outputs)
+    outputs.clear()
+    before = snap_counters()
+    w.handle(msg.Query((q,)))  # pre-write refusal: clean skip is sound
+    assert counter_delta(before, "evolu_query_skipped_clean_total") == 1
+    assert not outputs
+
+    # Now fail AFTER the apply wrote rows: the whole transaction rolls
+    # back, but the recorded changed-set must survive so the next sweep
+    # re-executes (it re-reads the unchanged rows and emits nothing —
+    # conservative, never stale).
+    import evolu_tpu.runtime.worker as worker_mod
+
+    real_update = worker_mod.update_clock
+
+    def explode(db, clock):
+        raise RuntimeError("injected post-apply failure")
+
+    worker_mod.update_clock = explode
+    try:
+        w.handle(msg.Send((NewCrdtMessage("todo", "r1", "title", "c"),), (), (q,)))
+    finally:
+        worker_mod.update_clock = real_update
+    assert any(isinstance(o, msg.OnError) for o in outputs)
+    outputs.clear()
+    before = snap_counters()
+    w.handle(msg.Query((q,)))
+    assert counter_delta(before, "evolu_query_executed_total") == 1
+    assert not outputs  # rollback: rows unchanged, no patch
+    assert w.queries_rows_cache[q][0]["title"] == "a"
+
+
+# --- LRU bounding (satellite: churned one-shots must not leak) --------
+
+
+def test_one_shot_query_churn_stays_bounded():
+    w, _outputs, _ = make_worker(query_cache_max=8)
+    for i in range(200):
+        w.handle(msg.Query((q_str(
+            'SELECT "id" FROM "todo" WHERE "title" = ?', (f"t{i}",)),)))
+    assert len(w.queries_rows_cache) <= 8
+    assert len(w.queries_raw_cache) <= 8
+    assert len(w._query_deps) <= 16
+    assert len(w._query_seen) <= 16
+    assert len(w._query_lru) <= 16
+    assert metrics.get_counter("evolu_query_cache_evictions_total") > 0
+
+
+def test_evicted_live_query_self_heals_with_root_replace():
+    w, outputs, _ = make_worker(query_cache_max=2)
+    qs = [q_str('SELECT "id", "title" FROM "todo" WHERE "id" = ?', (f"r{i}",))
+          for i in range(4)]
+    w.handle(msg.Send(
+        tuple(NewCrdtMessage("todo", f"r{i}", "title", f"t{i}") for i in range(4)),
+        (), tuple(qs)))
+    # Cap 2: the two least-recently-executed entries were evicted.
+    assert len(w.queries_rows_cache) == 2
+    outputs.clear()
+    # Simulated subscriber state for q0 from the patches so far: rows
+    # [r0]. Re-running the evicted q0 must emit a ROOT-REPLACE (index
+    # ops against [] would corrupt any live subscriber).
+    w.handle(msg.Query((qs[0],)))
+    patched = [o for o in outputs if isinstance(o, msg.OnQuery)]
+    assert len(patched) == 1
+    (q0, ops), = patched[0].queries_patches
+    assert q0 == qs[0]
+    assert ops[0]["path"] == "" and ops[0]["op"] == "replace"
+    assert [r["title"] for r in ops[0]["value"]] == ["t0"]
+    # Applying it over ANY stale client state converges.
+    assert apply_patch([{"id": "stale", "title": "stale"}], ops) == ops[0]["value"]
+
+
+def test_evicted_query_going_empty_still_patches():
+    """Evict a live query whose cached rows were non-empty, delete
+    those rows, re-run — the empty result must still reach subscribers
+    as a root-replace (no-baseline executions ALWAYS root-replace, so
+    no tombstone bookkeeping can cap out and drop the guarantee)."""
+    w, outputs, _ = make_worker(query_cache_max=2)
+    q0 = q_str('SELECT "id", "title" FROM "todo" WHERE "isDeleted" is not 1 '
+               'AND "id" = ?', ("r0",))
+    w.handle(msg.Send((NewCrdtMessage("todo", "r0", "title", "t0"),), (), (q0,)))
+    assert w.queries_rows_cache[q0]
+    # Churn unrelated queries past the cap to evict q0.
+    for i in range(4):
+        w.handle(msg.Query((q_str(
+            'SELECT "id" FROM "other" WHERE "name" = ?', (f"n{i}",)),)))
+    assert q0 not in w.queries_rows_cache
+    outputs.clear()
+    w.handle(msg.Send((NewCrdtMessage("todo", "r0", "isDeleted", 1),), (), (q0,)))
+    patched = [o for o in outputs if isinstance(o, msg.OnQuery)]
+    assert len(patched) == 1
+    (_q, ops), = patched[0].queries_patches
+    assert ops == [{"op": "replace", "path": "", "value": []}]
+
+
+def test_evict_queries_drops_every_structure():
+    w, _outputs, _ = make_worker()
+    q = q_str('SELECT "id" FROM "todo"')
+    w.handle(msg.Query((q,)))
+    assert q in w._query_deps and q in w._query_seen
+    w.handle(msg.EvictQueries((q,)))
+    for store in (w.queries_rows_cache, w.queries_raw_cache, w._query_deps,
+                  w._query_seen, w._query_lru):
+        assert q not in store
+
+
+def test_same_table_subquery_never_skipped_stale():
+    """End-to-end pin of the subquery review finding: a detail query
+    carrying a scalar subquery over the SAME table must re-execute on
+    writes to OTHER rows (its aggregate depends on them)."""
+    w, outputs, _ = make_worker()
+    q = q_str('SELECT (SELECT count(*) FROM "todo") AS n, "title" '
+              'FROM "todo" WHERE "id" = ?', ("ra",))
+    w.handle(msg.Send((NewCrdtMessage("todo", "ra", "title", "a"),), (), (q,)))
+    assert w.queries_rows_cache[q][0]["n"] == 1
+    # A row-disjoint write: the filter-less deps must force re-execution.
+    w.handle(msg.Send((NewCrdtMessage("todo", "rb", "title", "b"),), (), (q,)))
+    assert w.queries_rows_cache[q][0]["n"] == 2, "stale aggregate delivered"
+
+
+def test_self_join_never_skipped_stale():
+    """End-to-end pin of the self-join review finding: the aliased
+    second cursor reads rows the id filter doesn't bound."""
+    w, _outputs, _ = make_worker()
+    q = q_str('SELECT "x"."title" FROM "todo" JOIN "todo" AS "x" '
+              'ON "x"."done" = "todo"."id" WHERE "todo"."id" = ?', ("parent",))
+    w.handle(msg.Send((NewCrdtMessage("todo", "parent", "title", "p"),
+                       NewCrdtMessage("todo", "child", "title", "c1"),
+                       NewCrdtMessage("todo", "child", "done", "parent")),
+                      (), (q,)))
+    assert [r["title"] for r in w.queries_rows_cache[q]] == ["c1"]
+    # Write to the CHILD row (row-disjoint from the 'parent' filter):
+    w.handle(msg.Send((NewCrdtMessage("todo", "child", "title", "c2"),), (), (q,)))
+    assert [r["title"] for r in w.queries_rows_cache[q]] == ["c2"], \
+        "stale self-join result delivered"
+
+
+def test_clock_query_never_skipped_stale():
+    """End-to-end pin of the __clock review finding: update_clock
+    writes outside the changed-set contract on every Send."""
+    w, _outputs, _ = make_worker()
+    q = q_str('SELECT "timestamp" FROM "__clock"')
+    w.handle(msg.Send((NewCrdtMessage("todo", "ra", "title", "a"),), (), (q,)))
+    t0 = w.queries_rows_cache[q][0]["timestamp"]
+    # A table-disjoint app write still advances the clock.
+    w.handle(msg.Send((NewCrdtMessage("other", "o1", "name", "n"),), (), (q,)))
+    t1 = w.queries_rows_cache[q][0]["timestamp"]
+    assert t1 != t0, "stale clock row delivered"
+    assert t1 == w.db.exec_sql_query('SELECT "timestamp" FROM "__clock"')[0]["timestamp"]
+
+
+def test_case_variant_wire_table_never_skipped_stale():
+    """End-to-end pin of the identifier-case review finding: SQLite
+    resolves a remote message's table "TODO" into the table created as
+    "todo", so the changed-set and the read set must fold to one key."""
+    w, _outputs, _ = make_worker()
+    q = q_str('SELECT "id", "title" FROM "todo" WHERE "id" = ?', ("ra",))
+    w.handle(msg.Send((NewCrdtMessage("todo", "ra", "title", "a"),), (), (q,)))
+    assert w.queries_rows_cache[q][0]["title"] == "a"
+    w.handle(msg.Receive(
+        (CrdtMessage(remote_ts(1), "TODO", "ra", "title", "remote"),),
+        EMPTY_TREE))
+    w.handle(msg.Query((q,)))
+    assert w.queries_rows_cache[q][0]["title"] == "remote", \
+        "case-variant wire write left the subscription stale"
+
+
+def test_text_affinity_id_param_never_skipped_stale():
+    """End-to-end pin of the TEXT-affinity review finding: `"id" = 5`
+    (int param) matches the row whose id is '5'; a write to that row
+    must re-execute the subscription."""
+    w, outputs, _ = make_worker()
+    q = q_str('SELECT "id", "title" FROM "todo" WHERE "id" = ?', (5,))
+    w.handle(msg.Send((NewCrdtMessage("todo", "5", "title", "t0"),), (), (q,)))
+    assert w.queries_rows_cache[q][0]["title"] == "t0"
+    w.handle(msg.Send((NewCrdtMessage("todo", "5", "title", "t1"),), (), (q,)))
+    assert w.queries_rows_cache[q][0]["title"] == "t1", "stale row delivered"
+
+
+# --- satellite: stale-.so no-offsets fallback -------------------------
+
+
+def test_stale_so_no_offsets_fallback_identical_patches():
+    """runtime/worker.py's `offs is None` branch (a stale pre-r5 .so
+    returns no offsets): pin that the full-unpack fallback emits
+    byte-identical output streams by driving twin workers through the
+    same schedule, one with offsets stripped."""
+    from evolu_tpu.storage.native import native_available
+
+    if not native_available():
+        pytest.skip("native backend unavailable (raw path is native-only)")
+
+    w1, out1, _ = make_worker()
+    w2, out2, _ = make_worker()
+    real = type(w2.db).exec_sql_query_packed_raw
+
+    def no_offsets(sql, parameters=(), with_offsets=False):
+        out = real(w2.db, sql, parameters, with_offsets)
+        if with_offsets:
+            raw, _offs = out
+            return raw, None
+        return out
+
+    w2.db.exec_sql_query_packed_raw = no_offsets
+    q = q_str('SELECT "id", "title", "done" FROM "todo" ORDER BY "title"')
+    schedule = [
+        msg.Send(tuple(NewCrdtMessage("todo", f"r{i}", "title", f"t{i:02d}")
+                       for i in range(8)), (), (q,)),
+        msg.Send((NewCrdtMessage("todo", "r3", "done", 1),), (), (q,)),
+        msg.Query((q,)),
+        msg.Send((NewCrdtMessage("todo", "r3", "title", "zz"),), (), (q,)),
+    ]
+    for cmd in schedule:
+        w1.handle(cmd)
+        w2.handle(cmd)
+    assert out1 == out2
+    assert w1.queries_rows_cache[q] == w2.queries_rows_cache[q]
+    # And the fallback actually engaged (no offsets cached anywhere).
+    assert all(e[1] is None for e in w2.queries_raw_cache.values())
+
+
+# --- acceptance: byte-identity vs the re-run-everything oracle --------
+
+
+def dual_run(schedule, **cfg_kw):
+    """Run `schedule` against a gated worker and the ungated oracle;
+    the outputs and end states must match exactly."""
+    w_gated, out_gated, push_gated = make_worker(query_invalidation=True, **cfg_kw)
+    w_naive, out_naive, push_naive = make_worker(query_invalidation=False, **cfg_kw)
+    for cmd in schedule:
+        w_gated.handle(cmd)
+        w_naive.handle(cmd)
+    gated_stream = [o for o in out_gated if not isinstance(o, msg.OnError)]
+    naive_stream = [o for o in out_naive if not isinstance(o, msg.OnError)]
+    assert gated_stream == naive_stream
+    assert ([type(o).__name__ for o in out_gated]
+            == [type(o).__name__ for o in out_naive])
+    assert push_gated == push_naive
+    for sql in ('SELECT * FROM "__message" ORDER BY "timestamp"',
+                'SELECT * FROM "todo" ORDER BY "id"',
+                'SELECT * FROM "other" ORDER BY "id"'):
+        assert w_gated.db.exec(sql) == w_naive.db.exec(sql)
+    return w_gated, w_naive
+
+
+def full_schedule(chunked=False):
+    q_list = q_str('SELECT "id", "title", "done" FROM "todo" ORDER BY "title"')
+    q_detail = q_str('SELECT "id", "title" FROM "todo" WHERE "id" = ?', ("ra",))
+    q_other = q_str('SELECT "id", "name" FROM "other" ORDER BY "id"')
+    qs = (q_list, q_detail, q_other)
+    remote = tuple(
+        CrdtMessage(remote_ts(i, counter=i), "todo", f"rem{i % 3}", "title", f"m{i}")
+        for i in range(12 if chunked else 4)
+    )
+    non_canonical = tuple(
+        CrdtMessage(remote_ts(100 + i, counter=i, upper=True),
+                    "todo", "ra", "done", i)
+        for i in range(3)
+    )
+    return [
+        msg.Send((NewCrdtMessage("todo", "ra", "title", "a"),
+                  NewCrdtMessage("todo", "rb", "title", "b")), (), qs),
+        msg.Query(qs),
+        # table-disjoint for the todo queries
+        msg.Send((NewCrdtMessage("other", "o1", "name", "n1"),), (), qs),
+        # row-disjoint for q_detail
+        msg.Send((NewCrdtMessage("todo", "rb", "done", 1),), ("cb1",), qs),
+        msg.Query(qs),
+        # remote batch (object or packed route per backend), then the
+        # client-style re-run sweep
+        msg.Receive(remote, EMPTY_TREE),
+        msg.Query(qs),
+        # non-canonical case: bounces to the host oracle mid-stream
+        msg.Receive(non_canonical, EMPTY_TREE),
+        msg.Query(qs),
+        # rollback: un-encodable value aborts the Send
+        msg.Send((NewCrdtMessage("todo", "ra", "title", b"\x00bytes"),), (), qs),
+        msg.Query(qs),
+        msg.EvictQueries((q_other,)),
+        msg.Query(qs),
+        msg.Sync(qs),
+    ]
+
+
+def test_byte_identity_gated_vs_oracle_cpu_backend():
+    before = snap_counters()
+    dual_run(full_schedule())
+    # The gate actually engaged across the schedule.
+    assert counter_delta(before, "evolu_query_skipped_by_table_total") > 0
+    assert counter_delta(before, "evolu_query_skipped_by_rows_total") > 0
+    assert counter_delta(before, "evolu_query_skipped_clean_total") > 0
+
+
+def test_byte_identity_gated_vs_oracle_device_planner():
+    """backend="tpu" routes every batch through the device planner +
+    HBM winner cache; the non-canonical batch exercises
+    `merge._host_fallback` with cache invalidation mid-schedule."""
+    dual_run(full_schedule(), backend="tpu", winner_cache=True)
+
+
+def test_byte_identity_chunked_receive():
+    dual_run(full_schedule(chunked=True), receive_chunk_size=5)
+
+
+def test_byte_identity_typed_crdt_ops():
+    """Typed CRDT materializers report their changed rows (and the
+    __crdt_* tables) through the same contract."""
+    from evolu_tpu.core.crdt_types import counter_delta as cdelta
+
+    tds = SCHEMA_TDS + (TableDefinition.of("metrics", ("name", "clicks:counter")),)
+    q_m = q_str('SELECT "id", "clicks" FROM "metrics" WHERE "id" = ?', ("m1",))
+    q_t = q_str('SELECT "id", "title" FROM "todo" ORDER BY "id"')
+    schedule = [
+        msg.UpdateDbSchema(tds),
+        msg.Send((NewCrdtMessage("metrics", "m1", "name", "m"),), (), (q_m, q_t)),
+        msg.Send((NewCrdtMessage("metrics", "m1", "clicks", cdelta(3)),), (), (q_m, q_t)),
+        msg.Query((q_m, q_t)),
+        msg.Send((NewCrdtMessage("metrics", "m1", "clicks", cdelta(-1)),), (), (q_m, q_t)),
+        msg.Query((q_m, q_t)),
+    ]
+    w_gated, _ = dual_run(schedule)
+    assert w_gated.queries_rows_cache[q_m][0]["clicks"] == 2
+
+
+# --- client-level: eviction under live subscriptions ------------------
+
+
+def test_client_subscriptions_survive_cache_eviction():
+    """End-to-end through the Evolu client: with a cache cap smaller
+    than the subscription count, every subscriber still converges to
+    fresh rows (root-replace self-healing), byte-equal to direct SQL."""
+    from evolu_tpu.api.query import table
+    from evolu_tpu.runtime.client import create_evolu
+
+    e = create_evolu({"todo": ("title", "done")},
+                     config=Config(query_cache_max=2))
+    try:
+        ids = [e.create("todo", {"title": f"t{i}", "done": 0}) for i in range(5)]
+        e.worker.flush()
+        qs = [table("todo").select("id", "title", "done")
+              .where("id", "=", rid).serialize() for rid in ids]
+        for q in qs:
+            e.subscribe_query(q)
+        e.worker.flush()
+        for i, rid in enumerate(ids):
+            e.update("todo", rid, {"done": 1})
+        e.worker.flush()
+        for q, rid in zip(qs, ids):
+            sql, params = msg.deserialize_query(q)
+            assert e.get_query_rows(q) == e.db.exec_sql_query(sql, params)
+            assert e.get_query_rows(q)[0]["done"] == 1
+    finally:
+        e.dispose()

@@ -733,10 +733,15 @@ def test_create_hooks_analog():
         assert view.rows == [{"title": "a"}]
         assert hooks.use_evolu_first_data_are_loaded()
         assert changes and changes[-1] == [{"title": "a"}]
+        # r9: the subscription's FIRST sweep delivers its (empty)
+        # baseline as a root-replace — one initial [] notification
+        # (reference useQuery notifies on first load too).
+        assert changes[0] == []
+        fired = len(changes)
         unsub()
         mutate("todo", {"title": "b"})
         hooks.evolu.worker.flush()
-        assert len(view.rows) == 2 and len(changes) == 1  # unsubscribed
+        assert len(view.rows) == 2 and len(changes) == fired  # unsubscribed
         assert hooks.use_owner() is hooks.evolu.owner
         view.dispose()
     finally:
