@@ -1,22 +1,31 @@
-"""Observability: metrics registry + flight recorder (host-side only).
+"""Observability: metrics registry + flight recorder + distributed
+tracing (host-side only).
 
 `obs.metrics` — thread-safe counters/gauges/histograms with Prometheus
-v0.0.4 text exposition and a JSON snapshot, served by the relay at
-GET /metrics and GET /stats (server/relay.py).
+v0.0.4 text exposition, a JSON snapshot, span-derived exemplars, and a
+label-cardinality bound, served by the relay at GET /metrics and
+GET /stats (server/relay.py).
 
 `obs.flight` — bounded structured-event ring whose dump is attached to
 exceptions crossing the worker/relay boundary.
 
+`obs.trace` — W3C-traceparent-style distributed tracing: a bounded
+per-process span ring, deterministic hash-based sampling, fan-in span
+links, `GET /trace/<id>` span trees, and a Chrome-trace export
+(ISSUE 10 — one mutation followed client → relay → batch → engine →
+replica).
+
 This package MUST NOT import jax (directly or transitively): metrics
-record host-side Python values the hot paths already hold — never an
-extra device pull, never an op inside the fused jit pipeline. The
-constraint is load-bearing (instrumentation overhead budget is <=1% of
-the 1M-row reconcile) and mechanically enforced by
+and spans record host-side Python values the hot paths already hold —
+never an extra device pull, never an op inside the fused jit pipeline.
+The constraint is load-bearing (instrumentation overhead budget is
+<=1% — metrics measured at 0.0015%, tracing gated by
+benchmarks/trace_overhead.py) and mechanically enforced by
 tests/test_import_hygiene.py and tests/test_bench_liveness.py.
 """
 
-from evolu_tpu.obs import flight, metrics
+from evolu_tpu.obs import flight, metrics, trace
 from evolu_tpu.obs.flight import recorder
 from evolu_tpu.obs.metrics import registry, set_enabled
 
-__all__ = ["flight", "metrics", "recorder", "registry", "set_enabled"]
+__all__ = ["flight", "metrics", "trace", "recorder", "registry", "set_enabled"]

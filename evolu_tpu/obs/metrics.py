@@ -23,12 +23,30 @@ Design constraints (the device-path invariant from the issue):
 Histograms use FIXED log-spaced buckets chosen per family at first
 observe (defaults below) so exposition shape is batch-independent and
 two snapshots always subtract cleanly.
+
+Two ISSUE-10 extensions:
+- **Label-cardinality bound.** Per-owner/per-peer trace labels (the
+  convergence-plane freshness gauges) mean label VALUES can now come
+  from data, not just code. Each family admits at most
+  `label_cardinality_cap` distinct label sets; past the cap, new sets
+  fold into one `"__overflow__"` value per label (the aggregate stays
+  countable) and `evolu_obs_label_overflow_total{family=...}` counts
+  the folds — the registry can never grow unboundedly from hostile or
+  merely numerous label values.
+- **Exemplars.** `observe(..., exemplar=trace_id)` attaches the most
+  recent trace id to a histogram series (OpenMetrics exemplar
+  semantics: one per series, latest wins — enough to jump from a
+  latency histogram to `GET /trace/<id>`). Exposed via `snapshot()`
+  and `get_exemplar`; the text exposition stays Prometheus 0.0.4
+  unless `render_prometheus(exemplars=True)` opts into the
+  OpenMetrics-style `# {trace_id="..."}` suffix.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
@@ -78,12 +96,22 @@ def _fmt_num(v: float) -> str:
 
 
 class _Hist:
-    __slots__ = ("counts", "sum", "count")
+    __slots__ = ("counts", "sum", "count", "exemplar")
 
     def __init__(self, n_buckets: int):
         self.counts = [0] * (n_buckets + 1)  # last = +Inf overflow
         self.sum = 0.0
         self.count = 0
+        # (trace_id, value, unix_ts) of the latest exemplar-bearing
+        # observe, or None — OpenMetrics semantics, latest wins.
+        self.exemplar: Optional[Tuple[str, float, float]] = None
+
+
+# Distinct label sets a family admits before new sets fold into the
+# "__overflow__" aggregate. Generous: code-controlled label sets
+# (shards, endpoints, peers) sit far below it; only data-driven
+# labels (per-owner gauges) ever approach it.
+LABEL_CARDINALITY_CAP = 512
 
 
 class MetricsRegistry:
@@ -98,6 +126,7 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self.enabled = True
+        self.label_cardinality_cap = LABEL_CARDINALITY_CAP
         self._counters: Dict[str, Dict[_LabelItems, float]] = {}
         self._gauges: Dict[str, Dict[_LabelItems, float]] = {}
         self._hists: Dict[str, Dict[_LabelItems, _Hist]] = {}
@@ -106,12 +135,26 @@ class MetricsRegistry:
 
     # -- write side (hot paths) --
 
+    def _admit(self, fam: dict, name: str, key: _LabelItems) -> _LabelItems:
+        """Cardinality gate, called under the lock: an already-known
+        key (or the unlabeled key) passes untouched; a NEW key past
+        the per-family cap folds every label value into "__overflow__"
+        and counts the fold. Direct dict write for the fold counter —
+        re-entering inc() under the held lock would deadlock."""
+        if key in fam or not key or len(fam) < self.label_cardinality_cap:
+            return key
+        ofam = self._counters.setdefault("evolu_obs_label_overflow_total", {})
+        okey: _LabelItems = (("family", name),)
+        ofam[okey] = ofam.get(okey, 0) + 1
+        return tuple((k, "__overflow__") for k, _v in key)
+
     def inc(self, name: str, value: float = 1, **labels) -> None:
         if not self.enabled or value == 0:
             return
         key = _label_key(labels)
         with self._lock:
             fam = self._counters.setdefault(name, {})
+            key = self._admit(fam, name, key)
             fam[key] = fam.get(key, 0) + value
 
     def set_gauge(self, name: str, value: float, **labels) -> None:
@@ -119,15 +162,19 @@ class MetricsRegistry:
             return
         key = _label_key(labels)
         with self._lock:
-            self._gauges.setdefault(name, {})[key] = float(value)
+            fam = self._gauges.setdefault(name, {})
+            fam[self._admit(fam, name, key)] = float(value)
 
     def observe(
         self, name: str, value: float,
-        buckets: Optional[Sequence[float]] = None, **labels,
+        buckets: Optional[Sequence[float]] = None,
+        exemplar: Optional[str] = None, **labels,
     ) -> None:
         """Record into a histogram; `buckets` fixes the family's edges
         on first observe (LATENCY_MS_BUCKETS otherwise) and is ignored
-        afterwards — exposition shape must not drift per call."""
+        afterwards — exposition shape must not drift per call.
+        `exemplar` (a trace id) replaces the series' stored exemplar
+        (latest wins)."""
         if not self.enabled:
             return
         key = _label_key(labels)
@@ -138,6 +185,7 @@ class MetricsRegistry:
                     buckets if buckets is not None else LATENCY_MS_BUCKETS
                 )
             fam = self._hists.setdefault(name, {})
+            key = self._admit(fam, name, key)
             h = fam.get(key)
             if h is None:
                 h = fam[key] = _Hist(len(edges))
@@ -145,6 +193,8 @@ class MetricsRegistry:
             h.counts[i] += 1
             h.sum += value
             h.count += 1
+            if exemplar is not None:
+                h.exemplar = (exemplar, float(value), time.time())
 
     def describe(self, name: str, help_: str) -> None:
         with self._lock:
@@ -172,6 +222,13 @@ class MetricsRegistry:
                 acc += c
                 cum.append(acc)
             return edges, cum, h.sum, h.count
+
+    def get_exemplar(self, name: str, **labels):
+        """(trace_id, value, unix_ts) of a histogram series' latest
+        exemplar, or None."""
+        with self._lock:
+            h = self._hists.get(name, {}).get(_label_key(labels))
+            return h.exemplar if h is not None else None
 
     def quantile(self, name: str, q: float, **labels) -> Optional[float]:
         """Estimate the q-quantile (0..1) from a histogram's log-spaced
@@ -207,8 +264,12 @@ class MetricsRegistry:
 
     # -- exposition --
 
-    def render_prometheus(self) -> str:
-        """Prometheus text exposition format version 0.0.4."""
+    def render_prometheus(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition format version 0.0.4. With
+        `exemplars=True` the +Inf bucket line of a series carrying an
+        exemplar gets the OpenMetrics-style `# {trace_id="..."} v ts`
+        suffix — opt-in because 0.0.4 scrapers do not expect it (the
+        relay's /metrics default stays plain 0.0.4)."""
         with self._lock:
             lines: List[str] = []
             for name in sorted(self._counters):
@@ -230,7 +291,12 @@ class MetricsRegistry:
                         lines.append(f"{name}_bucket{le} {acc}")
                     acc += h.counts[-1]
                     le = _fmt_labels(key, 'le="+Inf"')
-                    lines.append(f"{name}_bucket{le} {acc}")
+                    ex = ""
+                    if exemplars and h.exemplar is not None:
+                        tid, v, ts = h.exemplar
+                        ex = (f' # {{trace_id="{_escape(str(tid))}"}} '
+                              f"{_fmt_num(v)} {ts:.3f}")
+                    lines.append(f"{name}_bucket{le} {acc}{ex}")
                     lines.append(f"{name}_sum{_fmt_labels(key)} {_fmt_num(h.sum)}")
                     lines.append(f"{name}_count{_fmt_labels(key)} {h.count}")
             return "\n".join(lines) + ("\n" if lines else "")
@@ -263,6 +329,8 @@ class MetricsRegistry:
                         "counts": list(h.counts),
                         "sum": h.sum,
                         "count": h.count,
+                        **({"exemplar": list(h.exemplar)}
+                           if h.exemplar is not None else {}),
                     }
                     for k, h in sorted(fam.items())
                 ]
@@ -290,6 +358,8 @@ inc = registry.inc
 observe = registry.observe
 set_gauge = registry.set_gauge
 get_counter = registry.get_counter
+get_gauge = registry.get_gauge
+get_exemplar = registry.get_exemplar
 render_prometheus = registry.render_prometheus
 snapshot = registry.snapshot
 reset = registry.reset

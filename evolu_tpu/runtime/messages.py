@@ -138,3 +138,10 @@ class SyncRequestInput:
     merkle_tree: str
     owner: Owner
     previous_diff: Optional[int] = None
+    # Distributed-trace context of the mutation that produced this
+    # round (obs.trace.SpanContext), or None for pull-only rounds /
+    # untraced embedders. Carried IN-PROCESS only — on the wire the
+    # context rides the HTTP traceparent header, never the body.
+    # compare=False: two semantically identical rounds (twin-worker
+    # byte-identity oracles) carry different trace ids by design.
+    trace: Optional[object] = field(default=None, compare=False)

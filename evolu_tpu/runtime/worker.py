@@ -33,7 +33,7 @@ from evolu_tpu.core.timestamp import (
     timestamp_to_string,
 )
 from evolu_tpu.core.types import CrdtClock, CrdtMessage, Owner, SyncError
-from evolu_tpu.obs import flight, metrics
+from evolu_tpu.obs import flight, metrics, trace
 from evolu_tpu.runtime import messages as msg
 from evolu_tpu.runtime.jsonpatch import create_patch
 from evolu_tpu.runtime.synclock import SyncLock, get_sync_lock
@@ -544,7 +544,11 @@ class DbWorker:
         """send.ts:82-122: stamp → apply → persist clock → push → re-query.
 
         One wall-clock sample per command, like the reference's
-        per-command TimeEnv (types.ts:303-309)."""
+        per-command TimeEnv (types.ts:303-309). The mutation mints the
+        distributed-trace root span (obs.trace, ISSUE 10): its context
+        rides the staged SyncRequestInput into the sync transport and
+        from there the HTTP traceparent header — the one id that ties
+        client → relay → batch → engine → replica together."""
         # Refuse wire-unencodable values BEFORE they enter the log (the
         # whole command rolls back and surfaces as OnError): a committed
         # value the encoder cannot express (bytes always; float/int64 in
@@ -552,29 +556,34 @@ class DbWorker:
         # Remote messages are exempt — a replica relays what it received.
         for m in command.messages:
             assert_wire_encodable(m.value, self.config.wire_extensions)
-        clock = read_clock(self.db)
-        t = clock.timestamp
-        now = self.now()
-        stamped: List[CrdtMessage] = []
-        for m in command.messages:
-            t = send_timestamp(t, now, self.config.max_drift)
-            stamped.append(
-                CrdtMessage(timestamp_to_string(t), m.table, m.row, m.column, m.value)
-            )
-        tree = apply_messages(self.db, clock.merkle_tree, stamped,
-                              planner=self._planner,
-                              changes=self._staged_changes_or_none())
-        next_clock = CrdtClock(t, tree)
-        update_clock(self.db, next_clock)
-        self._push(
-            msg.SyncRequestInput(
-                messages=tuple(stamped),
-                clock_timestamp=timestamp_to_string(t),
-                merkle_tree=merkle_tree_to_string(tree),
-                owner=self.owner,
-            )
+        mspan = trace.start_span(
+            "client.mutate", attrs={"messages": len(command.messages)}
         )
-        self._query(command.queries, command.on_complete_ids)
+        with mspan, trace.use(mspan.context):
+            clock = read_clock(self.db)
+            t = clock.timestamp
+            now = self.now()
+            stamped: List[CrdtMessage] = []
+            for m in command.messages:
+                t = send_timestamp(t, now, self.config.max_drift)
+                stamped.append(
+                    CrdtMessage(timestamp_to_string(t), m.table, m.row, m.column, m.value)
+                )
+            tree = apply_messages(self.db, clock.merkle_tree, stamped,
+                                  planner=self._planner,
+                                  changes=self._staged_changes_or_none())
+            next_clock = CrdtClock(t, tree)
+            update_clock(self.db, next_clock)
+            self._push(
+                msg.SyncRequestInput(
+                    messages=tuple(stamped),
+                    clock_timestamp=timestamp_to_string(t),
+                    merkle_tree=merkle_tree_to_string(tree),
+                    owner=self.owner,
+                    trace=mspan.context,
+                )
+            )
+            self._query(command.queries, command.on_complete_ids)
 
     def _receive(self, command: msg.Receive) -> None:
         """receive.ts:144-199: merge remote messages, then anti-entropy."""

@@ -30,7 +30,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from evolu_tpu.obs import flight, metrics
+from evolu_tpu.obs import flight, metrics, trace
 from evolu_tpu.utils.log import log
 
 from evolu_tpu.core.merkle import (
@@ -499,6 +499,57 @@ class _Handler(BaseHTTPRequestHandler):
                 return None
         return serve_single_request(self.store, request)
 
+    def _obs_authorized(self) -> bool:
+        """Optional token gate for the observability read surface
+        (`GET /metrics`, `/stats`, `/trace/*`): with EVOLU_OBS_TOKEN
+        set, demand the matching header (constant-time compare — the
+        EVOLU_FLEET_RELOAD_TOKEN pattern from /fleet/reload). /stats
+        and /trace enumerate owner ids, which the sync path treats as
+        capabilities. Unset = open, the trusted-network default,
+        unchanged. False → 403 already answered."""
+        token = os.environ.get("EVOLU_OBS_TOKEN")
+        if not token:
+            return True
+        import hmac
+
+        got = self.headers.get("X-Evolu-Obs-Token", "")
+        # Compare BYTES: compare_digest raises TypeError on non-ASCII
+        # str inputs, and a hostile header must answer 403, not crash
+        # the handler thread.
+        if hmac.compare_digest(got.encode("utf-8", "replace"),
+                               token.encode("utf-8")):
+            return True
+        metrics.inc("evolu_relay_errors_total")
+        self.send_error(403, "observability token mismatch")
+        return False
+
+    def _do_trace(self) -> None:
+        """GET /trace → recent trace ids; GET /trace/<id> → the span
+        tree for one trace (fan-in spans included via their links);
+        `?format=chrome` → the Chrome-trace export of those spans.
+        A non-hex / wrong-length id answers 404 (it can never name a
+        trace), never a 500."""
+        import urllib.parse
+
+        parts = urllib.parse.urlsplit(self.path)
+        fmt = urllib.parse.parse_qs(parts.query).get("format", [""])[0]
+        tail = parts.path[len("/trace"):].strip("/")
+        if not tail:
+            body = json.dumps({
+                "recent": trace.recorder.recent_trace_ids(),
+                "span_ring": trace.recorder.size(),
+            }).encode("utf-8")
+        elif len(tail) != 32 or not all(c in "0123456789abcdef" for c in tail):
+            self.send_error(404, "not a trace id")
+            return
+        elif fmt == "chrome":
+            body = json.dumps(
+                trace.export_chrome(trace.recorder.spans_for(tail))
+            ).encode("utf-8")
+        else:
+            body = json.dumps(trace.serve_trace(tail)).encode("utf-8")
+        self._respond(200, body, "application/json")
+
     def do_GET(self) -> None:  # /ping (index.ts:250-252) + observability
         if self.path == "/ping":
             body = b"ok"
@@ -508,6 +559,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         elif self.path == "/metrics":
             metrics.inc("evolu_relay_requests_total", endpoint="/metrics")
+            if not self._obs_authorized():
+                return
             try:
                 body = metrics.render_prometheus().encode("utf-8")
             except Exception as e:  # noqa: BLE001 - scraper gets a clean 500
@@ -515,8 +568,23 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(500, str(e))
                 return
             self._respond(200, body, metrics.PROMETHEUS_CONTENT_TYPE)
+        elif self.path == "/trace" or self.path.startswith("/trace/") \
+                or self.path.startswith("/trace?"):
+            # One fixed endpoint label — raw paths must never mint
+            # registry series (the /replicate 404-before-metric rule).
+            metrics.inc("evolu_relay_requests_total", endpoint="/trace")
+            if not self._obs_authorized():
+                return
+            try:
+                self._do_trace()
+            except Exception as e:  # noqa: BLE001 - reader gets a clean 500
+                metrics.inc("evolu_relay_errors_total")
+                self.send_error(500, str(e))
+            return
         elif self.path == "/stats":
             metrics.inc("evolu_relay_requests_total", endpoint="/stats")
+            if not self._obs_authorized():
+                return
             try:
                 # store.stats() runs SQL: a shard closing mid-scrape
                 # must surface as an HTTP 500, not a dropped connection.
@@ -607,8 +675,18 @@ class _Handler(BaseHTTPRequestHandler):
         body = self.rfile.read(length)
         metrics.observe("evolu_relay_request_bytes", len(body),
                         buckets=metrics.SIZE_BUCKETS)
+        # Incoming trace context (obs/trace.py): a malformed or
+        # oversized traceparent parses to None and the request simply
+        # proceeds untraced — NEVER a 4xx/5xx (header-fuzz-pinned).
+        tctx = trace.parse_traceparent(
+            self.headers.get(trace.TRACEPARENT_HEADER)
+        )
+        srv_span = trace.start_span("relay.sync", parent=tctx,
+                                    attrs={"endpoint": "/"})
+        _tok = trace.activate(srv_span.context)
         try:
             request = protocol.decode_sync_request(body)
+            srv_span.set_attr("owner", request.user_id)
             if self.fleet is not None:
                 if not self._route_fleet(request, body):
                     return  # answered: 307/forwarded/503-not-ready
@@ -631,21 +709,39 @@ class _Handler(BaseHTTPRequestHandler):
             # The flight dump rides the exception (server-side only —
             # the wire response stays a bare 500, no event leakage).
             flight.attach(e)
+            srv_span.set_attr("error", repr(e))
             metrics.inc("evolu_relay_errors_total")
             log("dev", "relay sync request failed", error=repr(e))
             self.send_error(500, str(e))
             return
         finally:
+            trace.deactivate(_tok)
+            srv_span.end()
             metrics.observe(
-                "evolu_relay_request_ms", (time.perf_counter() - t0) * 1e3
+                "evolu_relay_request_ms", (time.perf_counter() - t0) * 1e3,
+                exemplar=srv_span.trace_id,
             )
         if self.replication is not None and request.messages:
             # Debounced write hint: fresh rows should reach peer relays
-            # at gossip-debounce latency, not interval latency.
-            self.replication.hint()
+            # at gossip-debounce latency, not interval latency. The
+            # hint carries the write's trace context so the gossip
+            # round that ships these rows records into the SAME trace
+            # (the fleet-wide convergence trace, obs/trace.py).
+            self.replication.hint(origin=srv_span.context)
+        # The respond leg gets its own span (explicitly parented — the
+        # server span above already closed so the request_ms exemplar
+        # and the latency split stay consistent): queue-wait
+        # (sched.queue) vs engine (engine.batch, linked) vs respond.
+        rspan = trace.start_span("relay.respond", parent=srv_span.context)
         out = self._negotiate_caps(request, out)
         metrics.observe("evolu_relay_response_bytes", len(out),
                         buckets=metrics.SIZE_BUCKETS)
+        rspan.set_attr("bytes", len(out))
+        # End BEFORE the socket write: the client can race a
+        # GET /trace/<id> the instant it reads the response, and the
+        # span must already be in the ring (the write itself is the
+        # kernel's, not ours to time).
+        rspan.end()
         self._respond(200, out, "application/octet-stream")
 
     def _do_replicate(self) -> None:
@@ -673,19 +769,33 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(413)
             return
         body = self.rfile.read(length)
+        # The gossiping peer's round span context rides the
+        # traceparent header; its trace id is the ORIGIN trace of the
+        # write that armed the round (replicate.hint) — serving spans
+        # here land in the same fleet-wide convergence trace.
+        tctx = trace.parse_traceparent(
+            self.headers.get(trace.TRACEPARENT_HEADER)
+        )
+        sspan = trace.start_span(
+            "repl.serve", parent=tctx,
+            attrs={"leg": self.path.rsplit("/replicate/", 1)[-1]},
+        )
         try:
-            if self.path == "/replicate/summary":
-                out = replicate.serve_summary(self.store, body, self.replication)
-            elif self.path == "/replicate/pull":
-                out = replicate.serve_pull(
-                    self.store, body,
-                    per_owner=self.replication.pull_messages_per_owner,
-                    per_response=self.replication.pull_messages_per_response,
-                )
-            elif self.path == "/replicate/snapshot":
-                out = snapshot.serve_snapshot(self.store, body, self.replication)
-            else:
-                out = snapshot.serve_snapshot_chunk(self.store, body, self.replication)
+            with sspan, trace.use(sspan.context):
+                if self.path == "/replicate/summary":
+                    out = replicate.serve_summary(
+                        self.store, body, self.replication, origin=tctx
+                    )
+                elif self.path == "/replicate/pull":
+                    out = replicate.serve_pull(
+                        self.store, body,
+                        per_owner=self.replication.pull_messages_per_owner,
+                        per_response=self.replication.pull_messages_per_response,
+                    )
+                elif self.path == "/replicate/snapshot":
+                    out = snapshot.serve_snapshot(self.store, body, self.replication)
+                else:
+                    out = snapshot.serve_snapshot_chunk(self.store, body, self.replication)
         except ValueError as e:
             metrics.inc("evolu_relay_errors_total")
             self.send_error(400, str(e))
@@ -718,13 +828,20 @@ class _Handler(BaseHTTPRequestHandler):
             return True
         if action == "redirect":
             metrics.inc("evolu_fleet_redirects_total")
+            # Zero-duration event span: the trace shows WHERE the
+            # client was bounced (its own sync.redirect span shows the
+            # follow; this one shows the relay that answered 307).
+            trace.record_span("fleet.redirect", trace.current(),
+                              time.time(), 0.0, {"target": target})
             self.send_response(307)
             self.send_header("Location", target + "/")
             self.send_header("Content-Length", "0")
             self.end_headers()
             return False
         # forward: wrap the UNTOUCHED client body in the hop-guarded
-        # envelope and relay the peer's raw response back.
+        # envelope and relay the peer's raw response back. The forward
+        # POST carries the ambient trace context (headers only — the
+        # envelope bytes are exactly the client's).
         metrics.inc("evolu_fleet_forwards_total")
         import urllib.error
 
@@ -733,8 +850,17 @@ class _Handler(BaseHTTPRequestHandler):
         env = protocol.encode_fleet_forward(
             protocol.FleetForward(body, self.fleet.self_url, 1)
         )
+        fwd_span = trace.start_span("fleet.forward", parent=trace.current(),
+                                    attrs={"target": target})
         try:
-            out = _http_post(target + "/fleet/forward", env, retries=1)
+            with fwd_span:
+                # The FORWARD span's context rides the header (not the
+                # ambient server span's) so the peer's
+                # fleet.forward.serve span parents under this hop —
+                # same rule as replicate's per-leg spans.
+                out = _http_post(
+                    target + "/fleet/forward", env, retries=1,
+                    headers=trace.inject_headers(ctx=fwd_span.context))
         except urllib.error.HTTPError as e:
             if e.code in (429, 503):
                 # The peer is shedding load: flow control, relayed.
@@ -802,14 +928,31 @@ class _Handler(BaseHTTPRequestHandler):
                 # it lands, even if the rings disagree mid-reload
                 # (scoped gossip drains any stray owner).
                 metrics.inc("evolu_fleet_forwarded_served_total")
-                out = self._serve_request(request)
+                # The forwarder's span context rode the traceparent
+                # header: the serve span here joins the same trace, so
+                # GET /trace/<id> on THIS relay shows the hop the
+                # client never saw (malformed header → None → fresh
+                # trace, never an error).
+                tctx = trace.parse_traceparent(
+                    self.headers.get(trace.TRACEPARENT_HEADER)
+                )
+                fspan = trace.start_span(
+                    "fleet.forward.serve", parent=tctx,
+                    attrs={"owner": request.user_id, "origin": env.origin},
+                )
+                with fspan, trace.use(fspan.context):
+                    out = self._serve_request(request)
                 if out is None:
                     return  # 503 backpressure already answered
                 _count_ingest_mix(request.messages)
                 if self.replication is not None and request.messages:
-                    self.replication.hint()
-                self._respond(200, self._negotiate_caps(request, out),
-                              "application/octet-stream")
+                    self.replication.hint(origin=fspan.context)
+                out = self._negotiate_caps(request, out)
+                # Recorded before the socket write — see do_POST's
+                # respond span.
+                trace.start_span("relay.respond", parent=fspan.context,
+                                 attrs={"bytes": len(out)}).end()
+                self._respond(200, out, "application/octet-stream")
                 return
             # /fleet/reload is a control-plane MUTATION on the
             # client-facing port: with EVOLU_FLEET_RELOAD_TOKEN set,

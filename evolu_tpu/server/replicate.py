@@ -62,10 +62,13 @@ from evolu_tpu.core.merkle import diff_merkle_trees, merkle_tree_from_string
 from evolu_tpu.core.timestamp import (
     SYNC_NODE_ID,
     create_sync_timestamp,
+    iso_to_millis,
     timestamp_to_string,
 )
-from evolu_tpu.obs import metrics
+from evolu_tpu.core.types import TimestampParseError
+from evolu_tpu.obs import metrics, trace
 from evolu_tpu.sync import aead, protocol
+from evolu_tpu.sync.client import _accepts_headers
 from evolu_tpu.utils.log import log
 
 # One pull POST covers at most this many owners — bounds request bodies
@@ -97,13 +100,18 @@ def owner_tree_map(store) -> List[Tuple[str, str]]:
     return [(u, store.get_merkle_tree_string(u)) for u in store.user_ids()]
 
 
-def serve_summary(store, body: bytes, manager: Optional["ReplicationManager"]) -> bytes:
+def serve_summary(store, body: bytes, manager: Optional["ReplicationManager"],
+                  origin=None) -> bytes:
     """Handler body for `POST /replicate/summary`: decode the caller's
     summary, arm the local manager's debounced hint if the caller
     advertises anything we diverge from (heal flows both ways), and
     answer with OUR summary. ONE store scan serves both the divergence
-    check and the response. Raises ValueError only on malformed input
-    (the wire-decoder contract — the handler maps it to 400)."""
+    check and the response. `origin` is the caller's trace context
+    (obs/trace.py — the relay handler parses it off the traceparent
+    header): a divergence-armed hint carries it forward so OUR next
+    round records into the same fleet-wide convergence trace. Raises
+    ValueError only on malformed input (the wire-decoder contract —
+    the handler maps it to 400)."""
     incoming = protocol.decode_replica_summary(body)
     mine = owner_tree_map(store)
     if manager is not None:
@@ -111,7 +119,7 @@ def serve_summary(store, body: bytes, manager: Optional["ReplicationManager"]) -
         # "{}" is what get_merkle_tree_string answers for an unseen
         # owner — an owner we lack entirely is divergence too.
         if any(by_owner.get(uid, "{}") != tree for uid, tree in incoming.trees):
-            manager.hint()
+            manager.hint(origin=origin)
     fleet = getattr(manager, "fleet", None) if manager is not None else None
     if fleet is not None and incoming.peer_url:
         # Placement-scoped answer (server/fleet.py): the caller told
@@ -265,6 +273,11 @@ class ReplicationManager:
         self._snapshot_cache_lock = threading.Lock()
         self._post = http_post or functools.partial(_http_post, retries=0)
         self._rng = rng or random.random
+        # Trace contexts of recent write hints (origin traces for the
+        # fleet-wide convergence trace): drained by the next round,
+        # bounded — a write burst keeps the newest few origins, which
+        # is exactly what a debounced hint coalesces anyway.
+        self._hint_origins: List = []
         # Owner-sharded fleet membership (server/fleet.py), attached by
         # RelayServer.enable_fleet: scopes summaries/pulls to placement
         # (O(R) gossip) and hands the snapshot path to the fleet's
@@ -338,15 +351,24 @@ class ReplicationManager:
             )
             self._cv.notify()
 
-    def hint(self) -> None:
+    def hint(self, origin=None) -> None:
         """Debounced write hint: a burst of local writes (or a peer's
         summary showing divergence) coalesces into ONE early gossip
         sweep `debounce_s` after the first hint. Peers in failure
         backoff are NOT pulled forward — hints must not defeat the
-        bounded backoff."""
+        bounded backoff. `origin` (the hinting write's trace context,
+        obs/trace.py) is remembered — bounded, deduped — so the round
+        this hint arms records its spans into the SAME trace the
+        client's mutation started: that is the fleet-wide convergence
+        trace."""
         with self._cv:
             if self._stopping:
                 return
+            if origin is not None and origin.sampled:
+                if not any(o.trace_id == origin.trace_id
+                           for o in self._hint_origins):
+                    self._hint_origins.append(origin)
+                    del self._hint_origins[:-8]  # keep the newest 8
             if self._hint_at is None:
                 self._hint_at = time.monotonic() + self.debounce_s
                 metrics.inc("evolu_repl_hints_total", replica=self.replica_id)
@@ -423,7 +445,20 @@ class ReplicationManager:
         metrics.inc(
             "evolu_repl_round_trips_total", replica=self.replica_id, leg=leg
         )
-        return self._post(url, body)
+        # Each HTTP leg is a child span of the ambient round span and
+        # carries its context as the traceparent header (headers only;
+        # the peer wire bytes are untouched) — the serving peer's
+        # repl.serve span joins the same convergence trace.
+        lspan = trace.start_span(f"repl.{leg}", parent=trace.current())
+        with lspan:
+            hdrs = trace.inject_headers(ctx=lspan.context)
+            # Header support is probed at CALL time (memoized per
+            # callable): `_post` is swappable after construction
+            # (fault injectors wrap it), and a 2-arg transport must
+            # be served without the header rather than broken.
+            if hdrs and _accepts_headers(self._post):
+                return self._post(url, body, headers=hdrs)
+            return self._post(url, body)
 
     def _finish_pending_swap_once(self) -> None:
         """A crash between shard swaps leaves a verified install half
@@ -464,12 +499,31 @@ class ReplicationManager:
     def _round(self, peer: _Peer) -> None:
         self._finish_pending_swap_once()
         labels = {"replica": self.replica_id, "peer": peer.url}
+        # Drain the write-hint origins: the round span joins the FIRST
+        # origin's trace (the convergence trace the client's mutation
+        # started) and LINKS the rest — a span has one trace, extra
+        # concurrent writes ride as fan-in links, exactly like the
+        # scheduler's batch span. Origins are restored on failure so a
+        # retried round still lands in the right trace.
+        with self._cv:
+            origins, self._hint_origins = self._hint_origins, []
+        rspan = trace.start_span(
+            "repl.round", parent=origins[0] if origins else None,
+            links=origins[1:], attrs={"peer": peer.url},
+        )
         try:
-            converged, pulled = self._gossip(peer)
+            with rspan, trace.use(rspan.context):
+                converged, pulled = self._gossip(peer)
         except _ManagerStopping:
+            with self._cv:
+                self._hint_origins = origins + self._hint_origins
+                del self._hint_origins[:-8]
             return  # tearing down — not a peer failure
         except Exception as e:  # noqa: BLE001 - a peer failure must
             # never kill the loop: count, mark unhealthy, back off.
+            with self._cv:
+                self._hint_origins = origins + self._hint_origins
+                del self._hint_origins[:-8]
             peer.failures += 1
             metrics.inc("evolu_repl_peer_failures_total", **labels)
             metrics.inc("evolu_repl_rounds_total", result="error", **labels)
@@ -491,6 +545,7 @@ class ReplicationManager:
             metrics.observe(
                 "evolu_repl_convergence_lag_ms",
                 (time.monotonic() - peer.diverged_since) * 1e3,
+                exemplar=rspan.trace_id,
                 **labels,
             )
             peer.diverged_since = None
@@ -500,8 +555,9 @@ class ReplicationManager:
             # topologies — A↔B↔C with no A↔C edge): arm the debounced
             # hint so the next hop leaves at debounce latency, not
             # interval latency. A converged mesh pulls nothing, so the
-            # hint chain terminates.
-            self.hint()
+            # hint chain terminates. The hint carries this round's
+            # context so the next hop stays in the convergence trace.
+            self.hint(origin=rspan.context)
 
     # -- one gossip round --
 
@@ -570,6 +626,7 @@ class ReplicationManager:
 
         peer_tree_at_pull = {}
         requests: List[protocol.SyncRequest] = []
+        freshness: dict = {}  # owner -> newest pulled HLC millis
         pulled = 0
         for i in range(0, len(diverged), self.pull_chunk):
             chunk = diverged[i : i + self.pull_chunk]
@@ -590,8 +647,47 @@ class ReplicationManager:
                             om.messages, om.user_id, SYNC_NODE_ID, om.merkle_tree
                         )
                     )
+                    try:
+                        # Messages arrive timestamp-ordered; the last
+                        # one's HLC millis is the owner's watermark.
+                        # Rows already carry the clock — no new clocks,
+                        # no wire change. Non-canonical timestamps just
+                        # skip the gauge (they still ingest through
+                        # the host-oracle route like always) —
+                        # iso_to_millis raises TimestampParseError on
+                        # them, which must never abort the round.
+                        freshness[om.user_id] = max(
+                            freshness.get(om.user_id, 0),
+                            iso_to_millis(om.messages[-1].timestamp[:24]),
+                        )
+                    except (ValueError, TimestampParseError):
+                        pass
         metrics.inc("evolu_repl_messages_pulled_total", pulled, **labels)
-        self._ingest(requests)
+        ispan = trace.start_span(
+            "repl.ingest", parent=trace.current(),
+            attrs={"peer": peer.url, "owners": len(requests),
+                   "messages": pulled},
+        )
+        with ispan:
+            self._ingest(requests)
+        # The convergence plane (ISSUE 10): per-(owner, peer)
+        # freshness watermarks — the newest HLC millis this replica
+        # has SEEN from that peer per owner — and the end-to-end
+        # write→visible-at-this-replica lag, measured from the HLC
+        # millis the rows already carry against this host's wall
+        # clock. Gauges are data-labeled, so the registry's
+        # label-cardinality bound is what keeps them finite.
+        now_ms = time.time() * 1e3
+        for uid, newest in freshness.items():
+            metrics.set_gauge(
+                "evolu_conv_owner_freshness_millis", newest,
+                replica=self.replica_id, peer=peer.url, owner=uid,
+            )
+            metrics.observe(
+                "evolu_conv_write_visible_ms", max(0.0, now_ms - newest),
+                exemplar=ispan.trace_id,
+                replica=self.replica_id, peer=peer.url,
+            )
         converged = all(
             self.store.get_merkle_tree_string(uid)
             == peer_tree_at_pull.get(uid, object())

@@ -60,7 +60,7 @@ import threading
 import time
 from typing import List, Optional
 
-from evolu_tpu.obs import metrics
+from evolu_tpu.obs import metrics, trace
 from evolu_tpu.sync import aead, protocol
 from evolu_tpu.utils.log import log
 
@@ -83,12 +83,18 @@ class _Pending:
     open; a handler-thread write acked mid-batch would be rolled back
     with a poisoned batch)."""
 
-    __slots__ = ("request", "single", "t_enqueue", "done", "response", "error")
+    __slots__ = ("request", "single", "t_enqueue", "t_wall", "ctx",
+                 "done", "response", "error")
 
     def __init__(self, request: protocol.SyncRequest, single: bool = False):
         self.request = request
         self.single = single
         self.t_enqueue = time.monotonic()
+        self.t_wall = time.time()
+        # The submitting handler thread's ambient trace context — the
+        # dispatcher records this request's queue-wait span under it
+        # and links it from the batch span (fan-in, obs/trace.py).
+        self.ctx = trace.current()
         self.done = threading.Event()
         self.response: Optional[bytes] = None
         self.error: Optional[BaseException] = None
@@ -258,14 +264,32 @@ class SyncScheduler:
         self._queue = keep
         return batch
 
+    def _record_queue_waits(self, batch: List[_Pending]) -> float:
+        """Per-request queue-wait spans (enqueue → batch close), under
+        each request's own trace — one leg of the queue-wait /
+        engine-time / respond split the trace surfaces. Returns the
+        dispatch instant (monotonic) the waits were measured against."""
+        t_dispatch = time.monotonic()
+        for p in batch:
+            if p.ctx is not None:
+                trace.record_span(
+                    "sched.queue", p.ctx, p.t_wall,
+                    (t_dispatch - p.t_enqueue) * 1e3,
+                )
+        return t_dispatch
+
     def _run_batch(self, batch: List[_Pending]) -> None:
         if not batch:
             return
         if batch[0].single:
             p = batch[0]
             metrics.inc("evolu_sched_fallback_total", reason="non_canonical")
+            self._record_queue_waits(batch)
+            sspan = trace.start_span("sched.single", parent=p.ctx,
+                                     attrs={"owner": p.request.user_id})
             try:
-                p.resolve(self._serve_single(p.request))
+                with sspan, trace.use(sspan.context):
+                    p.resolve(self._serve_single(p.request))
             except Exception as e:  # noqa: BLE001 - per-request error
                 p.fail(e)
             return
@@ -274,9 +298,29 @@ class SyncScheduler:
         metrics.observe(
             "evolu_sched_batch_requests", len(batch), buckets=metrics.COUNT_BUCKETS
         )
+        self._record_queue_waits(batch)
+        # The fan-in span: ONE engine pass serves N requests from N
+        # different traces, so the batch span LINKS the request spans
+        # (it cannot parent them — a span has one trace). It roots its
+        # own trace, is force-sampled whenever any linked request is
+        # sampled, and GET /trace/<request-id> surfaces it through the
+        # link index. Kernel spans opened inside the engine pass
+        # (utils/log.py span()) nest under it via the ambient context.
+        # (start_span already records whenever any sampled link is
+        # present — no force_sample needed here.)
+        links = [p.ctx for p in batch if p.ctx is not None]
+        bspan = trace.start_span(
+            "engine.batch", links=links,
+            attrs={
+                "requests": len(batch),
+                "owners": len({p.request.user_id for p in batch}),
+            },
+        )
         try:
             engine = self._ensure_engine()
-            outs = engine.run_batch_wire([p.request for p in batch])
+            with trace.use(bspan.context):
+                outs = engine.run_batch_wire([p.request for p in batch])
+            bspan.end()
         except Exception as e:  # noqa: BLE001 - poison isolation
             # (BaseException — KeyboardInterrupt/SystemExit — is NOT
             # poison: it propagates, and the loop's finally fails any
@@ -284,6 +328,9 @@ class SyncScheduler:
             # back (engine contract): nothing committed, so the
             # singleton retry is exact — and it isolates the poison to
             # the one request that carries it; batchmates succeed.
+            bspan.set_attr("poisoned", True)
+            bspan.set_attr("error", repr(e))
+            bspan.end()
             metrics.inc("evolu_sched_poisoned_batches_total")
             log("server", "scheduler batch poisoned; retrying as singletons",
                 error=repr(e), requests=len(batch))
@@ -295,7 +342,8 @@ class SyncScheduler:
                 else:
                     metrics.inc("evolu_sched_fallback_total", reason="poison_retry")
                     p.resolve(response)
-            metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3)
+            metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3,
+                            exemplar=bspan.trace_id)
             return
         metrics.inc("evolu_sched_coalesced_requests_total", len(batch))
         n_v2 = sum(aead.count_v2(p.request.messages) for p in batch)
@@ -308,7 +356,8 @@ class SyncScheduler:
             metrics.inc("evolu_crypto_v2_batched_messages_total", n_v2)
         for p, out in zip(batch, outs):
             p.resolve(out)
-        metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3)
+        metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3,
+                        exemplar=bspan.trace_id)
 
     def _ensure_engine(self):
         """The BatchReconciler, created lazily on the dispatcher thread

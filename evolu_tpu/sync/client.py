@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -23,7 +24,7 @@ from typing import Callable, Optional
 
 from evolu_tpu.core.timestamp import timestamp_from_string
 from evolu_tpu.core.types import CrdtMessage, UnknownError
-from evolu_tpu.obs import metrics
+from evolu_tpu.obs import metrics, trace
 from evolu_tpu.runtime.messages import OnError, SyncRequestInput
 from evolu_tpu.runtime.synclock import SyncLock
 from evolu_tpu.sync import protocol
@@ -89,6 +90,45 @@ def decrypt_messages(messages, mnemonic: str):
     from evolu_tpu.sync import native_crypto
 
     return native_crypto.decrypt_batch(messages, mnemonic)
+
+
+def _accepts_headers(fn) -> bool:
+    """Whether an http_post callable takes a `headers` kwarg — the
+    trace-context hop is optional so injected 2-arg transports (tests,
+    embedders, fault injectors) keep working unchanged. Probed at
+    call time (the transport is swappable after construction) but
+    memoized per callable: inspect.signature builds a full Signature
+    object, far too heavy to re-run on every POST/gossip leg."""
+    try:
+        return _ACCEPTS_HEADERS_MEMO[fn]
+    except TypeError:
+        return _accepts_headers_probe(fn)  # unhashable callable
+    except KeyError:
+        pass
+    ok = _accepts_headers_probe(fn)
+    try:
+        if len(_ACCEPTS_HEADERS_MEMO) > 256:  # unbounded-growth guard
+            _ACCEPTS_HEADERS_MEMO.clear()
+        _ACCEPTS_HEADERS_MEMO[fn] = ok
+    except TypeError:
+        pass
+    return ok
+
+
+_ACCEPTS_HEADERS_MEMO: dict = {}
+
+
+def _accepts_headers_probe(fn) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    params = sig.parameters
+    return "headers" in params or any(
+        p.kind == p.VAR_KEYWORD for p in params.values()
+    )
 
 
 class SyncTransport:
@@ -343,11 +383,36 @@ class SyncTransport:
             body = body + protocol.encode_request_capabilities(caps)
         return body
 
+    def _post_traced(self, url: str, body: bytes) -> bytes:
+        """The sync POST with the ambient trace context as a
+        traceparent header (headers only — the body bytes are
+        untouched). An injected 2-arg http_post (tests, embedders —
+        probed at call time, the transport is swappable) is served
+        without the header rather than broken."""
+        hdrs = trace.inject_headers()
+        if hdrs and _accepts_headers(self._http_post):
+            return self._http_post(url, body, headers=hdrs)
+        return self._http_post(url, body)
+
     def _sync_round(self, request: SyncRequestInput):
-        """One encrypt→POST→decrypt round under the sync lock. Returns
-        the decoded (messages, merkle_tree, previous_diff) for the
-        caller to hand to on_receive AFTER releasing the lock, or None
-        when there is nothing to receive."""
+        """One encrypt→POST→decrypt round under the sync lock, traced
+        end to end (obs/trace.py): the round span joins the mutation's
+        trace when the request carries one (runtime/worker.py mints it
+        at Send) and roots a fresh trace for pull-only rounds; the
+        POST carries this span's context as its traceparent header.
+        Returns what `_sync_round_body` returns."""
+        rspan = trace.start_span(
+            "sync.round", parent=getattr(request, "trace", None),
+            attrs={"messages": len(request.messages)},
+        )
+        with rspan, trace.use(rspan.context):
+            return self._sync_round_body(request)
+
+    def _sync_round_body(self, request: SyncRequestInput):
+        """The round itself. Returns the decoded (messages,
+        merkle_tree, previous_diff) for the caller to hand to
+        on_receive AFTER releasing the lock, or None when there is
+        nothing to receive."""
         caps = tuple(self.config.sync_capabilities or ())
         owner_id = request.owner.id
         base = self.config.sync_url
@@ -396,7 +461,7 @@ class SyncTransport:
         try:
             while True:
                 try:
-                    response_bytes = self._http_post(url, body)
+                    response_bytes = self._post_traced(url, body)
                     break
                 except urllib.error.HTTPError as e:
                     # A fleet relay answers a non-placed sync POST with
@@ -412,6 +477,14 @@ class SyncTransport:
                         target = urllib.parse.urljoin(url, location)
                         self._routes[owner_id] = target
                         metrics.inc("evolu_sync_redirects_total")
+                        # The redirect hop is a leg of the mutation's
+                        # journey: record it into the round's trace so
+                        # GET /trace/<id> shows WHERE the client was
+                        # bounced (zero-duration event span).
+                        trace.record_span(
+                            "sync.redirect", trace.current(), time.time(),
+                            0.0, {"target": target},
+                        )
                         log("sync:request", "fleet redirect", url=target)
                         retarget(target)
                         continue
@@ -544,19 +617,25 @@ def _retry_after_seconds(error: urllib.error.HTTPError) -> Optional[float]:
 
 def _http_post(url: str, body: bytes, *, retries: int = BACKOFF_RETRIES,
                base_delay: float = BACKOFF_BASE_S, max_delay: float = BACKOFF_MAX_S,
-               sleep=None, rng=None) -> bytes:
+               sleep=None, rng=None, headers: Optional[dict] = None) -> bytes:
     """POST with bounded exponential backoff + full jitter on 429/503
     (honoring Retry-After — the relay's backpressure contract) and on
-    connection errors. `sleep`/`rng` are injectable for tests."""
+    connection errors. `sleep`/`rng` are injectable for tests.
+    `headers` (e.g. the traceparent trace-context hop, obs/trace.py)
+    merge over the defaults — context rides HTTP headers only, the
+    body bytes are never touched."""
     import random
     import time
 
     sleep = sleep or time.sleep
     rng = rng or random.random
     attempt = 0
+    base_headers = {"Content-Type": "application/octet-stream"}
+    if headers:
+        base_headers.update(headers)
     while True:
         req = urllib.request.Request(
-            url, data=body, headers={"Content-Type": "application/octet-stream"}, method="POST"
+            url, data=body, headers=base_headers, method="POST"
         )
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:

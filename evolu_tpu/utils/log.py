@@ -73,14 +73,15 @@ class LogEvent:
 
 
 def _obs():
-    """Lazy (obs.flight, obs.metrics) pair — obs imports LogEvent from
-    this module, so the reverse edge must resolve at call time. Cached
-    after the first call; one tuple check per event afterwards."""
+    """Lazy (obs.flight, obs.metrics, obs.trace) triple — obs imports
+    LogEvent from this module, so the reverse edge must resolve at
+    call time. Cached after the first call; one tuple check per event
+    afterwards."""
     global _obs_pair
     if _obs_pair is None:
-        from evolu_tpu.obs import flight, metrics
+        from evolu_tpu.obs import flight, metrics, trace
 
-        _obs_pair = (flight, metrics)
+        _obs_pair = (flight, metrics, trace)
     return _obs_pair
 
 
@@ -173,10 +174,21 @@ class Logger:
             # the per-target latency histogram (percentiles via
             # `duration_summary` / the relay's /metrics) and the event
             # in the flight ring. Host-side values only — the span
-            # wraps dispatch+pull, it never adds one.
-            flight, metrics = _obs()
+            # wraps dispatch+pull, it never adds one. With an ambient
+            # trace context (obs.trace — e.g. the scheduler's batch
+            # span active around the engine pass), the same interval
+            # also lands in the distributed trace under its kernel:*
+            # name, so the chrome export interleaves host and kernel
+            # spans on one timebase.
+            flight, metrics, trace = _obs()
             metrics.observe("evolu_kernel_span_ms", ms, target=target)
             flight.recorder.record_event(ev)
+            tctx = trace.current()
+            if tctx is not None:
+                trace.record_span(
+                    target if not message else f"{target}|{message}",
+                    tctx, ev.t - ms / 1e3, ms, fields or None,
+                )
             if self.is_enabled(target):
                 extra = (" " + " ".join(f"{k}={v}" for k, v in fields.items())) if fields else ""
                 print(f"[{target}] {message} {ms:.3f}ms{extra}")
@@ -222,9 +234,10 @@ class Logger:
 
     def clear(self) -> None:
         """Reset the ring + duration aggregates. On the MODULE SINGLETON
-        (`logger`) this also resets the process metrics registry and
-        flight recorder — one call returns the whole observability
-        surface to a clean slate (test isolation). Scoped Logger
+        (`logger`) this also resets the process metrics registry,
+        flight recorder, and trace span ring — one call returns the
+        whole observability surface to a clean slate (test isolation).
+        Scoped Logger
         instances clear only their own state: an embedder emptying a
         private ring must not zero the counters the relay is serving
         at GET /metrics (Prometheus counters are monotonic)."""
@@ -232,9 +245,10 @@ class Logger:
             self._ring.clear()
             self._durations.clear()
         if globals().get("logger") is self:
-            flight, metrics = _obs()
+            flight, metrics, trace = _obs()
             metrics.reset()
             flight.recorder.clear()
+            trace.recorder.clear()
 
 
 # Module-level default, mirroring the reference's module singleton. The

@@ -116,6 +116,37 @@ def test_metrics_do_not_touch_the_bench_graph(tiny_setup):
     )
 
 
+def test_tracing_does_not_touch_the_bench_graph(tiny_setup):
+    """ISSUE 10's twin of the metrics fence: with tracing enabled at
+    100% sampling AND an active ambient span around the timed loop
+    (the worst case — every log-span mirror fires), the bench checksum
+    must stay bit-identical and the jit cache-miss count flat. Spans
+    are host-side bookkeeping by contract; a recompile here would mean
+    a trace value leaked into a traced graph."""
+    from evolu_tpu.obs import trace
+
+    mesh, args = tiny_setup
+    loop = bench.make_loop(mesh, 1)
+    with jax.enable_x64(True):
+        trace.set_enabled(False)
+        try:
+            base = int(loop(*args))
+            cache_size = loop._cache_size()
+            trace.set_enabled(True)
+            trace.set_sample_rate(1.0)
+            root = trace.start_span("bench.guard")
+            with root, trace.use(root.context):
+                with_tracing = int(loop(*args))
+            cache_size_after = loop._cache_size()
+        finally:
+            trace.set_enabled(True)
+    assert with_tracing == base, "tracing changed the bench checksum"
+    assert cache_size_after == cache_size, (
+        "enabling tracing added jit cache misses (recompiles) to the "
+        "timed pipeline"
+    )
+
+
 def test_checksum_depends_on_the_data():
     """Same loop, different input data → different checksum (guards a
     degenerate fold that collapses to a constant)."""

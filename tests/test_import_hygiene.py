@@ -63,7 +63,8 @@ def test_no_module_initializes_the_xla_backend_at_import():
 def test_obs_package_never_imports_jax():
     """The observability package records host-side Python values only;
     the cheap mechanical proxy is that importing it (alone) must not
-    pull jax into the process at all."""
+    pull jax into the process at all. (The package import covers
+    obs.trace too — it is re-exported from obs/__init__.py.)"""
     proc = subprocess.run(
         [sys.executable, "-c",
          "import sys; import evolu_tpu.obs; "
@@ -73,3 +74,26 @@ def test_obs_package_never_imports_jax():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert "CLEAN" in proc.stdout, "evolu_tpu.obs transitively imported jax"
+
+
+def test_trace_module_never_imports_jax_and_never_touches_a_backend():
+    """ISSUE 10's explicit pin for the tracing module ALONE (not just
+    via the package import): importing, minting spans, parsing and
+    formatting headers, and exporting must neither pull jax into the
+    process nor touch any backend — tracing runs on relays that never
+    load jax at all."""
+    script = (
+        "import sys; from evolu_tpu.obs import trace; "
+        "s = trace.start_span('t', attrs={'k': 1}); "
+        "ctx = s.context; s.end(); "
+        "assert trace.parse_traceparent(trace.format_traceparent(ctx)); "
+        "trace.serve_trace(ctx.trace_id); trace.export_chrome(); "
+        "print('JAX_LOADED' if 'jax' in sys.modules else 'CLEAN')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env={**os.environ, "PYTHONPATH": _REPO},
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "CLEAN" in proc.stdout, "evolu_tpu.obs.trace transitively imported jax"

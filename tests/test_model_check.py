@@ -8,6 +8,7 @@ property: total byte-level convergence from arbitrary schedules.
 
 import random
 import time
+from contextlib import contextmanager
 
 import pytest
 
@@ -19,6 +20,23 @@ from evolu_tpu.sync.client import connect
 from evolu_tpu.utils.config import Config
 
 SCHEMA = {"todo": ("title", "isCompleted", "categoryId"), "todoCategory": ("name",)}
+
+
+@contextmanager
+def _evidence(label, seed):
+    """Seed-replay evidence (ROADMAP #5): on assertion failure the
+    episode dumps seed + flight-recorder ring + span export + metrics
+    snapshot to a tmp artifact whose path rides the failure message —
+    a failed seed arrives with its causal history, not just a stack."""
+    try:
+        yield
+    except AssertionError as e:
+        from evolu_tpu.obs import trace
+
+        path = trace.write_evidence(label, seed=seed)
+        raise AssertionError(
+            f"{e}\nseed={seed}; replay evidence artifact: {path}"
+        ) from e
 
 
 def _dump(evolu):
@@ -46,6 +64,11 @@ def _converge(replicas, deadline_s=40.0):
 
 @pytest.mark.parametrize("seed", [1234, 99, 7, 4242, 31337])
 def test_randomized_mixed_backend_schedules_converge(seed):
+    with _evidence("model-check", seed):
+        _run_randomized_episode(seed)
+
+
+def _run_randomized_episode(seed):
     rng = random.Random(seed)
     server = RelayServer(ShardedRelayStore(shards=4)).start()
     cfg = lambda **kw: Config(sync_url=server.url, **kw)  # noqa: E731
@@ -164,6 +187,11 @@ def test_adversarial_clocks_through_two_relay_fleet_converge():
     client routes — server/fleet.py), asserting byte-identical
     convergence AND the winner-cache == MAX(timestamp) invariant on
     the device-backend replica."""
+    with _evidence("model-check-adversarial-clocks", 20240731):
+        _run_adversarial_clock_episode()
+
+
+def _run_adversarial_clock_episode():
     import numpy as np
 
     from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
@@ -293,6 +321,11 @@ def test_crash_mid_chunked_receive_restart_converges(tmp_path, seed, crash_at):
     committed (rows + clock atomic per chunk). A RESTARTED process
     over the same database file must resume from the persisted clock
     and converge to byte-identical state."""
+    with _evidence("model-check-crash-restart", seed):
+        _run_crash_restart_episode(tmp_path, seed, crash_at)
+
+
+def _run_crash_restart_episode(tmp_path, seed, crash_at):
     from evolu_tpu.runtime.client import Evolu
     import evolu_tpu.runtime.worker as worker_mod
 
@@ -390,6 +423,11 @@ def test_mixed_crdt_workload_adversarial_clocks_two_relay_fleet():
     value equals the sum of every acked increment), the AW-set add-wins
     outcome for a concurrent add/remove pair, and the per-type
     winner-cache contract on the device-backend replica."""
+    with _evidence("model-check-mixed-crdt", 20250804):
+        _run_mixed_crdt_episode()
+
+
+def _run_mixed_crdt_episode():
     import numpy as np
 
     from evolu_tpu.core import crdt_types as ct
@@ -568,6 +606,11 @@ def test_no_stale_query_results_adversarial_clocks_host_bounce():
     delivered: the gated worker's output stream must be byte-identical
     to the oracle's at every step, and at the end every cached
     subscription must equal a fresh SQL read of the live database."""
+    with _evidence("model-check-stale-query", 20260804):
+        _run_stale_query_episode()
+
+
+def _run_stale_query_episode():
     from dataclasses import replace as dc_replace
 
     from evolu_tpu.core.merkle import create_initial_merkle_tree, merkle_tree_to_string

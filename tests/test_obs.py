@@ -92,6 +92,56 @@ def test_prometheus_exposition_is_valid_and_escaped():
     assert snap["counters"]["e_total"][0]["value"] == 2
 
 
+def test_label_cardinality_bound_folds_into_overflow():
+    """ISSUE 10: data-driven label values (per-owner freshness gauges)
+    must never grow the registry unboundedly — past the per-family
+    cap, NEW label sets fold into "__overflow__" and are counted."""
+    reg = metrics.MetricsRegistry()
+    reg.label_cardinality_cap = 4
+    for i in range(10):
+        reg.set_gauge("t_fresh", i, owner=f"o{i}", peer="p")
+    # 4 admitted + the one folded overflow series.
+    assert len(reg._gauges["t_fresh"]) == 5
+    assert reg.get_gauge("t_fresh", owner="o3", peer="p") == 3
+    assert reg.get_gauge(
+        "t_fresh", owner="__overflow__", peer="__overflow__") == 9  # last write
+    assert reg.get_counter("evolu_obs_label_overflow_total",
+                           family="t_fresh") == 6
+    # Existing series keep updating in place — no new fold.
+    reg.set_gauge("t_fresh", 33, owner="o3", peer="p")
+    assert reg.get_gauge("t_fresh", owner="o3", peer="p") == 33
+    assert reg.get_counter("evolu_obs_label_overflow_total",
+                           family="t_fresh") == 6
+    # Counters and histograms share the bound.
+    for i in range(10):
+        reg.inc("t_total", owner=f"o{i}")
+        reg.observe("t_ms", 1.0, owner=f"o{i}")
+    assert len(reg._counters["t_total"]) == 5
+    assert len(reg._hists["t_ms"]) == 5
+    assert reg.get_counter("t_total", owner="__overflow__") == 6
+    # Unlabeled series never fold (one series can't explode).
+    for _ in range(10):
+        reg.inc("t_plain_total")
+    assert reg.get_counter("t_plain_total") == 10
+    # Exposition stays valid with the folded series present.
+    assert 'owner="__overflow__"' in reg.render_prometheus()
+
+
+def test_histogram_exemplars_latest_wins_and_render_opt_in():
+    metrics.observe("ex_ms", 5.0, exemplar="a" * 32)
+    metrics.observe("ex_ms", 7.0, exemplar="b" * 32)
+    metrics.observe("ex_ms", 9.0)  # exemplar-less observe keeps the last
+    tid, value, ts = metrics.registry.get_exemplar("ex_ms")
+    assert tid == "b" * 32 and value == 7.0 and ts > 0
+    snap = metrics.snapshot()
+    (series,) = snap["histograms"]["ex_ms"]
+    assert series["exemplar"][0] == "b" * 32
+    # Default text exposition is plain 0.0.4; exemplars are opt-in.
+    assert "trace_id" not in metrics.render_prometheus()
+    assert '# {trace_id="' + "b" * 32 + '"}' in \
+        metrics.registry.render_prometheus(exemplars=True)
+
+
 def test_disabled_registry_records_nothing():
     metrics.set_enabled(False)
     try:

@@ -301,6 +301,33 @@ def test_two_relay_pull_convergence_and_observability_surface():
         assert peer["healthy"] is True
         assert peer["messages_pulled"] >= 70
         assert "evolu_repl_rounds_total" in _get(b.url + "/metrics").decode()
+        # The convergence plane (ISSUE 10): per-(owner, peer) freshness
+        # watermarks on the PULLING replica equal the newest HLC millis
+        # ingested per owner (rows carry the clock — no new clocks),
+        # and the write→visible lag histogram observed once per owner
+        # with the ingest trace as its exemplar.
+        rid = b.replication.replica_id
+        assert metrics.registry.get_gauge(
+            "evolu_conv_owner_freshness_millis",
+            replica=rid, peer=a.url, owner="alice",
+        ) == BASE + 39 * 500
+        assert metrics.registry.get_gauge(
+            "evolu_conv_owner_freshness_millis",
+            replica=rid, peer=a.url, owner="bob",
+        ) == BASE + 29 * 500
+        hist = metrics.registry.get_histogram(
+            "evolu_conv_write_visible_ms", replica=rid, peer=a.url
+        )
+        assert hist is not None and hist[3] >= 2  # one observe per owner
+        assert metrics.registry.get_exemplar(
+            "evolu_conv_write_visible_ms", replica=rid, peer=a.url
+        ) is not None
+        # Convergence-lag: the peer was diverged and this round healed
+        # it — the (replica, peer) lag histogram must have fired.
+        lag = metrics.registry.get_histogram(
+            "evolu_repl_convergence_lag_ms", replica=rid, peer=a.url
+        )
+        assert lag is not None and lag[3] >= 1
     finally:
         if b is not None:
             b.stop()

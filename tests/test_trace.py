@@ -1,0 +1,584 @@
+"""Distributed tracing (evolu_tpu/obs/trace.py, ISSUE 10): context
+codec + deterministic sampling, the bounded span ring and fan-in link
+retrieval, chrome export shape, the relay's GET /trace surface and its
+optional token gate, traceparent header fuzz (malformed headers are
+ignored, never a 4xx/5xx), the client transport's header hop, and the
+acceptance scenario — a 2-relay fleet driving one client mutation
+through routing → forward → scheduler-coalesce → engine → gossip with
+a SINGLE trace id yielding a span tree covering every hop on both
+relays, while wire bytes (v1 and v2 records alike) and SQLite end
+state stay byte-identical with tracing on."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.obs import metrics, trace
+from evolu_tpu.server.relay import RelayServer, RelayStore, serve_single_request
+from evolu_tpu.server.scheduler import SyncScheduler
+from evolu_tpu.sync import aead, protocol
+from evolu_tpu.sync.client import _http_post
+from evolu_tpu.utils.config import FleetConfig
+from evolu_tpu.utils.log import logger
+
+BASE = 1_700_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    logger.clear()  # resets metrics + flight + trace ring
+    trace.set_enabled(True)
+    trace.set_sample_rate(1.0)
+    yield
+    trace.set_enabled(True)
+    trace.set_sample_rate(1.0)
+    logger.clear()
+
+
+def _msgs(k, n, t0=0, content=b"ct-%d"):
+    node = f"{k + 1:016x}"
+    return tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(Timestamp(BASE + (t0 + j) * 1000, 0, node)),
+            content % (t0 + j) if b"%d" in content else content,
+        )
+        for j in range(n)
+    )
+
+
+def _sync_request(owner, messages=(), tree="{}"):
+    return protocol.SyncRequest(messages, owner, "00000000000000bb", tree)
+
+
+def _owner_for(ring, url, prefix="o"):
+    i = 0
+    while True:
+        uid = f"{prefix}{i:04d}"
+        if ring.primary(uid) == url.rstrip("/"):
+            return uid
+        i += 1
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return r.read()
+
+
+# --- context codec + sampling ---
+
+
+def test_traceparent_roundtrip():
+    ctx = trace.SpanContext("ab" * 16, "cd" * 8, True)
+    assert trace.format_traceparent(ctx) == f"00-{'ab' * 16}-{'cd' * 8}-01"
+    back = trace.parse_traceparent(trace.format_traceparent(ctx))
+    assert back.trace_id == ctx.trace_id and back.span_id == ctx.span_id
+
+
+@pytest.mark.parametrize("value", [
+    None, "", "garbage", "00", "00-xyz", "00-" + "g" * 32 + "-" + "cd" * 8 + "-01",
+    "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",      # all-zero trace id
+    "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",     # all-zero span id
+    "00-" + "ab" * 16 + "-" + "cd" * 8,             # missing flags
+    "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01-xx",  # v00 with extra member
+    "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",     # forbidden version
+    "00-" + "AB" * 16 + "-" + "cd" * 8 + "-01",     # uppercase hex
+    "x" * 10_000,                                   # oversized
+    "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01" + "-m" * 500,
+])
+def test_parse_traceparent_never_raises_and_rejects(value):
+    assert trace.parse_traceparent(value) is None
+
+
+def test_parse_accepts_future_version_with_extra_members():
+    ctx = trace.parse_traceparent(
+        "01-" + "ab" * 16 + "-" + "cd" * 8 + "-01-extra-members"
+    )
+    assert ctx is not None and ctx.trace_id == "ab" * 16
+
+
+def test_sampling_is_deterministic_and_proportional():
+    rec = trace.TraceRecorder()
+    rec.sample_rate = 0.5
+    ids = [rec.new_trace_id() for _ in range(1000)]
+    decisions = [rec.sampled(t) for t in ids]
+    # Deterministic: same id, same answer, every time.
+    assert decisions == [rec.sampled(t) for t in ids]
+    assert 350 < sum(decisions) < 650  # ~50%, generous bounds
+    rec.sample_rate = 1.0
+    assert all(rec.sampled(t) for t in ids)
+    rec.sample_rate = 0.0
+    assert not any(rec.sampled(t) for t in ids)
+
+
+def test_unsampled_trace_propagates_context_but_records_nothing():
+    rec = trace.TraceRecorder()
+    rec.sample_rate = 0.0
+    s = rec.start_span("quiet")
+    assert s.context is not None  # downstream hops still see the id
+    # No exemplar may be minted from an unsampled span: the histogram→
+    # trace jump must never dead-end on a trace the ring can't show.
+    assert s.trace_id is None
+    s.end()
+    assert rec.dump() == []
+
+
+def test_link_forced_span_promotes_its_context_so_children_record():
+    """A fan-in span recorded because a LINKED trace is sampled must
+    hand children (the engine pass's kernel:* spans) a sampled
+    context — not silently drop them whenever its own fresh trace
+    rolls unsampled."""
+    rec = trace.TraceRecorder()
+    rec.sample_rate = 1.0
+    req = rec.start_span("request")
+    req.end()
+    rec.sample_rate = 0.0  # every fresh trace now rolls unsampled
+    batch = rec.start_span("batch", links=[req.context])
+    assert batch.context.sampled  # promoted
+    child = rec.start_span("kernel:merkle", parent=batch.context)
+    child.end()
+    batch.end()
+    names = {s.name for s in rec.dump()}
+    assert {"request", "batch", "kernel:merkle"} <= names
+
+
+# --- ring + links + exports ---
+
+
+def test_span_ring_is_bounded():
+    rec = trace.TraceRecorder(capacity=8)
+    for i in range(50):
+        rec.start_span(f"s{i}").end()
+    assert len(rec.dump()) == 8
+
+
+def test_spans_for_includes_fanin_links_and_tree_nests():
+    root = trace.start_span("root")
+    child = trace.start_span("child", parent=root.context)
+    child.end()
+    root.end()
+    batch = trace.start_span("batch", links=[child.context])
+    batch.end()
+    got = trace.serve_trace(root.trace_id)
+    names = {s["name"] for s in got["spans"]}
+    assert names == {"root", "child", "batch"}
+    (tree_root,) = [n for n in got["tree"] if n["name"] == "root"]
+    assert [c["name"] for c in tree_root["children"]] == ["child"]
+    (linked,) = [n for n in got["tree"] if n.get("linked")]
+    assert linked["name"] == "batch"
+    assert [root.trace_id, child.context.span_id] in linked["links"]
+
+
+def test_chrome_export_shape():
+    s = trace.start_span("kernel:merkle", attrs={"n": 3})
+    s.end()
+    out = trace.export_chrome()
+    (ev,) = [e for e in out["traceEvents"] if e["name"] == "kernel:merkle"]
+    assert ev["ph"] == "X" and ev["dur"] >= 0 and ev["args"]["n"] == 3
+
+
+def test_log_span_mirrors_into_active_trace_under_kernel_name():
+    from evolu_tpu.utils.log import span
+
+    root = trace.start_span("batch")
+    with trace.use(root.context):
+        with span("kernel:reconcile"):
+            pass
+    root.end()
+    names = [s.name for s in trace.spans_for(root.trace_id)]
+    assert "kernel:reconcile" in names and "batch" in names
+
+
+def test_write_evidence_artifact(tmp_path):
+    trace.start_span("ev").end()
+    path = trace.write_evidence("unit", seed=7)
+    with open(path) as f:
+        payload = json.load(f)
+    assert payload["seed"] == 7
+    assert any(e["name"] == "ev" for e in payload["trace"]["traceEvents"])
+    assert "counters" in payload["metrics"]
+
+
+# --- relay surface: /trace + token gate + header fuzz ---
+
+
+def test_relay_trace_endpoint_and_404s():
+    server = RelayServer(RelayStore()).start()
+    try:
+        root = trace.start_span("client.mutate")
+        hdr = {trace.TRACEPARENT_HEADER: trace.format_traceparent(root.context)}
+        _http_post(server.url + "/", protocol.encode_sync_request(
+            _sync_request("alice", _msgs(0, 2))), headers=hdr)
+        root.end()
+        got = json.loads(_get(server.url + f"/trace/{root.trace_id}"))
+        names = {s["name"] for s in got["spans"]}
+        assert {"client.mutate", "relay.sync", "relay.respond"} <= names
+        (srv,) = [s for s in got["spans"] if s["name"] == "relay.sync"]
+        assert srv["trace_id"] == root.trace_id
+        assert srv["attrs"]["owner"] == "alice"
+        # The index lists the trace; chrome format parses.
+        assert root.trace_id in json.loads(_get(server.url + "/trace"))["recent"]
+        chrome = json.loads(_get(
+            server.url + f"/trace/{root.trace_id}?format=chrome"))
+        assert chrome["traceEvents"]
+        # Not-a-trace-id answers 404, never 500.
+        for bad in ("zz", "a" * 31, "A" * 32, "a" * 33):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(server.url + "/trace/" + bad)
+            assert e.value.code == 404
+    finally:
+        server.stop()
+
+
+def test_obs_token_gates_metrics_stats_and_trace(monkeypatch):
+    server = RelayServer(RelayStore()).start()
+    try:
+        monkeypatch.setenv("EVOLU_OBS_TOKEN", "s3cret")
+        for path in ("/metrics", "/stats", "/trace"):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(server.url + path)
+            assert e.value.code == 403
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(server.url + path, {"X-Evolu-Obs-Token": "wrong"})
+            assert e.value.code == 403
+            # A non-ASCII token header must 403, never crash the
+            # handler (compare_digest rejects non-ASCII str inputs).
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(server.url + path, {"X-Evolu-Obs-Token": "s\xe9cret"})
+            assert e.value.code == 403
+            assert _get(server.url + path, {"X-Evolu-Obs-Token": "s3cret"})
+        # /ping (liveness) stays open — probes carry no tokens.
+        assert _get(server.url + "/ping") == b"ok"
+        monkeypatch.delenv("EVOLU_OBS_TOKEN")
+        assert _get(server.url + "/metrics")  # unset = open, unchanged
+    finally:
+        server.stop()
+
+
+def test_malformed_traceparent_headers_are_ignored_never_an_error():
+    """The header-fuzz pin: a hostile/oversized/malformed traceparent
+    must never change the HTTP outcome — the request serves 200 and
+    the response bytes are identical to the headerless request."""
+    server = RelayServer(RelayStore()).start()
+    try:
+        body = protocol.encode_sync_request(_sync_request("fuzz", _msgs(1, 1)))
+        baseline = _http_post(server.url + "/", body)
+        for hdr in (
+            "garbage", "00", "00-zz-xx-01", "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",
+            "00-" + "0" * 32 + "-" + "0" * 16 + "-00",
+            "x" * 8192, "00-" + "a" * 4096 + "-b-01", "\x7f\x01\x02",
+            "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01-" + "y" * 4000,
+        ):
+            out = _http_post(server.url + "/", body,
+                             headers={trace.TRACEPARENT_HEADER: hdr})
+            assert out == baseline, f"header {hdr[:40]!r} changed the response"
+    finally:
+        server.stop()
+
+
+# --- client transport hop ---
+
+
+def test_sync_transport_sends_traceparent_of_the_mutation_trace():
+    from evolu_tpu.core.types import Owner
+    from evolu_tpu.runtime.messages import SyncRequestInput
+    from evolu_tpu.sync.client import SyncTransport
+    from evolu_tpu.utils.config import Config
+
+    seen = {}
+
+    def capturing_post(url, body, headers=None):
+        seen["headers"] = headers or {}
+        # An empty, valid sync response.
+        return protocol.encode_sync_response(protocol.SyncResponse((), "{}"))
+
+    transport = SyncTransport(
+        Config(sync_url="http://example.invalid"),
+        on_receive=lambda *a: None, http_post=capturing_post,
+    )
+    try:
+        root = trace.start_span("client.mutate")
+        transport.request_sync(SyncRequestInput(
+            messages=(), clock_timestamp=timestamp_to_string(
+                Timestamp(BASE, 0, "00000000000000aa")),
+            merkle_tree="{}", owner=Owner("o", "m"), trace=root.context,
+        ))
+        transport.flush()
+        root.end()
+        hdr = seen["headers"].get(trace.TRACEPARENT_HEADER)
+        assert hdr is not None and root.trace_id in hdr
+        # The round span joined the mutation's trace in the ring.
+        names = [s.name for s in trace.spans_for(root.trace_id)]
+        assert "sync.round" in names
+    finally:
+        transport.stop()
+
+
+def test_worker_send_mints_the_mutation_root_span():
+    from evolu_tpu.runtime.client import create_evolu
+
+    evolu = create_evolu({"todo": ("title",)})
+    pushed = []
+    evolu.worker.post_sync = pushed.append
+    try:
+        evolu.create("todo", {"title": "traced"})
+        evolu.worker.flush()
+        (req,) = pushed[-1:]
+        assert req.trace is not None
+        spans = trace.spans_for(req.trace.trace_id)
+        assert [s.name for s in spans] == ["client.mutate"]
+        assert spans[0].attrs["messages"] >= 1
+    finally:
+        evolu.dispose()
+
+
+# --- the acceptance scenario ---
+
+
+def _fleet_pair(forward: bool, scheduler=None):
+    """A 2-relay fleet with hour-long gossip intervals (everything
+    must ride the hint chain) and replication UNSCOPED from placement:
+    the episode wants a full replication edge so one trace can cross
+    routing AND gossip — a production R=2 fleet gets the same edge
+    from its replica set; with only two relays R=2 would also make
+    every owner local and kill the routing leg under test."""
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=3600)
+    b = RelayServer(RelayStore(), peers=[], replication_interval_s=3600,
+                    scheduler=scheduler)
+    cfg = FleetConfig(relays=(a.url, b.url), replication_factor=1,
+                      version=1, forward=forward)
+    a.enable_fleet(cfg)
+    b.enable_fleet(cfg)
+    a.replication.fleet = None
+    b.replication.fleet = None
+    a.start()
+    b.start()
+    return a, b
+
+
+def test_single_trace_id_covers_every_hop_across_the_2relay_fleet():
+    """ISSUE 10 acceptance: one client mutation drives
+    forward-routing → scheduler-coalesce → engine → gossip; a single
+    trace id yields a span tree covering every hop via GET /trace/<id>
+    on BOTH relays (queue-wait/engine split present; the batch span
+    links >= 2 request spans from different owners), while wire bytes
+    (v1 and v2 records) and SQLite end state stay byte-identical with
+    tracing on; the convergence-lag histogram and per-(owner, peer)
+    freshness gauge fire on the pulling replica."""
+    sched = None
+    a = b = None
+    try:
+        store_b = RelayStore()
+        sched = SyncScheduler(store_b, max_batch=8, max_wait_s=0.4)
+        a = RelayServer(RelayStore(), peers=[], replication_interval_s=3600)
+        b = RelayServer(store_b, peers=[], replication_interval_s=3600,
+                        scheduler=sched)
+        cfg = FleetConfig(relays=(a.url, b.url), replication_factor=1,
+                          version=1, forward=True)
+        a.enable_fleet(cfg)
+        b.enable_fleet(cfg)
+        a.replication.fleet = None  # see _fleet_pair's rationale
+        b.replication.fleet = None
+        a.start()
+        b.start()
+
+        owner_fwd = _owner_for(a.fleet.ring, b.url, prefix="fw")
+        owner_direct = _owner_for(b.fleet.ring, b.url, prefix="dx")
+        # One v1 (OpenPGP-shaped) and one v2 (aead GCM magic) record:
+        # both are opaque ciphertext to every hop — byte-identity must
+        # hold for the negotiated wire exactly like the v1 wire.
+        msgs_fwd = _msgs(0, 1) + _msgs(
+            0, 1, t0=1, content=aead.MAGIC + b"\x00" * 44)
+        msgs_direct = _msgs(7, 2)
+        req_fwd = _sync_request(owner_fwd, msgs_fwd)
+        req_direct = _sync_request(owner_direct, msgs_direct)
+
+        root = trace.start_span("client.mutate")
+        hdr = {trace.TRACEPARENT_HEADER: trace.format_traceparent(root.context)}
+        results = {}
+
+        def post_forwarded():
+            # Client → A; A is not placed for owner_fwd → proxies the
+            # UNTOUCHED body to B through /fleet/forward.
+            results["fwd"] = _http_post(
+                a.url + "/", protocol.encode_sync_request(req_fwd), headers=hdr)
+
+        def post_direct():
+            results["direct"] = _http_post(
+                b.url + "/", protocol.encode_sync_request(req_direct))
+
+        threads = [threading.Thread(target=post_forwarded),
+                   threading.Thread(target=post_direct)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        root.end()
+        assert set(results) == {"fwd", "direct"}
+        # Both owners landed on B only, coalesced through ONE fused
+        # engine pass (the 0.4s window spans both posts).
+        assert sorted(b.store.user_ids()) == sorted([owner_fwd, owner_direct])
+        assert a.store.user_ids() == []
+        assert metrics.get_counter("evolu_sched_batches_total") == 1
+
+        # Byte-identity with tracing ON: the traced, forwarded,
+        # coalesced response equals the untraced per-request oracle on
+        # an identical store — for the v2-bearing request too.
+        trace.set_enabled(False)
+        oracle = RelayStore()
+        expect_fwd = serve_single_request(oracle, req_fwd)
+        expect_direct = serve_single_request(oracle, req_direct)
+        trace.set_enabled(True)
+        assert results["fwd"] == expect_fwd
+        assert results["direct"] == expect_direct
+        # SQLite end state byte-identical to the untraced oracle.
+        for uid in (owner_fwd, owner_direct):
+            assert b.store.get_merkle_tree_string(uid) == \
+                oracle.get_merkle_tree_string(uid)
+            assert b.store.replica_messages(uid, "") == \
+                oracle.replica_messages(uid, "")
+        oracle.close()
+
+        # Gossip: B's manager holds BOTH writes' origin traces (the
+        # round's span parents the FIRST and links the rest — either
+        # order is correct behavior; pin the forwarded mutation first
+        # so the assertions below are deterministic). Peer B with A —
+        # B's summary POST carries the origin context, A's
+        # serve_summary arms A's hint with it, A's round pulls and
+        # ingests INTO THE SAME TRACE.
+        with b.replication._cv:
+            b.replication._hint_origins.sort(
+                key=lambda o: o.trace_id != root.trace_id)
+        b.replication.add_peer(a.url)
+        deadline = time.time() + 10
+        while time.time() < deadline and not a.replication._hint_origins:
+            time.sleep(0.02)
+        assert a.replication._hint_origins, "origin context never reached A"
+        assert a.replication._hint_origins[0].trace_id == root.trace_id
+        a.replication.add_peer(b.url)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if sorted(a.store.user_ids()) == sorted([owner_fwd, owner_direct]):
+                break
+            time.sleep(0.05)
+        assert sorted(a.store.user_ids()) == sorted([owner_fwd, owner_direct])
+        for uid in (owner_fwd, owner_direct):
+            assert a.store.get_merkle_tree_string(uid) == \
+                b.store.get_merkle_tree_string(uid)
+
+        # ONE trace id covers every hop, served by BOTH relays (the
+        # /trace surface is per-process; in-process test relays share
+        # the ring — each must answer the full tree).
+        for url in (a.url, b.url):
+            got = json.loads(_get(url + f"/trace/{root.trace_id}"))
+            names = {s["name"] for s in got["spans"]}
+            assert {
+                "client.mutate",       # client
+                "relay.sync",          # arrival at A
+                "fleet.forward",       # A → B proxy leg
+                "fleet.forward.serve",  # serve at B
+                "sched.queue",         # queue-wait split
+                "engine.batch",        # fused engine pass (linked)
+                "relay.respond",       # respond split
+                "repl.round",          # gossip round (origin trace)
+                "repl.summary",        # gossip HTTP legs
+                "repl.pull",
+                "repl.serve",          # serving side of gossip
+                "repl.ingest",         # visible at replica A
+            } <= names, f"missing hops: {sorted(names)}"
+        # The fan-in contract: the ONE batch span links BOTH request
+        # spans, which belong to different traces and owners.
+        (batch,) = [s for s in trace.recorder.dump() if s.name == "engine.batch"]
+        assert batch.attrs["requests"] == 2 and batch.attrs["owners"] == 2
+        assert len(batch.links) == 2
+        assert len({t for t, _ in batch.links}) == 2  # two distinct traces
+        assert any(t == root.trace_id for t, _ in batch.links)
+        # Queue-wait/engine split: both spans exist in the trace with
+        # real durations.
+        spans = trace.spans_for(root.trace_id)
+        (q,) = [s for s in spans if s.name == "sched.queue"]
+        assert q.duration_ms >= 0
+        assert any(s.name == "engine.batch" for s in spans)
+        # Parentage, not just presence: the serve at B nests under the
+        # forward hop at A, which nests under A's server span.
+        (fwd,) = [s for s in spans if s.name == "fleet.forward"]
+        (fws,) = [s for s in spans if s.name == "fleet.forward.serve"]
+        (a_sync,) = [s for s in spans if s.name == "relay.sync"]
+        assert fws.parent_id == fwd.span_id
+        assert fwd.parent_id == a_sync.span_id
+
+        # Convergence plane on the pulling replica (A): the freshness
+        # watermark equals the newest HLC millis it ingested per
+        # owner, and the write→visible histogram carries the origin
+        # trace as its exemplar.
+        for uid, msgs in ((owner_fwd, msgs_fwd), (owner_direct, msgs_direct)):
+            newest = BASE + (len(msgs) - 1) * 1000
+            assert metrics.registry.get_gauge(
+                "evolu_conv_owner_freshness_millis",
+                replica=a.replication.replica_id, peer=b.url.rstrip("/"),
+                owner=uid,
+            ) == newest
+        hist = metrics.registry.get_histogram(
+            "evolu_conv_write_visible_ms",
+            replica=a.replication.replica_id, peer=b.url.rstrip("/"),
+        )
+        assert hist is not None and hist[3] >= 2
+        exemplar = metrics.registry.get_exemplar(
+            "evolu_conv_write_visible_ms",
+            replica=a.replication.replica_id, peer=b.url.rstrip("/"),
+        )
+        assert exemplar is not None and exemplar[0] == root.trace_id
+    finally:
+        for s in (a, b):
+            if s is not None:
+                s.stop()
+
+
+def test_redirect_leg_joins_the_same_trace():
+    """Redirect-mode fleet: the 307 bounce at the non-placed relay and
+    the serve at the authoritative relay both land in the mutation's
+    trace (the client re-sends the same traceparent after following,
+    exactly like sync/client.py does)."""
+    a = b = None
+    try:
+        a, b = _fleet_pair(forward=False)
+        owner_b = _owner_for(a.fleet.ring, b.url, prefix="rd")
+        body = protocol.encode_sync_request(_sync_request(owner_b, _msgs(3, 2)))
+        root = trace.start_span("client.mutate")
+        hdr = {trace.TRACEPARENT_HEADER: trace.format_traceparent(root.context)}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _http_post(a.url + "/", body, headers=hdr)
+        assert e.value.code == 307
+        target = e.value.headers["Location"]
+        _http_post(target, body, headers=hdr)
+        root.end()
+        spans = trace.spans_for(root.trace_id)
+        names = [s.name for s in spans]
+        assert "fleet.redirect" in names  # the bounce, at A
+        # Two relay.sync spans in one trace: the 307'd arrival and the
+        # authoritative serve.
+        assert names.count("relay.sync") == 2
+    finally:
+        for s in (a, b):
+            if s is not None:
+                s.stop()
+
+
+def test_tracing_disabled_serves_identically_with_empty_ring():
+    server = RelayServer(RelayStore()).start()
+    try:
+        trace.set_enabled(False)
+        body = protocol.encode_sync_request(_sync_request("quiet", _msgs(2, 2)))
+        _http_post(server.url + "/", body, headers={
+            trace.TRACEPARENT_HEADER: "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01",
+        })
+        assert trace.recorder.dump() == []
+        assert json.loads(_get(server.url + "/trace"))["recent"] == []
+    finally:
+        trace.set_enabled(True)
+        server.stop()
