@@ -569,6 +569,54 @@ class DeviceWinnerCache:
         )
         return plan_batch_device_full(messages, {}, cols=cols)
 
+    # -- the PR-11 invariant audit --
+
+    def verify_against_db(self, sample: "int | None" = None) -> int:
+        """Audit the correctness centerpiece of the storage inversion
+        (PR-11 / ROADMAP #1, which promotes this cache from cache to
+        truth): every LIVE slot's (k1, k2) winner keys must equal
+        SQLite's MAX(timestamp) for its cell, read back from the HBM
+        slot arrays themselves — not from any host mirror. Streaming
+        mode holds no slots (SQLite is the winner source there), so
+        the audit is vacuous then by design. → the number of cells
+        checked; raises AssertionError naming the first divergent
+        cells. `sample` caps the audit to the first N cells (ops
+        surface — a full pull of a 2^22-slot cache is ~64 MiB over a
+        bandwidth-bound tunnel)."""
+        from evolu_tpu.ops.merge import winner_key_columns
+        from evolu_tpu.storage.apply import fetch_existing_winners
+
+        cells = list(self._slots)
+        if sample is not None:
+            cells = cells[: int(sample)]
+        if not cells:
+            return 0
+        winners = fetch_existing_winners(self._db, cells)
+        v1, v2, canonical = winner_key_columns(cells, winners)
+        if not canonical:
+            raise AssertionError(
+                "non-canonical stored winner occupies a cache slot "
+                "(the host-fallback invalidation contract is broken)"
+            )
+        # Gather ONLY the audited slots device-side and pull both
+        # columns in one wave (CLAUDE.md: never per-array, and a full
+        # 2^22-slot pull is the very 64 MiB `sample` exists to avoid).
+        idx = np.fromiter((self._slots[c] for c in cells), np.int64, len(cells))
+        with jax.enable_x64(True):
+            j_idx = jnp.asarray(idx)
+            w1, w2 = to_host_many(self._w1[j_idx], self._w2[j_idx])
+        bad = []
+        for j, c in enumerate(cells):
+            if int(w1[j]) != int(v1[j]) or int(w2[j]) != int(v2[j]):
+                bad.append((c, int(w1[j]), int(v1[j])))
+                if len(bad) >= 5:
+                    break
+        if bad:
+            raise AssertionError(
+                f"winner cache != MAX(timestamp) for {len(bad)}+ cells: {bad}"
+            )
+        return len(cells)
+
     def _host_fallback(self, messages, cells):
         """Non-canonical hex case: invalidate every touched cell —
         their SQLite winners may now be non-canonical, which the

@@ -902,6 +902,19 @@ class DbWorker:
         if cache is not None:
             cache.reset()
 
+    def verify_winner_cache(self, sample: "int | None" = None) -> int:
+        """Audit the PR-11 "device state is truth" invariant on THIS
+        worker's live cache: every HBM slot == SQLite MAX(timestamp)
+        for its cell (`DeviceWinnerCache.verify_against_db`). → cells
+        checked (0 when no cache is active — cpu backend, winner_cache
+        off, or streaming mode). The torture episode and the ops
+        surface both call through here so the audit always reads the
+        worker's actual planner state, not a reconstructed twin."""
+        cache = getattr(self._planner, "cache", None)
+        if cache is None:
+            return 0
+        return cache.verify_against_db(sample=sample)
+
     def _clear_query_caches(self) -> None:
         self.queries_rows_cache.clear()
         self.queries_raw_cache.clear()

@@ -475,11 +475,24 @@ class _PackedRows:
 
 class BatchReconciler:
     """Reconcile a batch of SyncRequests against one RelayStore or a
-    ShardedRelayStore (parallel per-shard ingest)."""
+    ShardedRelayStore (parallel per-shard ingest).
 
-    def __init__(self, store, mesh: Optional[Mesh] = None):
+    With a `write_behind` queue attached (PR-11,
+    `storage/write_behind.py`), `run_batch_wire` serves from
+    device-derived in-memory state instead: the batch's Merkle deltas
+    fold onto per-owner authoritative trees held by the queue, the
+    packed row buffers are ACKed into the durable log, and SQLite
+    materialization happens on the queue's background drain thread —
+    off the serving path. Responses that need stored MESSAGES (a
+    non-empty tree diff) wait on the owner's drain watermark first, so
+    every byte served from SQLite is committed state. The offline
+    entry points (`reconcile*`) stay synchronous — deferral is a
+    property of the live serving path only."""
+
+    def __init__(self, store, mesh: Optional[Mesh] = None, write_behind=None):
         self.store = store
         self.mesh = mesh or create_mesh()
+        self.write_behind = write_behind
         self._executor = None
         self._pull_pool = None
 
@@ -1052,19 +1065,176 @@ class BatchReconciler:
 
     def run_batch_wire(self, requests: Sequence[protocol.SyncRequest]) -> List[bytes]:
         """ONE engine/store pass for a live micro-batch → wire bytes per
-        request (the scheduler's entry point). Packed-capable stores
-        take `start_batch`/`finish_batch` (in-batch dedup in request
-        order, optimistic device hash, atomic per-shard insert+tree
-        commit); anything else routes through `reconcile_wire`, whose
-        `_ingest` picks the store-appropriate batched path. Either way
-        a failure rolls every shard transaction back before raising —
-        the scheduler's singleton retry depends on that."""
+        request (the scheduler's entry point). With a write-behind
+        queue attached, the pass defers SQLite entirely
+        (`_finish_batch_deferred`): serve from in-memory trees, ACK
+        into the durable log, answer — a `WriteBehindFull` raised
+        before the ACK leaves no state anywhere (the scheduler maps it
+        to 503 + Retry-After). Otherwise packed-capable stores take
+        `start_batch`/`finish_batch` (in-batch dedup in request order,
+        optimistic device hash, atomic per-shard insert+tree commit);
+        anything else routes through `reconcile_wire`, whose `_ingest`
+        picks the store-appropriate batched path. Either way a failure
+        rolls every shard transaction back before raising — the
+        scheduler's singleton retry depends on that."""
         stores, _ = self._shards()
+        if self.write_behind is not None and hasattr(
+            self.store, "get_merkle_tree_string"
+        ):
+            return self._finish_batch_deferred(self.start_batch(requests))
         if all(
             hasattr(getattr(s, "db", None), "relay_insert_packed") for s in stores
         ):
             return self.finish_batch(self.start_batch(requests), wire=True)
         return self.reconcile_wire(requests)
+
+    # -- write-behind serving (PR-11: device state is the truth) --
+
+    def _finish_batch_deferred(self, st) -> List[bytes]:
+        """Land batch k WITHOUT touching the btree: fold the device
+        deltas onto the queue's authoritative per-owner trees
+        (optimistically — every in-batch-deduped row XORs; rows that
+        turn out to be already stored are corrected EXACTLY at drain
+        time, see storage/write_behind.py), append the packed row
+        buffers + tree strings to the durable log (the ACK point), and
+        respond from the in-memory trees. Nothing is installed if the
+        append raises (backpressure or log failure) — the serving
+        state stays consistent for the retry."""
+        from evolu_tpu.core.merkle import merkle_tree_from_string
+        from evolu_tpu.storage.write_behind import IngestRecord
+
+        wb = self.write_behind
+        requests = st["requests"]
+        live, shard_data = st["live"], st["shard_data"]
+        trees: Dict[str, dict] = {}
+        strings: Dict[str, str] = {}
+        metrics.inc("evolu_engine_store_passes_total", path="write_behind")
+        if not live:
+            return self._respond_deferred(requests, trees, strings)
+        with span("kernel:merkle", "reconcile_deferred",
+                  owners=len({r.user_id for r in requests}),
+                  n=st["n_total"], shards=len(live)):
+            deltas_by_owner, _digest = deltas_finish(st["dev"])
+            for o, deltas in deltas_by_owner.items():
+                if not deltas:
+                    continue
+                cached = wb.serving_tree(o)
+                if cached is not None:
+                    base_tree = cached[0]
+                else:
+                    with wb.db_lock:
+                        raw = self.store.get_merkle_tree_string(o)
+                    base_tree = merkle_tree_from_string(raw)
+                tree = apply_prefix_xors(base_tree, deltas)
+                trees[o] = tree
+                strings[o] = merkle_tree_to_string(tree)
+            records = []
+            for si in live:
+                gu, gc, ts_packed, content_packed, lens = shard_data[si]
+                seen_o: set = set()
+                tree_rows = []
+                for o in gu:
+                    if o in strings and o not in seen_o:
+                        seen_o.add(o)
+                        tree_rows.append((o, strings[o]))
+                records.append(IngestRecord(
+                    gu, gc, ts_packed, content_packed, lens, tree_rows
+                ))
+            wb.append_batch(
+                records, {o: (trees[o], strings[o]) for o in strings}
+            )
+        return self._respond_deferred(requests, trees, strings)
+
+    def _resolve_tree_deferred(self, user_id: str, trees, tree_strings):
+        """`_resolve_tree` against the write-behind truth: this batch's
+        freshly folded tree, else the queue's serving cache (the owner
+        has undrained history), else the stored string (SQLite is
+        current for fully drained owners)."""
+        from evolu_tpu.core.merkle import merkle_tree_from_string
+
+        tree = trees.get(user_id)
+        if tree is not None:
+            return tree, tree_strings[user_id]
+        cached = self.write_behind.serving_tree(user_id)
+        if cached is not None:
+            tree, raw = cached
+        else:
+            with self.write_behind.db_lock:
+                raw = self.store.get_merkle_tree_string(user_id)
+            tree = merkle_tree_from_string(raw)
+        trees[user_id] = tree
+        tree_strings[user_id] = raw
+        return tree, raw
+
+    def _respond_deferred(self, requests, trees, strings) -> List[bytes]:
+        """Bytes-mode respond for the deferred path. The hot shape —
+        trees agree after the push — answers tree-only from memory
+        (ZERO SQLite). A non-empty diff needs stored messages: wait on
+        the owner's drain watermark, re-read the owner's EXACT
+        committed tree, and run the SAME `fetch_response_stream`
+        composition (the one byte-format-coupled copy, shared with
+        `sync_wire` and `_respond_wire`) under the drain lock.
+
+        The exact re-read matters beyond precision: a duplicate-
+        carrying push folds an already-stored row's hash onto a base
+        that contains it (XOR-cancel), so the OPTIMISTIC tree claims
+        the row is missing. Serving that tree would make the client
+        re-send the row every round — each redelivery re-cancelling it
+        — a permanent retry livelock. Post-flush SQLite carries the
+        drain-corrected truth, so the served tree converges instead
+        (review finding, pinned by
+        test_write_behind.py::test_duplicate_retry_response_tree_is_exact).
+        Shards that cannot C-serve degrade to the batched object
+        respond, also post-flush."""
+        from evolu_tpu.core.merkle import diff_merkle_trees, merkle_tree_from_string
+        from evolu_tpu.core.types import NonCanonicalStoreError
+        from evolu_tpu.server.relay import fetch_response_stream
+
+        wb = self.write_behind
+        shards, shard_ix = self._shards()
+        out: List[Optional[bytes]] = []
+        fallback: List[Tuple[int, protocol.SyncRequest]] = []
+        for i, r in enumerate(requests):
+            tree, raw = self._resolve_tree_deferred(r.user_id, trees, strings)
+            client_tree = merkle_tree_from_string(r.merkle_tree)
+            if diff_merkle_trees(tree, client_tree) is None:
+                out.append(protocol._string(2, raw))
+                continue
+            # The response needs stored rows: SQLite must be current
+            # for this owner first (the per-owner drain watermark),
+            # and from here on the EXACT committed tree serves.
+            wb.flush_owner(r.user_id)
+            with wb.db_lock:
+                raw = self.store.get_merkle_tree_string(r.user_id)
+            tree = merkle_tree_from_string(raw)
+            trees[r.user_id] = tree
+            strings[r.user_id] = raw
+            if diff_merkle_trees(tree, client_tree) is None:
+                # The optimistic divergence was the duplicate-cancel
+                # artifact; the committed trees actually agree.
+                out.append(protocol._string(2, raw))
+                continue
+            db = getattr(shards[shard_ix(r.user_id)], "db", None)
+            if db is None or not hasattr(db, "fetch_relay_messages_wire"):
+                fallback.append((i, r))
+                out.append(None)
+                continue
+            try:
+                with wb.db_lock:
+                    stream = fetch_response_stream(
+                        db, r.user_id, r.node_id, tree, client_tree
+                    )
+            except NonCanonicalStoreError:
+                fallback.append((i, r))
+                out.append(None)
+                continue
+            out.append(stream + protocol._string(2, raw))
+        if fallback:
+            with wb.db_lock:
+                resps = self._respond([r for _i, r in fallback], trees, strings)
+            for (i, _r), resp in zip(fallback, resps):
+                out[i] = protocol.encode_sync_response(resp)
+        return out
 
     def _respond_wire(
         self, requests, trees: Dict[str, dict],

@@ -169,7 +169,7 @@ class FleetManager:
 
     def __init__(self, store, config: FleetConfig, self_url: str,
                  replication=None, http_post=None, http_get=None,
-                 probe_ttl_s: float = PROBE_TTL_S):
+                 probe_ttl_s: float = PROBE_TTL_S, write_behind=None):
         import functools
 
         from evolu_tpu.sync.client import _http_post
@@ -177,6 +177,15 @@ class FleetManager:
         self.store = store
         self.self_url = self_url.rstrip("/")
         self.replication = replication
+        # PR-11: the rebalance installer is a direct store writer; on a
+        # write-behind relay each owner move runs behind the queue's
+        # drain barrier (drained + drain-locked — coarse, but owner
+        # moves are operator events, and the moved owners are
+        # FleetNotReady during the install so no serving-path state
+        # races them). Backlog-driven readiness lives in the relay's
+        # /health handler: a saturated backlog answers 503, so peer
+        # failover and the rebalance readiness probe route around it.
+        self.write_behind = write_behind
         self._post = http_post or functools.partial(_http_post, retries=0)
         self._get = http_get or _http_get_status
         self._probe_ttl_s = float(probe_ttl_s)
@@ -453,9 +462,16 @@ class FleetManager:
             self._installing.update(gained)
         t0 = time.perf_counter()
         try:
-            installed_msgs, shipped_trees = self._install_from_snapshot(
-                peer_url, set(gained)
+            from contextlib import nullcontext
+
+            barrier = (
+                self.write_behind.drain_barrier()
+                if self.write_behind is not None else nullcontext()
             )
+            with barrier:
+                installed_msgs, shipped_trees = self._install_from_snapshot(
+                    peer_url, set(gained)
+                )
         except BaseException:
             # Nothing (or a prefix) landed — all of it through the
             # idempotent XOR gate, so partial installs are safe state.

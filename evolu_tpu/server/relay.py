@@ -364,7 +364,8 @@ class ShardedRelayStore:
             s.close()
 
 
-def relay_stats_payload(store, replication=None, fleet=None) -> dict:
+def relay_stats_payload(store, replication=None, fleet=None,
+                        write_behind=None) -> dict:
     """The GET /stats JSON: store-derived row counts per shard (shared
     truth in a MultiprocessRelay — every worker reads the same files)
     plus this process's request counters from the metrics registry
@@ -396,6 +397,8 @@ def relay_stats_payload(store, replication=None, fleet=None) -> dict:
         payload["replication"] = replication.stats_payload()
     if fleet is not None:
         payload["fleet"] = fleet.stats_payload()
+    if write_behind is not None:
+        payload["write_behind"] = write_behind.stats_payload()
     return payload
 
 
@@ -404,6 +407,7 @@ class _Handler(BaseHTTPRequestHandler):
     scheduler = None  # SyncScheduler when continuous batching is on
     replication = None  # ReplicationManager when the relay has peers
     fleet = None  # FleetManager when the relay is an owner-sharded fleet member
+    write_behind = None  # WriteBehindQueue when the PR-11 inversion is on
     # Capabilities this relay echoes back (intersected with the
     # request's advertised set — sync/protocol.py capability
     # extension). A request with no capabilities gets the v1 wire,
@@ -590,7 +594,7 @@ class _Handler(BaseHTTPRequestHandler):
                 # must surface as an HTTP 500, not a dropped connection.
                 body = json.dumps(
                     relay_stats_payload(self.store, self.replication,
-                                        self.fleet)
+                                        self.fleet, self.write_behind)
                 ).encode("utf-8")
             except Exception as e:  # noqa: BLE001
                 metrics.inc("evolu_relay_errors_total")
@@ -623,6 +627,27 @@ class _Handler(BaseHTTPRequestHandler):
                     # probing — readiness itself stays install-driven
                     # (a full queue answers 503 per request already).
                     detail["queue_depth"] = self.scheduler.depth()
+                if self.write_behind is not None:
+                    # Backlog + drain watermark (PR-11): fleet failover
+                    # and the rebalance readiness probe must not route
+                    # onto a relay whose materialization backlog is at
+                    # its admission bound — a saturated queue IS
+                    # not-ready (it would 503 the rerouted traffic
+                    # anyway; better to fail over before sending it).
+                    wbd = self.write_behind.health_payload()
+                    detail["write_behind"] = wbd
+                    if wbd["saturated"] or wbd["failing"]:
+                        # Saturated OR persistently failing drain: not
+                        # ready. The failing case matters because the
+                        # backlog may sit BELOW max_rows while every
+                        # flush-needing request hangs on the wedged
+                        # drain — without this, fleet failover would
+                        # keep routing onto a relay that cannot serve.
+                        serving = False
+                        detail["status"] = (
+                            "backlogged" if wbd["saturated"]
+                            else "drain-failing"
+                        )
             except Exception as e:  # noqa: BLE001 - probe gets a clean 500
                 metrics.inc("evolu_relay_errors_total")
                 self.send_error(500, str(e))
@@ -780,8 +805,19 @@ class _Handler(BaseHTTPRequestHandler):
             "repl.serve", parent=tctx,
             attrs={"leg": self.path.rsplit("/replicate/", 1)[-1]},
         )
+        from contextlib import nullcontext
+
+        # Every /replicate serve READS the store (summaries, pulls,
+        # snapshot capture): with write-behind on, force a drain first
+        # and hold the drain lock for the serve — peers and snapshot
+        # pullers must only ever see COMMITTED state (a snapshot of
+        # half-materialized rows would install as truth elsewhere).
+        barrier = (
+            self.write_behind.drain_barrier()
+            if self.write_behind is not None else nullcontext()
+        )
         try:
-            with sspan, trace.use(sspan.context):
+            with sspan, trace.use(sspan.context), barrier:
                 if self.path == "/replicate/summary":
                     out = replicate.serve_summary(
                         self.store, body, self.replication, origin=tctx
@@ -1037,7 +1073,9 @@ class RelayServer:
                  bootstrap_lag_owners: Optional[int] = None,
                  checkpoint_interval_s: Optional[float] = None,
                  checkpoint_path: Optional[str] = None,
-                 capabilities: Optional[Sequence[str]] = None):
+                 capabilities: Optional[Sequence[str]] = None,
+                 write_behind: Optional[bool] = None,
+                 write_behind_log: Optional[str] = None):
         self.store = store or RelayStore()
         # capabilities=() emulates a v1 peer (never echoes the
         # extension — tests pin the byte-identical fallback with it).
@@ -1045,11 +1083,48 @@ class RelayServer:
             protocol.KNOWN_CAPABILITIES if capabilities is None
             else tuple(capabilities)
         )
+        from evolu_tpu.utils.config import default_config
+
+        # PR-11 storage inversion (docs/WRITE_BEHIND.md): opt-in via
+        # constructor arg, EVOLU_WRITE_BEHIND=1, or Config.write_behind
+        # — default OFF (the synchronous path is the reference shape
+        # and every byte-identity pin's baseline). It rides the
+        # batching engine, so enabling it implies batching.
+        if write_behind is None:
+            env = os.environ.get("EVOLU_WRITE_BEHIND", "")
+            if env:
+                # A SET env var wins in both directions — an operator
+                # must be able to force the synchronous reference path
+                # (EVOLU_WRITE_BEHIND=0) over a Config default when
+                # bisecting, not just force the inversion on.
+                write_behind = env.lower() not in ("0", "false", "no", "off")
+            else:
+                write_behind = default_config.write_behind
+        self.write_behind = None
+        if write_behind:
+            from evolu_tpu.storage.write_behind import WriteBehindQueue
+
+            if write_behind_log is None:
+                shards = getattr(self.store, "shards", None)
+                base = getattr(
+                    getattr((shards[0] if shards else self.store), "db", None),
+                    "path", None,
+                )
+                if base and base != ":memory:":
+                    write_behind_log = base + ".wblog"
+            self.write_behind = WriteBehindQueue(
+                self.store, log_path=write_behind_log,
+                max_rows=default_config.write_behind_max_rows,
+                drain_batch_rows=default_config.write_behind_drain_rows,
+            )
+            batching = True
         self.scheduler = scheduler
         if batching and scheduler is None:
             from evolu_tpu.server.scheduler import SyncScheduler
 
-            self.scheduler = SyncScheduler(self.store)
+            self.scheduler = SyncScheduler(
+                self.store, write_behind=self.write_behind
+            )
         self.replication = replication
         if peers is not None and replication is None:
             from evolu_tpu.server.replicate import ReplicationManager
@@ -1058,6 +1133,7 @@ class RelayServer:
                 self.store, peers, scheduler=self.scheduler,
                 interval_s=replication_interval_s,
                 bootstrap_lag_owners=bootstrap_lag_owners,
+                write_behind=self.write_behind,
             )
         self.checkpointer = None
         if checkpoint_interval_s is None:
@@ -1076,14 +1152,17 @@ class RelayServer:
                     )
                 checkpoint_path = store_path + ".checkpoint"
             self.checkpointer = CheckpointWriter(
-                self.store, checkpoint_path, checkpoint_interval_s
+                self.store, checkpoint_path, checkpoint_interval_s,
+                barrier=(self.write_behind.drain_barrier
+                         if self.write_behind is not None else None),
             )
         self.fleet = None
         self._handler_cls = type(
             "BoundHandler", (_Handler,),
             {"store": self.store, "scheduler": self.scheduler,
              "replication": self.replication,
-             "capabilities": self.capabilities},
+             "capabilities": self.capabilities,
+             "write_behind": self.write_behind},
         )
         self._httpd = _RelayHTTPServer((host, port), self._handler_cls)
         self._thread: Optional[threading.Thread] = None
@@ -1105,6 +1184,7 @@ class RelayServer:
         self.fleet = FleetManager(
             self.store, config, self_url or self.url,
             replication=self.replication,
+            write_behind=self.write_behind,
         )
         self._handler_cls.fleet = self.fleet
         if self.replication is not None:
@@ -1149,6 +1229,12 @@ class RelayServer:
             # submit() get their responses, and only then does the
             # storage go away. Post-drain submits answer 503.
             self.scheduler.stop()
+        if self.write_behind is not None:
+            # After the scheduler drained (its final batches appended
+            # records), before the store closes: flush everything to
+            # SQLite and stop the drain thread. The log is empty at
+            # this point — a clean shutdown leaves nothing to replay.
+            self.write_behind.close()
         self._httpd.server_close()
         self.store.close()
 

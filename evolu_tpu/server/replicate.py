@@ -230,6 +230,7 @@ class ReplicationManager:
         pull_messages_per_response: Optional[int] = None,
         bootstrap_lag_owners: Optional[int] = None,
         snapshot_chunk_bytes: Optional[int] = None,
+        write_behind=None,
     ):
         import functools
         import random
@@ -285,6 +286,12 @@ class ReplicationManager:
         # stays off — a partitioned relay must never install every
         # owner of a donor).
         self.fleet = None
+        # PR-11: with a write-behind queue on this relay, outbound
+        # gossip summaries read the store directly (owner_trees) — a
+        # round starts by draining so we only ever ADVERTISE committed
+        # state (a tree advertised ahead of its rows would make peers
+        # pull ranges the store cannot yet serve).
+        self.write_behind = write_behind
         now = time.monotonic()
         self._peers = [_Peer(u, now) for u in peers]
         self._swap_checked = False
@@ -513,6 +520,11 @@ class ReplicationManager:
         )
         try:
             with rspan, trace.use(rspan.context):
+                if self.write_behind is not None:
+                    # Advertise only committed state (see __init__). A
+                    # drain failure lands in the round's failure
+                    # handling — peer backoff, never a thread crash.
+                    self.write_behind.flush()
                 converged, pulled = self._gossip(peer)
         except _ManagerStopping:
             with self._cv:
@@ -735,7 +747,25 @@ class ReplicationManager:
         SIGKILL anywhere in the fetch loop resumes from the last
         committed chunk without re-transferring completed ones; a
         donor-side snapshot expiry (HTTP 400 on the chunk leg) drops
-        the stale install and the next round restarts fresh."""
+        the stale install and the next round restarts fresh.
+
+        With a write-behind queue the whole bootstrap runs behind its
+        `drain_barrier` (review finding): the swap replaces shard
+        contents, and a record ACKed against the PRE-swap tree base
+        would later drain its stale tree string over the installed
+        one — permanent tree/message divergence. The barrier makes the
+        window airtight, not just drained-at-entry: it clears the
+        serve-time tree cache, so any concurrent serve's base-tree
+        read blocks on `db_lock` until the swap is complete and then
+        reads post-swap truth. (Coarse — whole-store bootstrap is a
+        cold-start/operator event, same tradeoff as the fleet owner
+        move.)"""
+        if self.write_behind is not None:
+            with self.write_behind.drain_barrier():
+                return self._bootstrap_locked(peer)
+        return self._bootstrap_locked(peer)
+
+    def _bootstrap_locked(self, peer: _Peer) -> int:
         import urllib.error
 
         from evolu_tpu.server import snapshot as snap

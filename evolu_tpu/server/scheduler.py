@@ -74,6 +74,14 @@ class SchedulerQueueFull(Exception):
         self.retry_after = retry_after
 
 
+def _write_behind_full_type():
+    """Lazy import for the except clause (evaluated at raise time):
+    the scheduler stays importable without touching storage modules."""
+    from evolu_tpu.storage.write_behind import WriteBehindFull
+
+    return WriteBehindFull
+
+
 class _Pending:
     """One enqueued request + its future. `single=True` marks a
     request the engine can't batch: it dispatches alone, still ON the
@@ -140,8 +148,19 @@ class SyncScheduler:
         max_queue: int = 256,
         retry_after_s: float = 1.0,
         submit_timeout_s: float = 120.0,
+        write_behind=None,
     ):
         self.store = store
+        # PR-11: a storage.write_behind.WriteBehindQueue makes the
+        # engine serve from device-derived in-memory state and defer
+        # SQLite to the queue's drain thread. The scheduler's jobs:
+        # construct the engine with it, convert its backpressure into
+        # the 503 + Retry-After answer (queue-full stalls admission,
+        # never drops), and run every DIRECT store write (singleton
+        # fallbacks — non-batchable shapes, poison retries) behind the
+        # queue's drain barrier so sync_wire reads and writes only
+        # committed state.
+        self._write_behind = write_behind
         self.max_batch = max(1, int(max_batch))
         self.max_wait_s = float(max_wait_s)
         self.max_queue = int(max_queue)
@@ -321,6 +340,22 @@ class SyncScheduler:
             with trace.use(bspan.context):
                 outs = engine.run_batch_wire([p.request for p in batch])
             bspan.end()
+        except _write_behind_full_type() as e:
+            # Write-behind admission backpressure: nothing was served
+            # or persisted (the engine raises BEFORE the log ACK).
+            # This is flow control, not poison — answer every batch
+            # member 503 + Retry-After instead of slamming the
+            # singleton path with the very writes the queue stalled.
+            bspan.set_attr("backpressure", True)
+            bspan.end()
+            # Counting: the queue already counted the stall
+            # (evolu_wb_stalls_total) and the relay counts the 503
+            # answer (evolu_relay_backpressure_total) — no fallback
+            # counter here: these requests were NOT served on the
+            # per-request path, they were shed as flow control.
+            for p in batch:
+                p.fail(SchedulerQueueFull(e.retry_after))
+            return
         except Exception as e:  # noqa: BLE001 - poison isolation
             # (BaseException — KeyboardInterrupt/SystemExit — is NOT
             # poison: it propagates, and the loop's finally fails any
@@ -371,7 +406,9 @@ class SyncScheduler:
             try:
                 from evolu_tpu.server.engine import BatchReconciler
 
-                self._engine = BatchReconciler(self.store, self._mesh)
+                self._engine = BatchReconciler(
+                    self.store, self._mesh, write_behind=self._write_behind
+                )
             except Exception as e:  # noqa: BLE001
                 self._engine_broken = e
                 raise
@@ -387,6 +424,13 @@ class SyncScheduler:
         transaction on the shared store connection."""
         from evolu_tpu.server.relay import serve_single_request
 
+        if self._write_behind is not None:
+            # Direct store writes (the host-oracle / non-batchable
+            # path) must observe and produce committed state: drain
+            # everything, hold the drain lock for the duration, and
+            # let the queue's serving caches fall back to SQLite truth.
+            with self._write_behind.drain_barrier():
+                return serve_single_request(self.store, request)
         return serve_single_request(self.store, request)
 
     def stop(self) -> None:

@@ -753,12 +753,21 @@ CHECKPOINT_MAGIC = b"EVOLUSNAP1\n"
 
 
 def write_checkpoint(store, path: str,
-                     chunk_bytes: int = SNAPSHOT_CHUNK_BYTES) -> protocol.SnapshotManifest:
+                     chunk_bytes: int = SNAPSHOT_CHUNK_BYTES,
+                     barrier=None) -> protocol.SnapshotManifest:
     """Capture the store and atomically replace the checkpoint file
     (tmp + fsync + rename): a crash mid-write leaves the previous
     checkpoint intact — the file is always a complete, crc-covered
-    snapshot or absent."""
-    manifest, chunks = capture_snapshot(store, chunk_bytes)
+    snapshot or absent. `barrier` is an optional context-manager
+    factory held across the CAPTURE (PR-11: the write-behind queue's
+    `drain_barrier` — a checkpoint is a durable floor, so it must see
+    fully committed state, and the drain must not commit underneath
+    the capture's read transactions)."""
+    if barrier is not None:
+        with barrier():
+            manifest, chunks = capture_snapshot(store, chunk_bytes)
+    else:
+        manifest, chunks = capture_snapshot(store, chunk_bytes)
     blob = protocol.encode_snapshot_manifest(manifest)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -828,11 +837,12 @@ class CheckpointWriter:
     and logged, never fatal — the previous checkpoint stays valid."""
 
     def __init__(self, store, path: str, interval_s: float,
-                 chunk_bytes: int = SNAPSHOT_CHUNK_BYTES):
+                 chunk_bytes: int = SNAPSHOT_CHUNK_BYTES, barrier=None):
         self.store = store
         self.path = path
         self.interval_s = float(interval_s)
         self.chunk_bytes = int(chunk_bytes)
+        self.barrier = barrier  # see write_checkpoint (PR-11 drain barrier)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -847,7 +857,8 @@ class CheckpointWriter:
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             try:
-                write_checkpoint(self.store, self.path, self.chunk_bytes)
+                write_checkpoint(self.store, self.path, self.chunk_bytes,
+                                 barrier=self.barrier)
             except Exception as e:  # noqa: BLE001 - keep checkpointing
                 metrics.inc("evolu_snap_checkpoint_failures_total")
                 log("server", "checkpoint write failed", path=self.path,

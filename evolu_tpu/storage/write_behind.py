@@ -1,0 +1,818 @@
+"""Bounded async write-behind materializer: SQLite off the serving path.
+
+PR-11 (ROADMAP #1) inverts the engine's storage architecture. The
+serving path (`server/engine.BatchReconciler.run_batch_wire`) answers
+sync responses and Merkle questions from in-memory authoritative state
+— per-owner trees folded from the device hash kernel's deltas — and
+hands SQLite materialization to this queue. The btree (measured wall:
+~0.72M rows/s/core, multi-row INSERT already a recorded negative
+result) is drained by ONE background thread in batches sized for it,
+off the request path.
+
+Durability contract (the "ACKed write is never lost" floor):
+- Every appended record is framed (length + crc32) into an append-only
+  log and fsync'd BEFORE `append_batch` returns — the ACK point. A
+  torn tail (crash mid-write) fails its crc and is discarded on
+  replay; everything before it replays.
+- Replay is idempotent and EXACT: message inserts are PK-deduped
+  (INSERT OR IGNORE), and replay recomputes every owner tree from the
+  per-row was-new flags through the host oracle fold
+  (`core.merkle.minute_deltas_host`) — byte-identical to a
+  synchronous-apply twin regardless of where the crash landed
+  (mid-queue, mid-drain, mid-checkpoint; the torture episode in
+  tests/test_model_check.py is the license).
+- The log truncates only once fully drained AND committed; a crash
+  between commit and truncate just replays committed records (no-ops).
+- SQLite durability past the drain commit is SQLite's own (WAL +
+  synchronous=NORMAL survives process crash; the log covers the
+  undrained tail).
+
+Ordering and exactness:
+- Records drain strictly in append (seq) order; an owner's history is
+  only ever appended from the one engine dispatcher thread, so
+  per-owner order is total.
+- The engine's serve-time trees are OPTIMISTIC: every in-batch-deduped
+  row XORs (it cannot see rows already stored without touching the
+  btree). The drain compares against the INSERT's was-new flags: a
+  clean record (steady state — all rows new) lands its precomputed
+  tree string verbatim; a record with any already-stored row gets its
+  owner's tree recomputed exactly from the new rows only, the owner's
+  serving cache entry is dropped, and later pending records of that
+  owner (whose precomputed trees were folded on the stale optimistic
+  base) recompute too, until the serving path has re-read the
+  corrected tree (`_needs_flush` handshake). Steady state pays zero
+  Python per-row work; duplicate delivery converges to the oracle
+  state at drain latency.
+
+Backpressure is explicit: a full queue raises `WriteBehindFull` before
+mutating anything — the scheduler maps it to its 503 + Retry-After
+path (queue-full stalls admission, never drops).
+
+Concurrency: the drain thread is a second writer on the store's
+connections. `db_lock` serializes transactional SQLite use between
+the drain and any serving-path read (tree reads, response message
+fetches); `drain_barrier()` (flush + hold `db_lock`) is the
+whole-store consistency point used by snapshot capture, checkpoints,
+replication reads, and the direct per-request write path.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import time
+import zlib
+from collections import deque
+from contextlib import contextmanager
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from evolu_tpu.obs import metrics, trace
+from evolu_tpu.utils.log import log
+
+LOG_MAGIC = b"EVOLUWB1\n"
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+
+# Histogram buckets for drain batch sizes (rows) — reuse the count scale.
+_ROW_BUCKETS = metrics.COUNT_BUCKETS
+
+
+class WriteBehindFull(Exception):
+    """Admission backpressure: the pending queue is at capacity. The
+    caller should stall the write (the scheduler answers 503 +
+    `retry_after` seconds) — never drop it."""
+
+    def __init__(self, retry_after: float, backlog_rows: int):
+        super().__init__(
+            f"write-behind queue full ({backlog_rows} rows pending); "
+            f"retry after {retry_after}s"
+        )
+        self.retry_after = retry_after
+        self.backlog_rows = backlog_rows
+
+
+class IngestRecord:
+    """One shard's slice of one engine batch: the packed row buffers
+    exactly as `engine.start_batch` built them (no repacking), plus the
+    optimistic per-owner tree strings computed at serve time. The
+    on-disk frame is length+crc-guarded; decode raises ValueError on
+    any corruption (the wire-decoder contract)."""
+
+    __slots__ = ("gu", "gc", "ts_packed", "content_packed", "lens", "tree_rows")
+
+    def __init__(self, gu: Sequence[str], gc: Sequence[int], ts_packed: bytes,
+                 content_packed: bytes, lens, tree_rows: Sequence[Tuple[str, str]]):
+        self.gu = list(gu)
+        self.gc = [int(c) for c in gc]
+        self.ts_packed = ts_packed
+        self.content_packed = content_packed
+        self.lens = np.ascontiguousarray(lens, dtype=np.int32)
+        self.tree_rows = list(tree_rows)
+
+    @property
+    def n_rows(self) -> int:
+        return int(len(self.lens))
+
+    def encode(self) -> bytes:
+        parts: List[bytes] = [_U32.pack(len(self.gu))]
+        for u, c in zip(self.gu, self.gc):
+            ub = u.encode("utf-8")
+            parts.append(_U16.pack(len(ub)))
+            parts.append(ub)
+            parts.append(_U32.pack(c))
+        parts.append(_U32.pack(len(self.ts_packed)))
+        parts.append(self.ts_packed)
+        parts.append(_U32.pack(len(self.content_packed)))
+        parts.append(self.content_packed)
+        lens = self.lens.astype("<i4", copy=False)
+        parts.append(_U32.pack(len(lens)))
+        parts.append(lens.tobytes())
+        parts.append(_U32.pack(len(self.tree_rows)))
+        for u, t in self.tree_rows:
+            ub, tb = u.encode("utf-8"), t.encode("utf-8")
+            parts.append(_U16.pack(len(ub)))
+            parts.append(ub)
+            parts.append(_U32.pack(len(tb)))
+            parts.append(tb)
+        return b"".join(parts)
+
+    @staticmethod
+    def decode(body: bytes) -> "IngestRecord":
+        def take(n: int) -> bytes:
+            nonlocal pos
+            if pos + n > len(body):
+                raise ValueError("truncated write-behind record")
+            out = body[pos : pos + n]
+            pos += n
+            return out
+
+        pos = 0
+        (n_groups,) = _U32.unpack(take(4))
+        gu: List[str] = []
+        gc: List[int] = []
+        for _ in range(n_groups):
+            (ul,) = _U16.unpack(take(2))
+            gu.append(take(ul).decode("utf-8"))
+            gc.append(_U32.unpack(take(4))[0])
+        (tl,) = _U32.unpack(take(4))
+        ts_packed = take(tl)
+        (cl,) = _U32.unpack(take(4))
+        content_packed = take(cl)
+        (nl,) = _U32.unpack(take(4))
+        lens = np.frombuffer(take(4 * nl), dtype="<i4").astype(np.int32)
+        (n_trees,) = _U32.unpack(take(4))
+        tree_rows: List[Tuple[str, str]] = []
+        for _ in range(n_trees):
+            (ul,) = _U16.unpack(take(2))
+            u = take(ul).decode("utf-8")
+            (sl,) = _U32.unpack(take(4))
+            tree_rows.append((u, take(sl).decode("utf-8")))
+        if pos != len(body):
+            raise ValueError("trailing bytes after write-behind record")
+        if sum(gc) != len(lens) or len(ts_packed) != 46 * len(lens):
+            raise ValueError("write-behind record shape mismatch")
+        if int(lens.sum()) != len(content_packed):
+            raise ValueError("write-behind record content size mismatch")
+        return IngestRecord(gu, gc, ts_packed, content_packed, lens, tree_rows)
+
+class _Pending:
+    __slots__ = ("seq", "record", "t_enqueue")
+
+    def __init__(self, seq: int, record: IngestRecord, t_enqueue: float):
+        self.seq = seq
+        self.record = record
+        self.t_enqueue = t_enqueue
+
+
+class WriteBehindQueue:
+    """The bounded, ordered, crash-safe materialization queue for one
+    relay store (RelayStore or ShardedRelayStore — records route to
+    shards at DRAIN time by the store's stable owner hash, so replay
+    survives a shard-count change).
+
+    `exact_replay` note: materialization runs in two modes. The normal
+    drain trusts each record's precomputed tree strings while the
+    INSERT's was-new flags say every row was new; replay (and tainted
+    owners) recompute trees from the flags through the host oracle
+    fold — always exact, never fast-pathed."""
+
+    # Consecutive failed drain batches before `failing()` trips the
+    # relay's /health readiness gate (the drain itself retries forever).
+    _FAILING_AFTER = 3
+
+    def __init__(
+        self,
+        store,
+        log_path: Optional[str] = None,
+        max_rows: int = 1 << 20,
+        drain_batch_rows: int = 1 << 16,
+        fsync: bool = True,
+        retry_after_s: float = 1.0,
+        _drain_delay_s: float = 0.0,
+    ):
+        self.store = store
+        self.log_path = log_path
+        self.max_rows = int(max_rows)
+        self.drain_batch_rows = int(drain_batch_rows)
+        self.fsync = bool(fsync)
+        self.retry_after_s = float(retry_after_s)
+        self._drain_delay_s = float(_drain_delay_s)  # torture-test hook
+
+        self._cv = threading.Condition()
+        self.db_lock = threading.RLock()
+        self._pending: Deque[_Pending] = deque()
+        self._pending_rows = 0
+        self._last_seq = 0
+        self._drained_seq = 0
+        self._owner_seq: Dict[str, int] = {}  # owner → last enqueued seq
+        # Serving-state caches, maintained only while the owner has
+        # pending records (SQLite is current once fully drained):
+        self._trees: Dict[str, Tuple[dict, str]] = {}
+        # Owners whose optimistic trees were corrected at drain: the
+        # serving path must flush + re-read before trusting anything.
+        self._needs_flush: Dict[str, int] = {}  # owner → seq bound
+        self._stopping = False
+        self._drain_err: Optional[BaseException] = None
+        # Consecutive failed drain batches. The drain retries forever
+        # (a transient SQLITE_BUSY must not lose records), so a
+        # PERSISTENT failure (full disk, poisoned record) must surface
+        # through readiness instead: past _FAILING_AFTER the relay's
+        # /health answers 503 and fleet failover routes around us.
+        self._drain_failures = 0
+
+        self._log = None
+        self._log_bytes = 0
+        # Set when the log file becomes unrecoverable (truncate after
+        # a failed append also failed): a configured-but-dead log must
+        # REFUSE admission rather than silently mint non-durable ACKs.
+        self._log_poisoned = False
+        if log_path is not None:
+            self._open_log_and_replay()
+
+        self._thread = threading.Thread(
+            target=self._drain_loop, daemon=True, name="evolu-wb-drain"
+        )
+        self._thread.start()
+
+    # -- store topology --
+
+    def _shards(self):
+        shards = getattr(self.store, "shards", None)
+        if shards is not None:
+            return shards, self.store.shard_index
+        return [self.store], (lambda _u: 0)
+
+    # -- durable log --
+
+    def _open_log_and_replay(self) -> None:
+        path = self.log_path
+        existing = b""
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                existing = f.read()
+        records = self._decode_log(existing)
+        if records:
+            metrics.inc("evolu_wb_replayed_records_total", len(records))
+            metrics.inc("evolu_wb_replayed_rows_total",
+                        sum(r.n_rows for r in records))
+            log("storage", "write-behind log replay",
+                records=len(records), path=path)
+            # Replay through the always-exact path BEFORE serving: an
+            # ACKed write is in SQLite by the time this constructor
+            # returns.
+            with self.db_lock:
+                self._materialize(records, exact=True)
+        self._log = open(path, "wb")
+        self._log.write(LOG_MAGIC)
+        self._log.flush()
+        if self.fsync:
+            os.fsync(self._log.fileno())
+        self._log_bytes = len(LOG_MAGIC)
+        metrics.set_gauge("evolu_wb_log_bytes", self._log_bytes)
+
+    @staticmethod
+    def _decode_log(data: bytes) -> List[IngestRecord]:
+        """Decode every intact record; a torn/corrupt tail (crash
+        mid-append, before the ACK) is discarded — everything before
+        it was either ACKed or harmless to re-apply."""
+        if not data:
+            return []
+        if not data.startswith(LOG_MAGIC):
+            raise ValueError("not an evolu write-behind log")
+        pos = len(LOG_MAGIC)
+        out: List[IngestRecord] = []
+        while pos < len(data):
+            if pos + 8 > len(data):
+                break  # torn frame header
+            (n,) = _U32.unpack_from(data, pos)
+            (crc,) = _U32.unpack_from(data, pos + 4)
+            body = data[pos + 8 : pos + 8 + n]
+            if len(body) != n or zlib.crc32(body) != crc:
+                break  # torn/corrupt tail — pre-ACK, discard
+            out.append(IngestRecord.decode(body))
+            pos += 8 + n
+        return out
+
+    def _log_append(self, records: Sequence[IngestRecord]) -> None:
+        if self._log is None:
+            return
+        start = self._log_bytes
+        try:
+            for r in records:
+                body = r.encode()
+                self._log.write(_U32.pack(len(body)))
+                self._log.write(_U32.pack(zlib.crc32(body)))
+                self._log.write(body)
+                self._log_bytes += 8 + len(body)
+            self._log.flush()
+            if self.fsync:
+                os.fsync(self._log.fileno())  # the ACK point
+        except BaseException:
+            # Roll the file back to the pre-append length: a partial
+            # frame left in place would fail its crc at replay and
+            # DISCARD every later fsynced (ACKed) record behind it —
+            # the exact durability violation this module forbids. If
+            # even the truncate fails, poison the log so no further
+            # ACKs can be minted over a corrupt tail.
+            try:
+                self._log.seek(start)
+                self._log.truncate()
+                self._log.flush()
+                if self.fsync:
+                    os.fsync(self._log.fileno())
+            except BaseException as te:  # noqa: BLE001
+                self._log.close()
+                self._log = None
+                self._log_poisoned = True
+                metrics.inc("evolu_wb_log_poisoned_total")
+                log("storage", "write-behind log unrecoverable; "
+                    "admission refused until restart", error=repr(te))
+            self._log_bytes = start
+            raise
+        metrics.set_gauge("evolu_wb_log_bytes", self._log_bytes)
+
+    def _log_truncate_locked(self) -> None:
+        """Called under `_cv` with the queue empty: everything in the
+        log is committed, so restart replay would be a pure no-op —
+        reclaim the file. A crash between the drain commit and this
+        truncate only re-replays committed records (idempotent)."""
+        if self._log is None or self._log_bytes == len(LOG_MAGIC):
+            return
+        self._log.seek(0)
+        self._log.truncate()
+        self._log.write(LOG_MAGIC)
+        self._log.flush()
+        if self.fsync:
+            os.fsync(self._log.fileno())
+        self._log_bytes = len(LOG_MAGIC)
+        metrics.set_gauge("evolu_wb_log_bytes", self._log_bytes)
+
+    # -- admission (engine dispatcher thread) --
+
+    def append_batch(
+        self,
+        records: Sequence[IngestRecord],
+        trees: Optional[Dict[str, Tuple[dict, str]]] = None,
+    ) -> int:
+        """Admit one engine batch (one record per storage shard):
+        durable log append + fsync (the ACK), then install the pending
+        records and the serve-time tree cache atomically. Raises
+        `WriteBehindFull` BEFORE mutating anything when the new rows
+        would exceed `max_rows` — the serving path's trees stay
+        consistent and the client retries after `retry_after`."""
+        n_rows = sum(r.n_rows for r in records)
+        if n_rows == 0:
+            return self._last_seq
+        with self._cv:
+            if self._stopping:
+                raise WriteBehindFull(self.retry_after_s, self._pending_rows)
+            if self._log_poisoned:
+                # A configured durable log that died mid-run must not
+                # degrade to memory-only ACKs ("an ACKed write is
+                # never lost" would become a lie held until the next
+                # crash). Clients keep retrying 503; /health reports
+                # failing so the fleet routes around us.
+                raise WriteBehindFull(self.retry_after_s, self._pending_rows)
+            if self._pending_rows + n_rows > self.max_rows and self._pending_rows:
+                metrics.inc("evolu_wb_stalls_total")
+                raise WriteBehindFull(self.retry_after_s, self._pending_rows)
+            # The log write + ACK fsync runs under _cv — deliberate:
+            # it happens once per ENGINE PASS (not per request), and
+            # holding the lock is what keeps the drain's truncate
+            # (also under _cv) from ever erasing a frame between its
+            # fsync and its pending-install. Readers (/health, /stats,
+            # serving_tree) stall at most one fsync (~ms).
+            self._log_append(records)
+            now = time.monotonic()
+            for r in records:
+                self._last_seq += 1
+                self._pending.append(_Pending(self._last_seq, r, now))
+                for o in r.gu:
+                    self._owner_seq[o] = self._last_seq
+            self._pending_rows += n_rows
+            if trees:
+                self._trees.update(trees)
+            metrics.inc("evolu_wb_enqueued_rows_total", n_rows)
+            metrics.set_gauge("evolu_wb_queue_rows", self._pending_rows)
+            metrics.set_gauge("evolu_wb_queue_records", len(self._pending))
+            seq = self._last_seq
+            self._cv.notify_all()
+        return seq
+
+    # -- serving-state reads (engine dispatcher thread) --
+
+    def serving_tree(self, owner: str) -> Optional[Tuple[dict, str]]:
+        """The authoritative serve-time tree for `owner`, or None when
+        SQLite is current (no pending history, or a drain-time
+        correction forced a flush — in which case this WAITS for the
+        owner's watermark so the subsequent SQLite read is exact)."""
+        with self._cv:
+            bound = self._needs_flush.get(owner)
+            if bound is None:
+                return self._trees.get(owner)
+        self.flush_owner(owner)
+        return None
+
+    # -- watermarks / flushes --
+
+    def backlog(self) -> Tuple[int, int]:
+        with self._cv:
+            return len(self._pending), self._pending_rows
+
+    def saturated(self) -> bool:
+        with self._cv:
+            return self._pending_rows >= self.max_rows
+
+    def failing(self) -> bool:
+        """True once the drain has failed `_FAILING_AFTER` consecutive
+        batches, or the durable log became unrecoverable (admission
+        refused) — persistent, not a transient blip. Readiness gate
+        (docs/WRITE_BEHIND.md failure modes)."""
+        with self._cv:
+            return (self._drain_failures >= self._FAILING_AFTER
+                    or self._log_poisoned)
+
+    def watermarks(self) -> Tuple[int, int]:
+        """(last appended seq, drained-and-committed seq)."""
+        with self._cv:
+            return self._last_seq, self._drained_seq
+
+    def _wait_drained(self, seq: int, timeout: Optional[float]) -> None:
+        """Wait out the drain — including its transient failures (it
+        retries with backoff; a one-off SQLITE_BUSY must not abort a
+        checkpoint or gossip round that would succeed 50ms later).
+        Raise only when the drain thread is actually DEAD with work
+        pending, or on timeout (carrying the last drain error as the
+        cause either way)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._drained_seq < seq:
+                if not self._thread.is_alive() and not self._stopping:
+                    raise RuntimeError(
+                        "write-behind drain thread died"
+                    ) from self._drain_err
+                remaining = (
+                    None if deadline is None else deadline - time.monotonic()
+                )
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"write-behind drain did not reach seq {seq} "
+                        f"(at {self._drained_seq})"
+                    ) from self._drain_err
+                self._cv.wait(min(remaining or 1.0, 1.0))
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every record appended so far is committed."""
+        metrics.inc("evolu_wb_flushes_total", scope="all")
+        with self._cv:
+            seq = self._last_seq
+        self._wait_drained(seq, timeout)
+
+    def flush_owner(self, owner: str, timeout: Optional[float] = None) -> None:
+        """Block until `owner`'s enqueued history is committed — the
+        per-owner drain watermark reads that need SQLite wait on."""
+        with self._cv:
+            seq = self._owner_seq.get(owner, 0)
+        if seq:
+            metrics.inc("evolu_wb_flushes_total", scope="owner")
+            self._wait_drained(seq, timeout)
+        with self._cv:
+            if self._drained_seq >= self._needs_flush.get(owner, 0):
+                self._needs_flush.pop(owner, None)
+
+    @contextmanager
+    def drain_barrier(self):
+        """Flush everything, then hold `db_lock` so the drain cannot
+        restart underneath the caller: the whole-store read consistency
+        point (snapshot capture, checkpoints, replication serves, the
+        direct per-request write path). Loops until the queue is
+        verified EMPTY while already holding the lock — a record ACKed
+        in the flush-to-lock window (the dispatcher winning `db_lock`
+        for a tree read first) must not ride through the barrier, or a
+        snapshot swap under it would later be overwritten by that
+        record's pre-swap tree (review finding). Once empty-under-lock,
+        SQLite alone is the truth, so the serve-time tree cache is
+        dropped — any concurrent serve then blocks at its base-tree
+        read until the barrier releases."""
+        while True:
+            self.flush()
+            self.db_lock.acquire()
+            with self._cv:
+                if not self._pending:
+                    self._trees.clear()
+                    break
+            self.db_lock.release()
+        try:
+            yield
+        finally:
+            self.db_lock.release()
+
+    # -- lifecycle --
+
+    def reset(self) -> None:
+        """Drop everything pending and truncate the log — the owner
+        reset/restore + transaction-rollback semantics for embedders
+        (the caller owns resetting whatever device/cache state rode on
+        these rows). Takes `db_lock` FIRST so an in-flight drain
+        transaction commits or finishes before the drop — without the
+        fence, rows being materialized at call time would commit
+        AFTER reset() returned, resurrecting state the caller believed
+        dropped (review finding)."""
+        with self.db_lock, self._cv:
+            dropped = self._pending_rows
+            self._pending.clear()
+            self._pending_rows = 0
+            self._drained_seq = self._last_seq
+            self._owner_seq.clear()
+            self._trees.clear()
+            self._needs_flush.clear()
+            self._log_truncate_locked()
+            metrics.set_gauge("evolu_wb_queue_rows", 0)
+            metrics.set_gauge("evolu_wb_queue_records", 0)
+            if dropped:
+                metrics.inc("evolu_wb_reset_dropped_rows_total", dropped)
+            self._cv.notify_all()
+
+    def close(self, flush: bool = True) -> None:
+        if flush:
+            try:
+                self.flush()
+            except Exception as e:  # noqa: BLE001 - still stop the thread
+                log("storage", "write-behind close flush failed", error=repr(e))
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        self._thread.join(timeout=30.0)
+        if self._log is not None:
+            self._log.close()
+            self._log = None
+
+    # -- drain (one background thread) --
+
+    def _drain_loop(self) -> None:
+        backoff = 0.05
+        while True:
+            with self._cv:
+                while not self._pending and not self._stopping:
+                    self._cv.wait()
+                if not self._pending:
+                    return  # stopping + drained
+                batch: List[_Pending] = []
+                rows = 0
+                for p in self._pending:
+                    if batch and rows + p.record.n_rows > self.drain_batch_rows:
+                        break
+                    batch.append(p)
+                    rows += p.record.n_rows
+            t0 = time.perf_counter()
+            dspan = trace.start_span(
+                "wb.drain", attrs={"records": len(batch), "rows": rows}
+            )
+            try:
+                with dspan, trace.use(dspan.context):
+                    with self.db_lock:
+                        tainted = self._materialize([p.record for p in batch])
+            except Exception as e:  # noqa: BLE001 - keep draining
+                metrics.inc("evolu_wb_drain_failures_total")
+                log("storage", "write-behind drain batch failed; retrying",
+                    error=repr(e), records=len(batch))
+                with self._cv:
+                    self._drain_err = e
+                    self._drain_failures += 1
+                    self._cv.notify_all()
+                if self._stopping:
+                    return
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 2.0)
+                continue
+            backoff = 0.05
+            now = time.monotonic()
+            with self._cv:
+                self._drain_err = None
+                self._drain_failures = 0
+                top = batch[-1].seq
+                for p in batch:
+                    # A concurrent reset() may have cleared the deque;
+                    # the rows are committed either way.
+                    if self._pending and self._pending[0] is p:
+                        self._pending.popleft()
+                        self._pending_rows -= p.record.n_rows
+                    metrics.observe("evolu_wb_apply_lag_ms",
+                                    (now - p.t_enqueue) * 1e3,
+                                    exemplar=dspan.trace_id)
+                self._drained_seq = max(self._drained_seq, top)
+                for o in tainted:
+                    # The serving path must re-read the corrected tree
+                    # before folding anything else on top of it.
+                    self._needs_flush[o] = self._owner_seq.get(o, top)
+                    self._trees.pop(o, None)
+                # Fully-drained owners fall back to SQLite truth.
+                for o in [o for o, s in self._owner_seq.items() if s <= top]:
+                    del self._owner_seq[o]
+                    self._trees.pop(o, None)
+                    if self._drained_seq >= self._needs_flush.get(o, 0):
+                        self._needs_flush.pop(o, None)
+                if not self._pending:
+                    self._log_truncate_locked()
+                metrics.set_gauge("evolu_wb_queue_rows", self._pending_rows)
+                metrics.set_gauge("evolu_wb_queue_records", len(self._pending))
+                self._cv.notify_all()
+            metrics.inc("evolu_wb_drained_rows_total", rows)
+            metrics.observe("evolu_wb_drain_batch_rows", rows,
+                            buckets=_ROW_BUCKETS, exemplar=dspan.trace_id)
+            metrics.observe("evolu_wb_drain_ms",
+                            (time.perf_counter() - t0) * 1e3,
+                            exemplar=dspan.trace_id)
+
+    # -- materialization --
+
+    def _insert_rows(self, db, gu, gc, ts_packed, content_packed, lens):
+        """INSERT OR IGNORE one record slice → per-row was-new flags.
+        Packed C call where available; generic per-row SQL otherwise
+        (replay must work on any backend the store opens with)."""
+        if hasattr(db, "relay_insert_packed"):
+            return db.relay_insert_packed(gu, gc, ts_packed, content_packed, lens)
+        flags = np.zeros(int(sum(gc)), bool)
+        offs = np.concatenate([[0], np.cumsum(lens)]).astype(np.int64)
+        row = 0
+        for u, k in zip(gu, gc):
+            for _ in range(k):
+                ts = ts_packed[row * 46 : (row + 1) * 46].decode("ascii")
+                content = content_packed[offs[row] : offs[row + 1]]
+                flags[row] = (
+                    db.run(
+                        'INSERT OR IGNORE INTO "message" '
+                        '("timestamp", "userId", "content") VALUES (?, ?, ?)',
+                        (ts, u, content),
+                    )
+                    == 1
+                )
+                row += 1
+        return flags
+
+    def _materialize(self, records: Sequence[IngestRecord],
+                     exact: bool = False) -> set:
+        """Commit `records` (already in seq order) into the store: one
+        transaction per touched shard, message inserts per record in
+        order, then the LAST tree per owner. Returns the set of owners
+        whose optimistic trees were corrected (always empty in `exact`
+        mode — there is no optimism to correct). Caller holds db_lock."""
+        from evolu_tpu.core.merkle import (
+            apply_prefix_xors,
+            merkle_tree_from_string,
+            merkle_tree_to_string,
+            minute_deltas_host,
+        )
+
+        stores, shard_index = self._shards()
+        # Split each record's owner groups by CURRENT shard topology
+        # (replay survives a shard-count change), preserving order.
+        per_shard: Dict[int, List[tuple]] = {}
+        for rec in records:
+            row = 0
+            offs = np.concatenate([[0], np.cumsum(rec.lens)]).astype(np.int64)
+            tree_of = dict(rec.tree_rows)
+            for u, k in zip(rec.gu, rec.gc):
+                si = shard_index(u)
+                lo, hi = row, row + k
+                per_shard.setdefault(si, []).append(
+                    (rec, u, k,
+                     rec.ts_packed[lo * 46 : hi * 46],
+                     rec.content_packed[offs[lo] : offs[hi]],
+                     rec.lens[lo:hi],
+                     tree_of.get(u))
+                )
+                row = hi
+        tainted: set = set()
+        if self._drain_delay_s:
+            time.sleep(self._drain_delay_s)  # torture-test kill window
+        with self._cv:
+            # Owners corrected by an earlier drain batch whose serving
+            # path has not yet re-read: their precomputed trees are
+            # stale up to the recorded seq bound.
+            carry_taint = dict(self._needs_flush)
+        for si, ops in per_shard.items():
+            db = stores[si].db
+            with db.transaction():
+                cur: Dict[str, str] = {}  # owner → tree string (in-txn truth)
+                for (rec, u, k, ts_b, content_b, lens, tree_s) in ops:
+                    flags = np.asarray(
+                        self._insert_rows(db, [u], [k], ts_b, content_b, lens)
+                    )
+                    clean = bool(flags.all())
+                    if (not exact and clean and u not in tainted
+                            and u not in carry_taint):
+                        if tree_s is not None:
+                            cur[u] = tree_s
+                        continue
+                    # Exact path: fold the NEW rows only onto the
+                    # current stored tree — the host oracle fold, the
+                    # same semantics a synchronous apply would have had.
+                    # Correction counters only for LIVE drains: replay
+                    # (`exact`) re-applies committed records whose rows
+                    # are legitimately not-new — counting those would
+                    # read as phantom duplicate-delivery after every
+                    # restart (evolu_wb_replayed_* covers replay).
+                    if not clean and not exact:
+                        tainted.add(u)
+                        metrics.inc("evolu_wb_corrected_records_total")
+                    base = cur.get(u)
+                    if base is None:
+                        base = stores[si].get_merkle_tree_string(u)
+                    new_ts = [
+                        ts_b[i * 46 : (i + 1) * 46].decode("ascii")
+                        for i in range(k)
+                        if bool(flags[i])
+                    ]
+                    if new_ts:
+                        deltas, _d = minute_deltas_host(new_ts)
+                        tree = apply_prefix_xors(
+                            merkle_tree_from_string(base), deltas
+                        )
+                        cur[u] = merkle_tree_to_string(tree)
+                    # No new rows → the tree is unchanged; writing the
+                    # read-back base would mint a merkleTree row (e.g.
+                    # "{}") the synchronous oracle never writes.
+                for u, s in cur.items():
+                    db.run(
+                        'INSERT OR REPLACE INTO "merkleTree" '
+                        '("userId", "merkleTree") VALUES (?, ?)',
+                        (u, s),
+                    )
+        if tainted:
+            metrics.inc("evolu_wb_corrected_owners_total", len(tainted))
+        return tainted
+
+    # -- observability --
+
+    def stats_payload(self) -> dict:
+        records, rows = self.backlog()
+        last, drained = self.watermarks()
+        return {
+            "backlog_records": records,
+            "backlog_rows": rows,
+            "last_seq": last,
+            "drained_seq": drained,
+            "saturated": rows >= self.max_rows,
+            "max_rows": self.max_rows,
+            "log_bytes": self._log_bytes,
+            "log_path": self.log_path,
+            "enqueued_rows": metrics.get_counter("evolu_wb_enqueued_rows_total"),
+            "drained_rows": metrics.get_counter("evolu_wb_drained_rows_total"),
+            "corrected_owners": metrics.get_counter(
+                "evolu_wb_corrected_owners_total"
+            ),
+            "replayed_records": metrics.get_counter(
+                "evolu_wb_replayed_records_total"
+            ),
+            "stalls": metrics.get_counter("evolu_wb_stalls_total"),
+            "flushes": (
+                metrics.get_counter("evolu_wb_flushes_total", scope="all")
+                + metrics.get_counter("evolu_wb_flushes_total", scope="owner")
+            ),
+            "drain_failures": metrics.get_counter(
+                "evolu_wb_drain_failures_total"
+            ),
+            "apply_lag_ms_p50": metrics.quantile("evolu_wb_apply_lag_ms", 0.50),
+            "apply_lag_ms_p99": metrics.quantile("evolu_wb_apply_lag_ms", 0.99),
+        }
+
+    def health_payload(self) -> dict:
+        records, rows = self.backlog()
+        last, drained = self.watermarks()
+        with self._cv:
+            failures = self._drain_failures
+            poisoned = self._log_poisoned
+        return {
+            "backlog_records": records,
+            "backlog_rows": rows,
+            "last_seq": last,
+            "drained_seq": drained,
+            "saturated": rows >= self.max_rows,
+            "drain_failures_consecutive": failures,
+            "log_poisoned": poisoned,
+            "failing": failures >= self._FAILING_AFTER or poisoned,
+        }

@@ -107,6 +107,21 @@ class Config:
     # root-replace patch (correct against any client state). None =
     # unbounded (the pre-r9 behavior).
     query_cache_max: "int | None" = 32768
+    # PR-11 storage inversion (storage/write_behind.py): serve sync
+    # responses and Merkle answers from device-derived in-memory state
+    # and demote SQLite to a bounded async write-behind materializer
+    # drained off the serving path. Opt-in (default OFF — every
+    # existing byte-identity pin stays on the synchronous path until
+    # the torture bar is green in a deployment); EVOLU_WRITE_BEHIND=1
+    # overrides at the relay. Durability floor: fsync'd record log +
+    # exact idempotent replay (docs/WRITE_BEHIND.md).
+    write_behind: bool = False
+    # Admission bound for the write-behind queue (rows). Queue-full
+    # stalls admission via the scheduler's 503 + Retry-After path —
+    # never drops. ~150 bytes/row in-memory for typical ciphertexts.
+    write_behind_max_rows: int = 1 << 20
+    # Drain transaction sizing (rows per btree commit).
+    write_behind_drain_rows: int = 1 << 16
     # After a swallowed offline sync failure, probe the relay's
     # GET /ping starting at this cadence in seconds (backing off 2x per
     # failure up to 30s); the first success fires the reconnect hook

@@ -1,0 +1,135 @@
+"""Subprocess worker for the write-behind SIGKILL torture episode
+(tests/test_model_check.py::test_write_behind_sigkill_torture).
+
+Mode `ingest`: opens a file-backed RelayStore + WriteBehindQueue
+(durable log) + BatchReconciler, generates `batches` seeded request
+batches (the SAME generator the parent's oracle twin uses), serves
+each through the write-behind path, and prints `ACK <i>` after the
+batch's response is produced (i.e. after the record log fsync — the
+durability promise under test). Every 4th batch it also writes a
+checkpoint behind the drain barrier, so a kill can land mid-checkpoint
+too. The drain is artificially slowed (`drain_delay`) to widen the
+mid-queue/mid-drain kill windows. The parent SIGKILLs this process at
+an arbitrary ACK count.
+
+Mode `finish`: reopens the store + queue (constructor replays the
+log through the always-exact path), flushes, and prints
+`DONE crc=<state crc>` — the parent compares it against synchronous
+oracle twins of the ACKed prefix (and prefix+1: a kill can land
+between the log fsync and the ACK print).
+
+    python tests/_write_behind_worker.py ingest <db_path> <seed> <batches> <drain_delay>
+    python tests/_write_behind_worker.py finish <db_path>
+"""
+
+import os
+import sys
+import zlib
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASE = 1700000000000
+
+
+def seeded_batches(seed: int, n_batches: int):
+    """Deterministic request batches — ONE implementation imported by
+    both this worker and the parent's oracle twin. Distinct owners per
+    batch (the scheduler contract), occasional duplicate redelivery of
+    an earlier batch's rows (the retry shape the drain must correct
+    exactly), all timestamps canonical. Clients send their IN-SYNC
+    post-push tree (the steady-state hot shape, computed through a
+    deterministic embedded oracle) so fresh pushes never force a
+    serve-side flush — the kill windows stay mid-queue/mid-drain."""
+    import random
+
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.server.relay import RelayStore
+    from evolu_tpu.sync import protocol
+
+    rng = random.Random(seed)
+    owners = [f"owner{i}" for i in range(5)]
+    nodes = {o: f"{i + 1:016x}" for i, o in enumerate(owners)}
+    history = {o: [] for o in owners}
+    tree_oracle = RelayStore()
+    batches = []
+    for b in range(n_batches):
+        reqs = []
+        for o in rng.sample(owners, rng.randrange(1, 4)):
+            msgs = []
+            if history[o] and rng.random() < 0.3:
+                # Redeliver a few already-sent rows (client retry).
+                msgs.extend(rng.sample(history[o], min(3, len(history[o]))))
+            for j in range(rng.randrange(1, 9)):
+                ts = timestamp_to_string(
+                    Timestamp(BASE + (b * 1000 + j) * 60000, rng.randrange(4),
+                              nodes[o])
+                )
+                m = protocol.EncryptedCrdtMessage(ts, b"ct-%d-%s" % (b, o.encode()))
+                msgs.append(m)
+                history[o].append(m)
+            tree = tree_oracle.add_messages(o, msgs)
+            from evolu_tpu.core.merkle import merkle_tree_to_string
+
+            reqs.append(protocol.SyncRequest(
+                tuple(msgs), o, nodes[o], merkle_tree_to_string(tree)
+            ))
+        batches.append(reqs)
+    tree_oracle.close()
+    return batches
+
+
+def state_crc(store) -> int:
+    crc = 0
+    for u in sorted(store.user_ids()):
+        crc = zlib.crc32(store.get_merkle_tree_string(u).encode(), crc)
+        for m in store.replica_messages(u, ""):
+            crc = zlib.crc32(m.timestamp.encode(), crc)
+            crc = zlib.crc32(m.content, crc)
+    return crc
+
+
+def main() -> None:
+    mode, db_path = sys.argv[1], sys.argv[2]
+
+    from evolu_tpu.server.engine import BatchReconciler
+    from evolu_tpu.server.relay import RelayStore
+    from evolu_tpu.storage.write_behind import WriteBehindQueue
+
+    if mode == "finish":
+        store = RelayStore(db_path)
+        wb = WriteBehindQueue(store, log_path=db_path + ".wblog")
+        wb.flush()
+        print(f"DONE crc={state_crc(store):08x}", flush=True)
+        wb.close()
+        store.close()
+        return
+
+    seed, n_batches, drain_delay = (
+        int(sys.argv[3]), int(sys.argv[4]), float(sys.argv[5])
+    )
+    from evolu_tpu.server import snapshot
+
+    store = RelayStore(db_path)
+    wb = WriteBehindQueue(
+        store, log_path=db_path + ".wblog", drain_batch_rows=8,
+        _drain_delay_s=drain_delay,
+    )
+    eng = BatchReconciler(store, write_behind=wb)
+    for i, reqs in enumerate(seeded_batches(seed, n_batches)):
+        eng.run_batch_wire(reqs)
+        print(f"ACK {i}", flush=True)
+        if i and i % 4 == 0:
+            snapshot.write_checkpoint(
+                store, db_path + ".ckpt", barrier=wb.drain_barrier
+            )
+            print(f"CKPT {i}", flush=True)
+    wb.flush()
+    print(f"DONE crc={state_crc(store):08x}", flush=True)
+    wb.close()
+    eng.close()
+    store.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -768,3 +768,147 @@ def _run_stale_query_episode():
     finally:
         w_gated.db.close()
         w_naive.db.close()
+
+
+# -- PR-11 torture: the write-behind queue's durability license --
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [3, 17, 71])
+def test_write_behind_sigkill_torture(tmp_path, seed):
+    """SIGKILL a write-behind relay worker at an arbitrary point
+    (mid-queue, mid-drain, mid-checkpoint — the drain is slowed and
+    checkpoints run behind the barrier every 4 batches), restart it,
+    and demand the drained SQLite end state be byte-identical (state
+    crc) to a synchronous-apply oracle twin of the ACKed prefix. The
+    ACK point is the record-log fsync: a kill can land between the
+    fsync and the ACK print, so prefix+1 is also an accepted oracle.
+    This is the license for promoting device state to truth
+    (ROADMAP #1): an ACKed write is never lost, and replay's
+    always-exact tree fold converges to the oracle regardless of
+    where the kill landed."""
+    with _evidence("write-behind-sigkill", seed):
+        _run_write_behind_torture(tmp_path, seed)
+
+
+def _run_write_behind_torture(tmp_path, seed):
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _write_behind_worker import seeded_batches, state_crc
+
+    from evolu_tpu.server.engine import BatchReconciler
+
+    rng = random.Random(seed)
+    n_batches = 12
+    db_path = str(tmp_path / "victim.db")
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_write_behind_worker.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, worker, "ingest", db_path, str(seed),
+         str(n_batches), "0.15"],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    kill_after = rng.randrange(1, n_batches - 1)
+    acked = -1
+    try:
+        for line in proc.stdout:
+            if line.startswith("ACK "):
+                acked = int(line.split()[1])
+                if acked >= kill_after:
+                    # Land the kill anywhere in the next batches'
+                    # serve/drain/checkpoint window.
+                    time.sleep(rng.random() * 0.3)
+                    proc.kill()  # SIGKILL — no teardown, no flush
+                    break
+            elif line.startswith("DONE"):
+                break
+        # The worker may have ACKed more batches into the pipe before
+        # dying than the loop above consumed — the TRUE acked count is
+        # the last ACK line anywhere in its output.
+        for line in proc.stdout:
+            if line.startswith("ACK "):
+                acked = int(line.split()[1])
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert acked >= 0, "worker never ACKed a batch"
+
+    # Restart: constructor replay + flush, then the state crc.
+    out = subprocess.run(
+        [sys.executable, worker, "finish", db_path],
+        capture_output=True, text=True, timeout=300, env=env, check=True,
+    )
+    done = [ln for ln in out.stdout.splitlines() if ln.startswith("DONE crc=")]
+    assert done, out.stdout
+    got_crc = done[-1].split("crc=")[1]
+
+    # Oracle twins: synchronous apply of the ACKed prefix — and of
+    # prefix+1 (a kill between the log fsync and the ACK print means
+    # one more batch is legitimately durable). The kill may also land
+    # mid-append of batch acked+1: its record was either fully fsynced
+    # (crc-framed) or its torn tail was discarded at replay, so the
+    # end state matches exactly one of the two twins. Batches are
+    # whole records here (single-shard store), never split.
+    batches = seeded_batches(seed, n_batches)
+    accepted = set()
+    for extra in (0, 1):
+        oracle = RelayStore()
+        eng = BatchReconciler(oracle)
+        for reqs in batches[: acked + 1 + extra]:
+            eng.run_batch_wire(reqs)
+        accepted.add(f"{state_crc(oracle):08x}")
+        eng.close()
+        oracle.close()
+    assert got_crc in accepted, (got_crc, accepted, acked)
+
+
+@pytest.mark.slow
+def test_write_behind_torture_winner_state_matches_sqlite(tmp_path):
+    """The client-side half of the PR-11 invariant bar: after an
+    update-heavy apply schedule (repeated cells — the shape the
+    adaptive gate keeps on the cached route; a create-heavy churn
+    workload legitimately streams with zero slots), the HBM winner
+    slots equal SQLite's MAX(timestamp) per cell — read back from the
+    device arrays via the worker's audit surface. A restart re-seeds
+    the (volatile) cache lazily; the invariant must hold again after
+    post-restart traffic."""
+    from evolu_tpu.runtime.client import Evolu
+
+    db_path = str(tmp_path / "client.db")
+    cfg = Config(backend="tpu", min_device_batch=1)  # every apply on the cache route
+    ev = Evolu(db_path=db_path, config=cfg)
+    ev.update_db_schema(SCHEMA)
+    try:
+        ids = [ev.create("todo", {"title": f"t{i}"}) for i in range(4)]
+        ev.worker.flush()
+        # Update-heavy on ONE hot row, one batch per mutation (flush
+        # each): repeated cells are the shape the adaptive gate keeps
+        # cached (tiny batches over alternating rows read as 100%
+        # churn and legitimately stream — the gate is tuned for the
+        # 1M-row receive shape, not 3-cell mutations).
+        hot = ids[0]
+        for i in range(20):
+            ev.update("todo", hot, {"title": f"edit{i}",
+                                    "isCompleted": bool(i % 2)})
+            ev.worker.flush()
+        checked = ev.worker.verify_winner_cache()
+        assert checked > 0, "the winner cache never engaged"
+        ev.dispose()
+
+        # Restart: HBM is volatile — the cache re-seeds from SQLite
+        # lazily; the audit must hold on the re-seeded slots too.
+        ev = Evolu(db_path=db_path, config=cfg)
+        ev.update_db_schema(SCHEMA)
+        for i in range(15):
+            ev.update("todo", hot, {"title": f"post{i}"})
+            ev.worker.flush()
+        assert ev.worker.verify_winner_cache() > 0
+    finally:
+        ev.dispose()

@@ -1,0 +1,604 @@
+"""PR-11 storage inversion: device-resident serving state + the
+bounded async write-behind materializer (storage/write_behind.py).
+
+The contract under test, end to end:
+- With write-behind ON, the engine's serving path touches no btree;
+  after a drain the SQLite end state is BYTE-IDENTICAL to a
+  synchronous-apply oracle twin, and responses for in-sync pushes and
+  cold syncs are byte-identical to the synchronous engine's.
+- Duplicate delivery (client retry) converges: the optimistic serve
+  tree is corrected EXACTLY at drain time; state identity holds and
+  the next round's responses re-align with the oracle.
+- An ACKed write is never lost: the fsync'd record log replays
+  idempotently after a crash (the SIGKILL torture episode lives in
+  tests/test_model_check.py; this file covers the in-process replay).
+- Backpressure stalls admission (WriteBehindFull → the scheduler's
+  503 + Retry-After), never drops.
+- /health exposes backlog + drain watermark (saturated = not ready);
+  /stats exposes the evolu_wb_* family.
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.server.engine import BatchReconciler
+from evolu_tpu.server.relay import RelayServer, RelayStore, ShardedRelayStore
+from evolu_tpu.storage.write_behind import (
+    IngestRecord,
+    WriteBehindFull,
+    WriteBehindQueue,
+)
+from evolu_tpu.sync import protocol
+
+BASE = 1700000000000
+
+
+def _msgs(node, start, n, payload=b"ct"):
+    return tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(Timestamp(BASE + (start + i) * 1000, 0, node)),
+            payload + b"-%d" % (start + i),
+        )
+        for i in range(n)
+    )
+
+
+def _synced_tree(req: protocol.SyncRequest) -> str:
+    """The post-push server tree for `req` — an in-sync client sends
+    this, so the response diff is empty and nothing on the serving
+    path needs SQLite (the steady-state hot shape)."""
+    s = RelayStore()
+    try:
+        return s.sync(req).merkle_tree
+    finally:
+        s.close()
+
+
+def _dump(store):
+    """Full store state (every shard's rows + trees) for byte-identity
+    asserts. Shard layout is topology, not state — flatten."""
+    shards = getattr(store, "shards", None) or [store]
+    rows, trees = [], []
+    for s in shards:
+        rows += [
+            (r["userId"], r["timestamp"], r["content"])
+            for r in s.db.exec_sql_query(
+                'SELECT "timestamp", "userId", "content" FROM "message"'
+            )
+        ]
+        trees += [
+            (r["userId"], r["merkleTree"])
+            for r in s.db.exec_sql_query(
+                'SELECT "userId", "merkleTree" FROM "merkleTree"'
+            )
+        ]
+    return sorted(rows), sorted(trees)
+
+
+@pytest.fixture
+def pair():
+    """(write-behind engine, synchronous oracle engine) over fresh
+    stores, torn down in order."""
+    store = ShardedRelayStore(shards=4)
+    wb = WriteBehindQueue(store)
+    eng = BatchReconciler(store, write_behind=wb)
+    oracle = ShardedRelayStore(shards=4)
+    oeng = BatchReconciler(oracle)
+    yield store, wb, eng, oracle, oeng
+    wb.close()
+    eng.close()
+    oeng.close()
+    store.close()
+    oracle.close()
+
+
+# -- record framing --
+
+
+def test_record_roundtrip_with_nul_and_unicode():
+    ts = _msgs("a" * 16, 0, 3)
+    ts_packed = "".join(m.timestamp for m in ts).encode("ascii")
+    contents = [b"\x00plain\x00", b"", b"\xff" * 9]
+    lens = np.array([len(c) for c in contents], np.int32)
+    rec = IngestRecord(
+        ["owner-é", "ow2"], [2, 1], ts_packed, b"".join(contents), lens,
+        [("owner-é", '{"t": 1}')],
+    )
+    back = IngestRecord.decode(rec.encode())
+    assert back.gu == rec.gu and back.gc == rec.gc
+    assert back.ts_packed == rec.ts_packed
+    assert back.content_packed == rec.content_packed
+    assert back.lens.tolist() == rec.lens.tolist()
+    assert back.tree_rows == rec.tree_rows
+
+
+def test_record_decode_rejects_corruption():
+    rec = IngestRecord(
+        ["u"], [1], b"x" * 46, b"abc", np.array([3], np.int32), []
+    )
+    body = rec.encode()
+    with pytest.raises(ValueError):
+        IngestRecord.decode(body[:-2])
+    with pytest.raises(ValueError):
+        IngestRecord.decode(body + b"zz")
+
+
+def test_torn_log_tail_is_discarded(tmp_path):
+    rec = IngestRecord(
+        ["u"], [1], _msgs("a" * 16, 0, 1)[0].timestamp.encode(), b"abc",
+        np.array([3], np.int32), [],
+    )
+    import struct
+    import zlib
+
+    body = rec.encode()
+    frame = struct.pack("<I", len(body)) + struct.pack(
+        "<I", zlib.crc32(body)
+    ) + body
+    from evolu_tpu.storage.write_behind import LOG_MAGIC
+
+    good = WriteBehindQueue._decode_log(LOG_MAGIC + frame + frame[: len(frame) // 2])
+    assert len(good) == 1  # intact first record; torn tail dropped
+    with pytest.raises(ValueError):
+        WriteBehindQueue._decode_log(b"not a log" + frame)
+
+
+# -- serve/drain byte-identity vs the synchronous oracle --
+
+
+def test_fresh_pushes_and_drained_state_byte_identical(pair):
+    store, wb, eng, oracle, oeng = pair
+    reqs = [
+        protocol.SyncRequest(_msgs("a" * 16, 0, 40), "userA", "a" * 16, "{}"),
+        protocol.SyncRequest(_msgs("b" * 16, 0, 23), "userB", "b" * 16, "{}"),
+        protocol.SyncRequest((), "userC", "c" * 16, "{}"),  # empty pull
+    ]
+    assert eng.run_batch_wire(reqs) == oeng.run_batch_wire(reqs)
+    wb.flush()
+    assert _dump(store) == _dump(oracle)
+
+
+def test_multi_batch_same_owner_sequential_trees(pair):
+    store, wb, eng, oracle, oeng = pair
+    for rnd in range(4):
+        reqs = [
+            protocol.SyncRequest(
+                _msgs("a" * 16, rnd * 50, 17), "userA", "a" * 16, "{}"
+            )
+        ]
+        assert eng.run_batch_wire(reqs) == oeng.run_batch_wire(reqs)
+    wb.flush()
+    assert _dump(store) == _dump(oracle)
+
+
+def test_cold_sync_waits_on_drain_watermark(pair):
+    store, wb, eng, oracle, oeng = pair
+    push = [protocol.SyncRequest(_msgs("a" * 16, 0, 30), "uA", "a" * 16, "{}")]
+    eng.run_batch_wire(push)
+    oeng.run_batch_wire(push)
+    # A second node's cold sync needs stored MESSAGES: the respond path
+    # must wait for the owner's drain watermark, then serve committed
+    # rows — byte-identical to the oracle.
+    pull = [protocol.SyncRequest((), "uA", "d" * 16, "{}")]
+    got = eng.run_batch_wire(pull)
+    want = oeng.run_batch_wire(pull)
+    assert got == want
+    assert len(got[0]) > 30 * 46  # the rows actually arrived
+
+
+def test_duplicate_delivery_corrected_exactly_at_drain(pair):
+    store, wb, eng, oracle, oeng = pair
+    from evolu_tpu.obs import metrics
+
+    before = metrics.get_counter("evolu_wb_corrected_owners_total")
+    reqs = [protocol.SyncRequest(_msgs("a" * 16, 0, 12), "uA", "a" * 16, "{}")]
+    eng.run_batch_wire(reqs)
+    oeng.run_batch_wire(reqs)
+    # Client retry: every row is already stored. The optimistic serve
+    # tree is transiently imprecise — the DRAIN must repair it exactly.
+    eng.run_batch_wire(reqs)
+    oeng.run_batch_wire(reqs)
+    wb.flush()
+    assert _dump(store) == _dump(oracle)
+    assert metrics.get_counter("evolu_wb_corrected_owners_total") > before
+    # Post-correction traffic re-aligns byte-identically.
+    pull = [protocol.SyncRequest((), "uA", "e" * 16, "{}")]
+    assert eng.run_batch_wire(pull) == oeng.run_batch_wire(pull)
+    fresh = [protocol.SyncRequest(_msgs("a" * 16, 100, 6), "uA", "a" * 16, "{}")]
+    assert eng.run_batch_wire(fresh) == oeng.run_batch_wire(fresh)
+    wb.flush()
+    assert _dump(store) == _dump(oracle)
+
+
+def test_duplicate_retry_response_tree_is_exact(pair):
+    """A duplicate-carrying push (lost-response client retry) must be
+    ANSWERED with the drain-corrected exact tree, not the optimistic
+    XOR-cancelled one — serving the cancelled tree would make the
+    client re-send the row every round, re-cancelling it each time: a
+    permanent retry livelock (review finding). With the exact re-read
+    the retry's response is byte-identical to the synchronous
+    oracle's."""
+    store, wb, eng, oracle, oeng = pair
+    reqs = [protocol.SyncRequest(_msgs("a" * 16, 0, 9), "uR", "a" * 16, "{}")]
+    eng.run_batch_wire(reqs)
+    oeng.run_batch_wire(reqs)
+    # The retry: every row already stored on both engines.
+    assert eng.run_batch_wire(reqs) == oeng.run_batch_wire(reqs)
+    wb.flush()
+    assert _dump(store) == _dump(oracle)
+
+
+def test_partial_overlap_batch_converges(pair):
+    store, wb, eng, oracle, oeng = pair
+    first = [protocol.SyncRequest(_msgs("a" * 16, 0, 10), "uA", "a" * 16, "{}")]
+    eng.run_batch_wire(first)
+    oeng.run_batch_wire(first)
+    # 5 duplicate rows + 5 new ones in one request.
+    overlap = [protocol.SyncRequest(_msgs("a" * 16, 5, 10), "uA", "a" * 16, "{}")]
+    eng.run_batch_wire(overlap)
+    oeng.run_batch_wire(overlap)
+    wb.flush()
+    assert _dump(store) == _dump(oracle)
+
+
+def test_non_canonical_case_owner_quarantine_state_identical(pair):
+    store, wb, eng, oracle, oeng = pair
+    # Canonical width, non-canonical HEX CASE: batchable; the engine
+    # quarantines the owner to the host fold. End state must match.
+    ts = timestamp_to_string(Timestamp(BASE, 0, "a" * 16)).replace("a", "A")
+    reqs = [
+        protocol.SyncRequest(
+            (protocol.EncryptedCrdtMessage(ts, b"weird"),) + _msgs("b" * 16, 0, 3),
+            "uQ", "b" * 16, "{}",
+        )
+    ]
+    assert eng.run_batch_wire(reqs) == oeng.run_batch_wire(reqs)
+    wb.flush()
+    assert _dump(store) == _dump(oracle)
+
+
+# -- crash replay --
+
+
+def test_crash_replay_recovers_acked_writes(tmp_path):
+    path = str(tmp_path / "relay.db")
+    store = RelayStore(path)
+    wb = WriteBehindQueue(store, log_path=path + ".wblog", _drain_delay_s=30.0)
+    eng = BatchReconciler(store, write_behind=wb)
+    reqs = [protocol.SyncRequest(_msgs("a" * 16, 0, 25), "uA", "a" * 16, "{}")]
+    reqs = [protocol.SyncRequest(reqs[0].messages, "uA", "a" * 16,
+                                 _synced_tree(reqs[0]))]
+    eng.run_batch_wire(reqs)  # ACKed into the log; drain is stalled
+    assert wb.backlog()[1] == 25
+    # "Crash": abandon the queue without flush/close.
+    store.close()
+    eng.close()
+
+    store2 = RelayStore(path)
+    wb2 = WriteBehindQueue(store2, log_path=path + ".wblog")  # replays
+    oracle = RelayStore()
+    oeng = BatchReconciler(oracle)
+    oeng.run_batch_wire(reqs)
+    assert _dump(store2) == _dump(oracle)
+    from evolu_tpu.obs import metrics
+
+    assert metrics.get_counter("evolu_wb_replayed_records_total") > 0
+    # Replay twice (crash before truncate): idempotent.
+    wb2.close()
+    store3 = RelayStore(path)
+    wb3 = WriteBehindQueue(store3, log_path=path + ".wblog")
+    assert _dump(store3) == _dump(oracle)
+    wb3.close()
+    for s in (store2, store3, oracle):
+        s.close()
+    oeng.close()
+
+
+def test_clean_shutdown_leaves_empty_log(tmp_path):
+    path = str(tmp_path / "relay.db")
+    store = RelayStore(path)
+    wb = WriteBehindQueue(store, log_path=path + ".wblog")
+    eng = BatchReconciler(store, write_behind=wb)
+    eng.run_batch_wire(
+        [protocol.SyncRequest(_msgs("a" * 16, 0, 9), "uA", "a" * 16, "{}")]
+    )
+    wb.close()
+    eng.close()
+    store.close()
+    from evolu_tpu.storage.write_behind import LOG_MAGIC
+
+    with open(path + ".wblog", "rb") as f:
+        assert f.read() == LOG_MAGIC  # fully drained + truncated
+
+
+# -- backpressure --
+
+
+def test_queue_full_raises_before_any_state_change(pair):
+    store, wb, eng, oracle, oeng = pair
+    wb.max_rows = 16
+    wb._drain_delay_s = 30.0
+    base = protocol.SyncRequest(_msgs("a" * 16, 0, 16), "uA", "a" * 16, "{}")
+    r1 = [protocol.SyncRequest(base.messages, "uA", "a" * 16, _synced_tree(base))]
+    eng.run_batch_wire(r1)
+    assert wb.backlog()[1] == 16
+    with pytest.raises(WriteBehindFull):
+        eng.run_batch_wire(
+            [protocol.SyncRequest(_msgs("a" * 16, 100, 8), "uA", "a" * 16, "{}")]
+        )
+    wb._drain_delay_s = 0.0
+    wb.flush(timeout=60)
+    # The rejected batch left nothing anywhere: state == oracle of r1.
+    oeng.run_batch_wire(r1)
+    assert _dump(store) == _dump(oracle)
+
+
+def test_scheduler_maps_backpressure_to_queue_full():
+    from evolu_tpu.server.scheduler import SchedulerQueueFull, SyncScheduler
+
+    store = RelayStore()
+    wb = WriteBehindQueue(store, max_rows=8, _drain_delay_s=30.0)
+    sched = SyncScheduler(store, write_behind=wb, max_wait_s=0.001)
+    try:
+        base = protocol.SyncRequest(_msgs("a" * 16, 0, 8), "uA", "a" * 16, "{}")
+        sched.submit(
+            protocol.SyncRequest(base.messages, "uA", "a" * 16,
+                                 _synced_tree(base))
+        )
+        with pytest.raises(SchedulerQueueFull):
+            sched.submit(
+                protocol.SyncRequest(_msgs("a" * 16, 50, 4), "uA", "a" * 16, "{}")
+            )
+    finally:
+        wb._drain_delay_s = 0.0
+        sched.stop()
+        wb.close()
+        store.close()
+
+
+# -- the direct (non-batchable) path barrier --
+
+
+def test_non_canonical_width_singleton_drains_first():
+    """A non-batchable request takes the direct per-request path, which
+    must run behind the drain barrier: by the time `sync_wire` touches
+    the store, every ACKed row is committed. (A malformed width then
+    errors identically to the reference path — on BOTH engines — with
+    the store state untouched by the failed transaction.)"""
+    from evolu_tpu.core.types import EvoluError
+    from evolu_tpu.server.scheduler import SyncScheduler
+
+    store = RelayStore()
+    wb = WriteBehindQueue(store, _drain_delay_s=0.2)
+    sched = SyncScheduler(store, write_behind=wb, max_wait_s=0.001)
+    oracle = RelayStore()
+    try:
+        base = protocol.SyncRequest(_msgs("a" * 16, 0, 10), "uA", "a" * 16, "{}")
+        push = protocol.SyncRequest(base.messages, "uA", "a" * 16,
+                                    _synced_tree(base))
+        sched.submit(push)
+        oracle.sync_wire(push)
+        weird = protocol.SyncRequest(
+            (protocol.EncryptedCrdtMessage("short-stamp", b"x"),),
+            "uA", "a" * 16, "{}",
+        )
+        with pytest.raises(EvoluError):
+            sched.submit(weird)
+        with pytest.raises(EvoluError):
+            oracle.sync_wire(weird)
+        # The barrier drained the ACKed push before the direct path ran.
+        assert wb.backlog() == (0, 0)
+        assert _dump(store) == _dump(oracle)
+    finally:
+        sched.stop()
+        wb.close()
+        store.close()
+        oracle.close()
+
+
+# -- relay surface: env gate, /health, /stats, checkpoint barrier --
+
+
+def test_relay_env_gate_and_observability(tmp_path, monkeypatch):
+    monkeypatch.setenv("EVOLU_WRITE_BEHIND", "1")
+    server = RelayServer(ShardedRelayStore(shards=2))
+    assert server.write_behind is not None  # env opt-in implies batching
+    assert server.scheduler is not None
+    server.start()
+    try:
+        req = protocol.SyncRequest(_msgs("a" * 16, 0, 12), "uZ", "a" * 16, "{}")
+        body = protocol.encode_sync_request(req)
+        out = urllib.request.urlopen(
+            urllib.request.Request(server.url + "/", data=body), timeout=30
+        ).read()
+        oracle = RelayStore()
+        assert out == oracle.sync_wire(req)
+        oracle.close()
+        h = json.loads(
+            urllib.request.urlopen(server.url + "/health", timeout=10).read()
+        )
+        assert h["write_behind"]["saturated"] is False
+        assert h["write_behind"]["last_seq"] >= h["write_behind"]["drained_seq"]
+        s = json.loads(
+            urllib.request.urlopen(server.url + "/stats", timeout=10).read()
+        )
+        assert s["write_behind"]["enqueued_rows"] >= 12
+    finally:
+        server.stop()
+
+
+def test_health_backlogged_answers_503(monkeypatch):
+    server = RelayServer(RelayStore(), write_behind=True)
+    server.write_behind.max_rows = 0  # force "saturated"
+    server.start()
+    try:
+        try:
+            urllib.request.urlopen(server.url + "/health", timeout=10)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            detail = json.loads(e.read())
+            assert detail["status"] == "backlogged"
+    finally:
+        server.write_behind.max_rows = 1 << 20
+        server.stop()
+
+
+def test_persistent_drain_failure_fails_health(monkeypatch):
+    """The drain retries forever (records must not be lost), so a
+    PERSISTENT failure must surface through readiness: /health answers
+    503 "drain-failing" even though the backlog sits below max_rows —
+    otherwise fleet failover keeps routing onto a relay whose
+    flush-needing serves all hang (review finding)."""
+    import time as _time
+
+    server = RelayServer(RelayStore(), write_behind=True)
+    wb = server.write_behind
+
+    def boom(records, exact=False):
+        raise RuntimeError("injected persistent drain failure")
+
+    monkeypatch.setattr(wb, "_materialize", boom)
+    server.start()
+    try:
+        req = protocol.SyncRequest(_msgs("a" * 16, 0, 6), "uF", "a" * 16, "{}")
+        base = protocol.SyncRequest(req.messages, "uF", "a" * 16,
+                                    _synced_tree(req))
+        body = protocol.encode_sync_request(base)
+        urllib.request.urlopen(
+            urllib.request.Request(server.url + "/", data=body), timeout=30
+        ).read()
+        deadline = _time.time() + 10
+        while _time.time() < deadline and not wb.failing():
+            _time.sleep(0.05)
+        assert wb.failing()
+        try:
+            urllib.request.urlopen(server.url + "/health", timeout=10)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["status"] == "drain-failing"
+    finally:
+        monkeypatch.undo()  # let close() drain the backlog for real
+        server.stop()
+
+
+def test_checkpoint_barrier_sees_drained_state(tmp_path):
+    from evolu_tpu.server import snapshot
+
+    path = str(tmp_path / "relay.db")
+    store = RelayStore(path)
+    wb = WriteBehindQueue(store, log_path=path + ".wblog")
+    eng = BatchReconciler(store, write_behind=wb)
+    reqs = [protocol.SyncRequest(_msgs("a" * 16, 0, 15), "uA", "a" * 16, "{}")]
+    eng.run_batch_wire(reqs)
+    ckpt = str(tmp_path / "relay.ckpt")
+    snapshot.write_checkpoint(store, ckpt, barrier=wb.drain_barrier)
+    # The checkpoint must contain the ACKed-but-async rows: restoring
+    # it into a fresh store yields the oracle state.
+    restored = RelayStore()
+    snapshot.restore_checkpoint(restored, ckpt)
+    oracle = RelayStore()
+    oeng = BatchReconciler(oracle)
+    oeng.run_batch_wire(reqs)
+    assert _dump(restored) == _dump(oracle)
+    wb.close()
+    eng.close()
+    oeng.close()
+    for s in (store, restored, oracle):
+        s.close()
+
+
+def test_replication_advertises_committed_state_only():
+    """A wb relay gossiping to a plain peer: the peer must converge to
+    the oracle state (summaries are drained-first, pulls serve
+    committed rows)."""
+    a = RelayServer(RelayStore(), write_behind=True, peers=[],
+                    replication_interval_s=3600).start()
+    b = RelayServer(RelayStore(), peers=[a.url],
+                    replication_interval_s=3600).start()
+    try:
+        req = protocol.SyncRequest(_msgs("a" * 16, 0, 20), "uA", "a" * 16, "{}")
+        body = protocol.encode_sync_request(req)
+        urllib.request.urlopen(
+            urllib.request.Request(a.url + "/", data=body), timeout=30
+        ).read()
+        b.replication.run_once()
+        oracle = RelayStore()
+        oracle.sync_wire(req)
+        assert _dump(b.store) == _dump(oracle)
+        oracle.close()
+    finally:
+        b.stop()
+        a.stop()
+
+
+# -- reset semantics --
+
+
+def test_reset_drops_pending_and_truncates(tmp_path):
+    path = str(tmp_path / "relay.db")
+    store = RelayStore(path)
+    wb = WriteBehindQueue(store, log_path=path + ".wblog", _drain_delay_s=30.0)
+    eng = BatchReconciler(store, write_behind=wb)
+    eng.run_batch_wire(
+        [protocol.SyncRequest(_msgs("a" * 16, 0, 10), "uA", "a" * 16, "{}")]
+    )
+    wb._drain_delay_s = 0.0
+    wb.reset()
+    assert wb.backlog() == (0, 0)
+    # flush() returns immediately; a fresh queue over the log replays
+    # nothing (truncated).
+    wb.flush(timeout=5)
+    wb.close()
+    wb2 = WriteBehindQueue(store, log_path=path + ".wblog")
+    from evolu_tpu.storage.write_behind import LOG_MAGIC
+
+    with open(path + ".wblog", "rb") as f:
+        assert f.read() == LOG_MAGIC
+    wb2.close()
+    eng.close()
+    store.close()
+
+
+# -- the PR-11 invariant audit (client side: cache is truth) --
+
+
+def test_winner_cache_verify_against_db():
+    from evolu_tpu.ops.winner_cache import DeviceWinnerCache
+    from evolu_tpu.core.types import CrdtMessage
+    from evolu_tpu.storage.apply import apply_messages
+    from evolu_tpu.storage.native import open_database
+
+    db = open_database(":memory:", "auto")
+    db.exec(
+        'CREATE TABLE IF NOT EXISTS "__message" ('
+        '"timestamp" TEXT, "table" TEXT, "row" TEXT, "column" TEXT, '
+        '"value" ANY, PRIMARY KEY ("timestamp", "table", "row", "column"))'
+    )
+    db.exec('CREATE TABLE IF NOT EXISTS "todo" ("id" TEXT PRIMARY KEY, "title" ANY)')
+    cache = DeviceWinnerCache(db, adaptive=False)
+    msgs = [
+        CrdtMessage(
+            timestamp_to_string(Timestamp(BASE + i * 1000, 0, "a" * 16)),
+            "todo", f"row{i % 7}", "title", f"v{i}",
+        )
+        for i in range(50)
+    ]
+    apply_messages(db, {}, msgs, planner=cache.plan_batch)
+    assert cache.verify_against_db() == 7  # 7 distinct cells, all exact
+    assert cache.verify_against_db(sample=3) == 3
+    # Poison one slot host-side: the audit must catch it.
+    import jax.numpy as jnp
+    import jax
+
+    with jax.enable_x64(True):
+        cache._w1 = cache._w1.at[0].set(jnp.uint64(12345))
+    with pytest.raises(AssertionError):
+        cache.verify_against_db()
+    db.close()
