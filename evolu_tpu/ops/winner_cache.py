@@ -119,6 +119,102 @@ def _grow_kernel(w, new_cap):
     return jax.lax.dynamic_update_slice(out, w, (0,))
 
 
+# -- PR-12 mesh-sharded slot arrays (MeshShardedWinnerCache below) --
+#
+# Compiled shard_map kernels for the owner-sharded HBM winner store,
+# cached per mesh (the mesh object is the jit-cache key, so every
+# consumer sharing a MeshContext shares ONE compiled pipeline per
+# bucket). Registered for the recompile fence like the engine's
+# _JIT_KERNELS (`mesh_jit_cache_size`).
+
+_MESH_JIT_KERNELS: List = []
+
+
+def mesh_jit_cache_size() -> int:
+    """Jit-cache entries across the sharded winner-cache kernels — the
+    recompile fence for the sharded pipeline (same `_cache_size`
+    degradation contract as `engine.merkle_jit_cache_size`)."""
+    return sum(getattr(k, "_cache_size", lambda: 0)() for k in _MESH_JIT_KERNELS)
+
+
+def _sharded_plan_body(w1, w2, slots, cell_id, k1, k2):
+    """Per-device gather/plan/scatter — `_cached_plan_kernel`'s body on
+    this device's (1, cap) slot rows and (S,) batch slice. Cells are
+    placed per shard (stable hash), so cell segments never span
+    devices; minute segments are per-shard partials the host decoder
+    XOR-merges exactly (the cross-device delta reduction)."""
+    w1r, w2r = w1[0], w2[0]
+    e1 = w1r[slots]
+    e2 = w2r[slots]
+    xor_s, upsert_s, i_s, s1, s2, (slots_s,), (win1, win2, seg_end, real) = (
+        plan_merge_sorted_core(
+            cell_id, k1, k2, e1, e2, extras=(slots,), return_winners=True
+        )
+    )
+    millis_s, counter_s = unpack_ts_keys(s1)
+    hashes = jnp.where(xor_s, timestamp_hashes(millis_s, counter_s, s2), jnp.uint32(0))
+    zero_owner = jnp.zeros((), jnp.int32)
+    _, minute_sorted, m_seg_end, seg_xor, valid_sorted = owner_minute_segments(
+        zero_owner, millis_s, hashes, xor_s
+    )
+    cap = jnp.int32(w1r.shape[0])
+    tgt = jnp.where(seg_end & real, slots_s, cap)
+    w1r = w1r.at[tgt].set(win1, mode="drop")
+    w2r = w2r.at[tgt].set(win2, mode="drop")
+    return (
+        w1r[None], w2r[None],
+        xor_s, upsert_s, i_s, minute_sorted, m_seg_end, seg_xor, valid_sorted,
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_plan_kernel(mesh):
+    from evolu_tpu.ops import shard_map
+    from evolu_tpu.parallel.mesh import OWNERS_AXIS
+    from jax.sharding import PartitionSpec as P
+
+    spec2, spec1 = P(OWNERS_AXIS, None), P(OWNERS_AXIS)
+    fn = jax.jit(
+        shard_map(
+            _sharded_plan_body,
+            mesh=mesh,
+            in_specs=(spec2, spec2, spec1, spec1, spec1, spec1),
+            out_specs=(spec2, spec2) + (spec1,) * 7,
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1),
+    )
+    _MESH_JIT_KERNELS.append(fn)
+    return fn
+
+
+def _sharded_seed_body(w1, w2, idx, v1, v2):
+    w1 = w1.at[0, idx[0]].set(v1[0], mode="drop")
+    w2 = w2.at[0, idx[0]].set(v2[0], mode="drop")
+    return w1, w2
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_seed_kernel(mesh):
+    from evolu_tpu.ops import shard_map
+    from evolu_tpu.parallel.mesh import OWNERS_AXIS
+    from jax.sharding import PartitionSpec as P
+
+    spec2 = P(OWNERS_AXIS, None)
+    fn = jax.jit(
+        shard_map(
+            _sharded_seed_body,
+            mesh=mesh,
+            in_specs=(spec2,) * 5,
+            out_specs=(spec2, spec2),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1),
+    )
+    _MESH_JIT_KERNELS.append(fn)
+    return fn
+
+
 class DeviceWinnerCache:
     """Keeps (k1, k2) winner keys per cell in device memory across
     batches. `plan_batch` matches the planner contract of
@@ -187,9 +283,27 @@ class DeviceWinnerCache:
         # cheap per-batch foreign-write probe. Same-connection writes
         # never move it, so steady-state batches pay one PRAGMA read.
         self._data_version = self._read_data_version()
+        self._alloc_slot_arrays()
+
+    # -- overridable array hooks (MeshShardedWinnerCache reshapes the
+    # slot store to per-device rows; all coherence/gating logic above
+    # these hooks is shared verbatim) --
+
+    def _alloc_slot_arrays(self) -> None:
         with jax.enable_x64(True):
-            self._w1 = jnp.zeros(capacity, jnp.uint64)
-            self._w2 = jnp.zeros(capacity, jnp.uint64)
+            self._w1 = jnp.zeros(self.capacity, jnp.uint64)
+            self._w2 = jnp.zeros(self.capacity, jnp.uint64)
+
+    def _clear_free_slots(self) -> None:
+        self._free.clear()
+        self._next_slot = 0
+
+    def _gather_slot_values(self, idx: np.ndarray):
+        """Device-side gather of the audited slots, pulled in ONE wave
+        (never a full-array pull — see verify_against_db)."""
+        with jax.enable_x64(True):
+            j_idx = jnp.asarray(idx)
+            return to_host_many(self._w1[j_idx], self._w2[j_idx])
 
     def _read_data_version(self):
         try:
@@ -203,9 +317,15 @@ class DeviceWinnerCache:
         version = self._read_data_version()
         if version != self._data_version:
             self._data_version = version
-            if self._slots or self._free:
+            if self._has_slot_state():
                 metrics.inc("evolu_winner_cache_foreign_write_drops_total")
                 self.reset()
+
+    def _has_slot_state(self) -> bool:
+        """Anything live OR freed in the slot store — the foreign-write
+        reset gate (a hook: the sharded subclass keeps its free lists
+        per shard, and the gate must see them identically)."""
+        return bool(self._slots or self._free)
 
     # -- slot management --
 
@@ -242,6 +362,13 @@ class DeviceWinnerCache:
             metrics.inc("evolu_winner_cache_noncanonical_seeds_total")
             return False
         metrics.inc("evolu_winner_cache_seeded_cells_total", n)
+        self._assign_and_write_seeds(new_cells, v1, v2)
+        return True
+
+    def _assign_and_write_seeds(self, new_cells, v1, v2) -> None:
+        """Slot assignment + the device seed write (the array-shape-
+        specific half of `_seed_new_cells`)."""
+        n = len(new_cells)
         reused = min(len(self._free), n)
         self._grow_to(self._next_slot + n - reused)
         idx = np.empty(n, np.int32)
@@ -258,7 +385,6 @@ class DeviceWinnerCache:
                 self._w1, self._w2, jnp.asarray(idx_p),
                 jnp.asarray(v1_p), jnp.asarray(v2_p),
             )
-        return True
 
     def _enforce_capacity(self, cells, new_cells):
         """The `max_slots` cap (VERDICT #3), applied between the gate
@@ -293,8 +419,7 @@ class DeviceWinnerCache:
     def reset(self) -> None:
         metrics.inc("evolu_winner_cache_resets_total")
         self._slots.clear()
-        self._free.clear()
-        self._next_slot = 0
+        self._clear_free_slots()
         # Streaming mode sources winners from SQLite and measures churn
         # against the carried-over _known — no 1.0-rate re-seed
         # artifact is possible there, and skipping a genuine churn
@@ -302,9 +427,7 @@ class DeviceWinnerCache:
         # never skip twice in a row: consecutive resets mean the resets
         # themselves are the workload (see __init__).
         self._skip_ewma_once = not self._streaming and not self._ewma_suppressed
-        with jax.enable_x64(True):
-            self._w1 = jnp.zeros(self.capacity, jnp.uint64)
-            self._w2 = jnp.zeros(self.capacity, jnp.uint64)
+        self._alloc_slot_arrays()
 
     def on_transaction_failed(self) -> None:
         """The plan-time scatter already advanced the cache; a rolled
@@ -602,9 +725,7 @@ class DeviceWinnerCache:
         # columns in one wave (CLAUDE.md: never per-array, and a full
         # 2^22-slot pull is the very 64 MiB `sample` exists to avoid).
         idx = np.fromiter((self._slots[c] for c in cells), np.int64, len(cells))
-        with jax.enable_x64(True):
-            j_idx = jnp.asarray(idx)
-            w1, w2 = to_host_many(self._w1[j_idx], self._w2[j_idx])
+        w1, w2 = self._gather_slot_values(idx)
         bad = []
         for j, c in enumerate(cells):
             if int(w1[j]) != int(v1[j]) or int(w2[j]) != int(v2[j]):
@@ -630,6 +751,223 @@ class DeviceWinnerCache:
         self.invalidate(cells)
         existing = fetch_existing_winners(self._db, cells)
         return _host_fallback(messages, existing, len(messages), with_deltas=True)
+
+
+class MeshShardedWinnerCache(DeviceWinnerCache):
+    """PR-12: the winner store SHARDED over the device mesh — slot
+    arrays of shape (n_devices, capacity) laid out with a
+    `NamedSharding` on the owners axis, cells placed on a STABLE shard
+    (crc32 of the cell triple — `parallel.mesh.owner_shard` over the
+    interned key, so a cell's slot lives on the same device forever),
+    and `plan_batch`/`plan_packed` running ONE shard_map'd
+    gather/plan/scatter pass: each device plans the cells it owns from
+    its OWN slot rows, and the per-shard (minute, xor) partials are
+    XOR-merged by the host decoder exactly (the cross-device reduction
+    of per-owner Merkle deltas — decoders merge repeated keys by
+    construction).
+
+    Coherence is the base contract, now PER SHARD: every live slot on
+    device d equals SQLite's MAX(timestamp) for its cell
+    (`verify_against_db` audits through the sharded gather; the
+    invalidation/reset/foreign-write hooks are inherited verbatim —
+    they operate above the array hooks). Encoded slot ids are
+    `local * n_shards + shard`, so growing the per-shard capacity
+    (doubling along axis 1) never rewrites an assigned id.
+    """
+
+    def __init__(
+        self,
+        db,
+        mesh_ctx=None,
+        capacity: int = 1 << 12,
+        adaptive: bool = True,
+        max_slots: "int | None" = 1 << 22,
+    ):
+        from evolu_tpu.parallel.mesh import MeshContext
+
+        self.ctx = mesh_ctx if mesh_ctx is not None else MeshContext()
+        self.n_shards = self.ctx.n_shards
+        self._free_by_shard: List[List[int]] = [[] for _ in range(self.n_shards)]
+        self._next_by_shard: List[int] = [0] * self.n_shards
+        super().__init__(db, capacity=capacity, adaptive=adaptive,
+                         max_slots=max_slots)
+
+    # -- placement --
+
+    def _cell_shard(self, cell: Cell) -> int:
+        from evolu_tpu.parallel.mesh import owner_shard
+
+        return owner_shard("\x00".join(cell), self.n_shards)
+
+    def shard_slot_counts(self) -> List[int]:
+        """Live slots per device (ops/stats surface; the per-shard
+        audit in tests groups its assertions by this placement)."""
+        counts = [0] * self.n_shards
+        for slot in self._slots.values():
+            counts[slot % self.n_shards] += 1
+        return counts
+
+    # -- array hooks --
+
+    def _sharding2(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from evolu_tpu.parallel.mesh import OWNERS_AXIS
+
+        return NamedSharding(self.ctx.mesh, P(OWNERS_AXIS, None))
+
+    def _sharding1(self):
+        from evolu_tpu.parallel.mesh import sharding
+
+        return sharding(self.ctx.mesh)
+
+    def _alloc_slot_arrays(self) -> None:
+        shd = self._sharding2()
+        with jax.enable_x64(True):
+            self._w1 = jax.device_put(
+                jnp.zeros((self.n_shards, self.capacity), jnp.uint64), shd
+            )
+            self._w2 = jax.device_put(
+                jnp.zeros((self.n_shards, self.capacity), jnp.uint64), shd
+            )
+
+    def _clear_free_slots(self) -> None:
+        self._free = []
+        self._next_slot = 0
+        self._free_by_shard = [[] for _ in range(self.n_shards)]
+        self._next_by_shard = [0] * self.n_shards
+
+    def _has_slot_state(self) -> bool:
+        return bool(self._slots) or any(self._free_by_shard)
+
+    def _grow_to(self, needed: int) -> None:
+        """Grow the PER-SHARD capacity (axis 1); eager lax is fine —
+        growth is doubling-rare and never on the steady-state path."""
+        new_cap = self.capacity
+        while new_cap < needed:
+            new_cap *= 2
+        if new_cap == self.capacity:
+            return
+        shd = self._sharding2()
+        with jax.enable_x64(True):
+            for name in ("_w1", "_w2"):
+                grown = jax.lax.dynamic_update_slice(
+                    jnp.zeros((self.n_shards, new_cap), jnp.uint64),
+                    getattr(self, name), (0, 0),
+                )
+                setattr(self, name, jax.device_put(grown, shd))
+        self.capacity = new_cap
+        metrics.inc("evolu_winner_cache_grows_total")
+        metrics.set_gauge("evolu_winner_cache_capacity_slots",
+                          self.n_shards * new_cap)
+
+    def _gather_slot_values(self, idx: np.ndarray):
+        shard = idx % self.n_shards
+        local = idx // self.n_shards
+        with jax.enable_x64(True):
+            return to_host_many(
+                self._w1[jnp.asarray(shard), jnp.asarray(local)],
+                self._w2[jnp.asarray(shard), jnp.asarray(local)],
+            )
+
+    def _assign_and_write_seeds(self, new_cells, v1, v2) -> None:
+        ns = self.n_shards
+        by_shard: List[List[int]] = [[] for _ in range(ns)]
+        for j, c in enumerate(new_cells):
+            by_shard[self._cell_shard(c)].append(j)
+        need = self.capacity
+        for si, js in enumerate(by_shard):
+            fresh = max(len(js) - len(self._free_by_shard[si]), 0)
+            need = max(need, self._next_by_shard[si] + fresh)
+        self._grow_to(need)
+        width = bucket_size(max(max(map(len, by_shard)), 1), multiple=16)
+        # Pad rows target the out-of-range local index (dropped).
+        idx = np.full((ns, width), self.capacity, np.int32)
+        v1_p = np.zeros((ns, width), np.uint64)
+        v2_p = np.zeros((ns, width), np.uint64)
+        for si, js in enumerate(by_shard):
+            for k, j in enumerate(js):
+                if self._free_by_shard[si]:
+                    local = self._free_by_shard[si].pop()
+                else:
+                    local = self._next_by_shard[si]
+                    self._next_by_shard[si] += 1
+                self._slots[new_cells[j]] = local * ns + si
+                idx[si, k] = local
+                v1_p[si, k] = v1[j]
+                v2_p[si, k] = v2[j]
+        shd = self._sharding2()
+        with jax.enable_x64(True):
+            self._w1, self._w2 = _sharded_seed_kernel(self.ctx.mesh)(
+                self._w1, self._w2,
+                jax.device_put(idx, shd),
+                jax.device_put(v1_p, shd),
+                jax.device_put(v2_p, shd),
+            )
+
+    def invalidate(self, cells) -> None:
+        dropped = 0
+        for c in cells:
+            slot = self._slots.pop(c, None)
+            if slot is not None:
+                self._free_by_shard[slot % self.n_shards].append(
+                    slot // self.n_shards
+                )
+                dropped += 1
+        metrics.inc("evolu_winner_cache_invalidated_cells_total", dropped)
+
+    # -- the sharded plan pass --
+
+    def _run_cached_plan(self, cell_ids, slots, millis, counter, node, n):
+        """ONE shard_map dispatch: route each row to the device owning
+        its cell's slot (stable placement ⇒ same-cell rows co-locate,
+        and within a shard the stable routing keeps them in batch
+        order — the planner's idx tiebreak contract), pad per-device
+        slices to a common power-of-two bucket, plan on-device, then
+        unpermute per shard block and map back through the routing.
+        Deltas XOR-merge across the per-shard partials in the decoder
+        (cross-device reduction). Masks return in batch order, length
+        n — identical results to the base single-device pass
+        (parity-pinned in tests/test_mesh_engine.py)."""
+        k1 = pack_ts_key_host(millis, counter)
+        ns = self.n_shards
+        shard = (slots % ns).astype(np.int64)
+        local = (slots // ns).astype(np.int32)
+        counts = np.bincount(shard, minlength=ns)
+        size = bucket_size(max(int(counts.max(initial=0)), 1))
+        total = ns * size
+        cell_p = np.full(total, int(_PAD_CELL), np.int32)
+        slots_p = np.zeros(total, np.int32)
+        k1_p = np.zeros(total, np.uint64)
+        k2_p = np.zeros(total, np.uint64)
+        order = np.argsort(shard, kind="stable")
+        offs = np.zeros(ns + 1, np.int64)
+        offs[1:] = np.cumsum(counts)
+        pos_in_shard = np.empty(n, np.int64)
+        pos_in_shard[order] = np.arange(n, dtype=np.int64) - offs[shard[order]]
+        dest = shard * size + pos_in_shard
+        cell_p[dest] = cell_ids
+        slots_p[dest] = local
+        k1_p[dest] = k1
+        k2_p[dest] = node
+        self.ctx.record_occupancy(counts.tolist(), size)
+        self.ctx.record_xdev_reduce("winner_minute_partials")
+        shd1 = self._sharding1()
+        self._w1, self._w2, *outs = _sharded_plan_kernel(self.ctx.mesh)(
+            self._w1, self._w2,
+            jax.device_put(slots_p, shd1), jax.device_put(cell_p, shd1),
+            jax.device_put(k1_p, shd1), jax.device_put(k2_p, shd1),
+        )
+        xor_s, upsert_s, i_s, minute_sorted, seg_end, seg_xor, valid = (
+            to_host_many(*outs)
+        )
+        xor_flat, upsert_flat = unpermute_masks(
+            xor_s, upsert_s, i_s, block_size=size
+        )
+        deltas = decode_owner_minute_deltas(
+            np.zeros(total, np.int32), minute_sorted, seg_end, seg_xor, valid
+        ).get(0, {})
+        return xor_flat[dest], upsert_flat[dest], deltas
 
 
 def _pad_seed(idx, k1, k2, capacity: int):

@@ -14,7 +14,13 @@ package maps that onto a TPU pod the jax way (SURVEY.md §2.15):
   exactly; `xor_allreduce`).
 """
 
-from evolu_tpu.parallel.mesh import create_mesh, assign_owners_to_shards
+from evolu_tpu.parallel.mesh import (
+    MeshContext,
+    assign_owners_to_shards,
+    create_mesh,
+    get_mesh_context,
+    owner_shard,
+)
 from evolu_tpu.parallel.reconcile import (
     reconcile_columns_sharded,
     reconcile_owner_batches,
@@ -22,7 +28,10 @@ from evolu_tpu.parallel.reconcile import (
 )
 
 __all__ = [
+    "MeshContext",
     "create_mesh",
+    "get_mesh_context",
+    "owner_shard",
     "assign_owners_to_shards",
     "reconcile_columns_sharded",
     "reconcile_owner_batches",

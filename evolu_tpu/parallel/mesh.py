@@ -63,6 +63,111 @@ def require_single_process(what: str) -> None:
         )
 
 
+def owner_shard(owner_id, n_shards: int) -> int:
+    """STABLE owner→device placement (crc32, the same family as
+    `ShardedRelayStore.shard_index` and `engine.owner_process`): an
+    owner's rows land on the same mesh device every batch, which is
+    what lets per-owner device-resident state (sharded winner-cache
+    slots, write-behind serving trees fed from sharded deltas) survive
+    across batches. Pure function of (owner, n_shards) — every
+    process/relay sharing a mesh computes the same placement."""
+    import zlib
+
+    if not isinstance(owner_id, (bytes, bytearray)):
+        owner_id = str(owner_id).encode("utf-8")
+    return zlib.crc32(owner_id) % n_shards
+
+
+class MeshContext:
+    """ONE device-mesh context shared by every sharded-engine consumer
+    in the process (engine passes, the sharded winner cache, scheduler
+    pools serving several relays): the mesh object is the jit-cache key
+    for every compiled shard_map kernel, so sharing the context means
+    one compiled pipeline per bucket for the whole process — not one
+    per relay — and `place`/`assign_stable` give all consumers the same
+    stable owner→device placement.
+
+    Per-batch LPT (``assign_owners_to_shards``) balances better but
+    re-places owners every batch; the sharded engine trades that for
+    placement stability and measures the cost honestly instead
+    (`evolu_mesh_shard_rows` occupancy and `evolu_mesh_padding_waste_rows`
+    histograms, docs/OBSERVABILITY.md)."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, n_devices: Optional[int] = None):
+        self.mesh = mesh if mesh is not None else create_mesh(n_devices)
+        self.n_shards = int(self.mesh.devices.size)
+        from evolu_tpu.obs import metrics
+
+        metrics.set_gauge("evolu_mesh_devices", self.n_shards)
+
+    def place(self, owner_id) -> int:
+        return owner_shard(owner_id, self.n_shards)
+
+    def assign_stable(self, unit_sizes: Dict[Hashable, int]) -> List[List[Hashable]]:
+        """Placement-stable layout with the `assign_owners_to_shards`
+        return shape. Units are owner ids or (owner, chunk-index)
+        tuples (the engine's hot-owner row-split): chunk j of owner o
+        lands on shard (place(o) + j) % n — chunk 0 always on the
+        owner's home shard, later chunks spilling round-robin so a hot
+        owner still uses the whole mesh (safe wherever the decoder
+        XOR-merges repeated (owner, minute) partials, which every
+        engine delta decoder does)."""
+        shards: List[List[Hashable]] = [[] for _ in range(self.n_shards)]
+        for u in unit_sizes:
+            if isinstance(u, tuple) and len(u) == 2:
+                owner, j = u
+            else:
+                owner, j = u, 0
+            shards[(self.place(owner) + int(j)) % self.n_shards].append(u)
+        return shards
+
+    def record_occupancy(self, loads: Sequence[int], shard_size: int) -> None:
+        """Per-device batch-occupancy / padding-waste telemetry for one
+        sharded dispatch (`evolu_mesh_*`, docs/OBSERVABILITY.md)."""
+        from evolu_tpu.obs import metrics
+
+        for load in loads:
+            metrics.observe("evolu_mesh_shard_rows", load,
+                            buckets=metrics.COUNT_BUCKETS)
+            metrics.observe("evolu_mesh_padding_waste_rows",
+                            max(shard_size - load, 0),
+                            buckets=metrics.COUNT_BUCKETS)
+        metrics.inc("evolu_mesh_dispatches_total")
+
+    def record_xdev_reduce(self, kind: str) -> None:
+        """Count one cross-device reduction (the digest XOR all-reduce
+        of a sharded dispatch, or a host XOR-merge of per-owner delta
+        partials that spanned devices)."""
+        from evolu_tpu.obs import metrics
+
+        metrics.inc("evolu_mesh_xdev_reduce_total", kind=kind)
+
+
+_process_ctx: Optional[MeshContext] = None
+
+
+def get_mesh_context(n_devices: Optional[int] = None) -> MeshContext:
+    """The process-wide MeshContext singleton (relay/scheduler wiring —
+    embedders and tests pass explicit contexts instead). Lazy: calling
+    this touches the jax backend, so it must only run on device-side
+    paths (the scheduler's first batch), never at relay import.
+
+    FIRST CREATION WINS: placement (`owner_shard` is mod n_shards) must
+    be one function per process — two contexts of different sizes would
+    place the same owner on different devices for different consumers.
+    A later call with a mismatched `n_devices` therefore returns the
+    existing context (logged), never a second pool."""
+    global _process_ctx
+    if _process_ctx is None:
+        _process_ctx = MeshContext(n_devices=n_devices)
+    elif n_devices is not None and _process_ctx.n_shards != n_devices:
+        from evolu_tpu.utils.log import log
+
+        log("server", "mesh context size mismatch ignored (first wins)",
+            have=_process_ctx.n_shards, requested=n_devices)
+    return _process_ctx
+
+
 def assign_owners_to_shards(
     owner_sizes: Dict[Hashable, int], n_shards: int
 ) -> List[List[Hashable]]:

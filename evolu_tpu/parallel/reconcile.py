@@ -275,6 +275,7 @@ def build_owner_columns(
     mesh: Mesh,
     owner_batches: Dict[str, Sequence[CrdtMessage]],
     existing_winners: Dict[str, Dict[Tuple[str, str, str], str]],
+    mesh_ctx=None,
 ):
     """Host-side layout: per-owner columnarization → shard assignment →
     flat padded global columns + bookkeeping to scatter results back.
@@ -304,8 +305,16 @@ def build_owner_columns(
         per_owner[o] = (cell_ids, k1, k2, ex_k1, ex_k2, millis, counter, node)
     owner_ix = {o: i for i, o in enumerate(owners)}
 
-    shards = assign_owners_to_shards({o: len(owner_batches[o]) for o in owners}, n_shards)
-    # Shard balance telemetry: the LPT assignment's per-shard row loads
+    sizes = {o: len(owner_batches[o]) for o in owners}
+    if mesh_ctx is not None:
+        # PR-12 sharded path: STABLE owner→device placement (an owner
+        # lands on the same device every batch — the precondition for
+        # device-resident per-owner state such as the mesh-sharded
+        # winner cache), occupancy/padding telemetry recorded.
+        shards = mesh_ctx.assign_stable(sizes)
+    else:
+        shards = assign_owners_to_shards(sizes, n_shards)
+    # Shard balance telemetry: the assignment's per-shard row loads
     # (host ints already in hand — arXiv:2004.00107's point that
     # anti-entropy behavior is only debuggable with per-round telemetry
     # applies doubly to a load imbalance that serializes the mesh).
@@ -314,6 +323,9 @@ def build_owner_columns(
         metrics.observe("evolu_reconcile_shard_rows", load,
                         buckets=metrics.COUNT_BUCKETS)
     shard_size = bucket_size(max(max(loads, default=0), 1))
+    if mesh_ctx is not None:
+        mesh_ctx.record_occupancy(loads, shard_size)
+        mesh_ctx.record_xdev_reduce("digest")
 
     # Timestamp columns are NOT laid out: the kernels recover
     # millis/counter/node from the sorted HLC keys, so transferring
@@ -347,6 +359,7 @@ def reconcile_owner_batches(
     mesh: Mesh,
     owner_batches: Dict[str, Sequence[CrdtMessage]],
     existing_winners: Dict[str, Dict[Tuple[str, str, str], str]],
+    mesh_ctx=None,
 ):
     """Full multi-owner reconcile: one device dispatch for all owners.
 
@@ -365,11 +378,16 @@ def reconcile_owner_batches(
                     buckets=metrics.COUNT_BUCKETS)
     with span("kernel:reconcile", "reconcile_owner_batches",
               owners=len(owner_batches), n=n_msgs):
-        return _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners)
+        return _reconcile_owner_batches_timed(
+            mesh, owner_batches, existing_winners, mesh_ctx
+        )
 
 
-def _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners):
-    cols, index, host_owners = build_owner_columns(mesh, owner_batches, existing_winners)
+def _reconcile_owner_batches_timed(mesh, owner_batches, existing_winners,
+                                   mesh_ctx=None):
+    cols, index, host_owners = build_owner_columns(
+        mesh, owner_batches, existing_winners, mesh_ctx=mesh_ctx
+    )
     results = {}
     digest = 0
     if index:

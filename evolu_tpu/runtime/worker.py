@@ -84,9 +84,22 @@ def select_planner(config: Config, db: Optional[PySqliteDatabase] = None) -> Cal
     hot_min = config.hot_owner_min_batch
     cache = None
     if db is not None and config.winner_cache:
-        from evolu_tpu.ops.winner_cache import DeviceWinnerCache
+        if config.mesh_engine and _multi_device():
+            # PR-12: slot arrays sharded over the device mesh (stable
+            # cell→device placement; one shard_map'd gather/plan/
+            # scatter pass per batch). Same planner contract and
+            # coherence hooks; plans are identical to the single-device
+            # cache (parity-pinned in tests/test_mesh_engine.py).
+            from evolu_tpu.ops.winner_cache import MeshShardedWinnerCache
+            from evolu_tpu.parallel.mesh import get_mesh_context
 
-        cache = DeviceWinnerCache(db)
+            cache = MeshShardedWinnerCache(
+                db, mesh_ctx=get_mesh_context(config.mesh_devices)
+            )
+        else:
+            from evolu_tpu.ops.winner_cache import DeviceWinnerCache
+
+            cache = DeviceWinnerCache(db)
 
     def planner(batch, existing):
         hot_route = (

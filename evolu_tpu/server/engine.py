@@ -191,7 +191,7 @@ def _compiled_merkle_kernel_compact_delta(mesh: Mesh, cap: int):
 
 @with_x64
 def owner_minute_deltas(
-    mesh: Mesh, owner_rows: Dict[str, Sequence[str]]
+    mesh: Mesh, owner_rows: Dict[str, Sequence[str]], ctx=None
 ) -> Tuple[Dict[str, Dict[str, int]], int]:
     """Device pass: {owner: [timestamp strings]} → per-owner
     {minute-key: xor delta} plus the global batch digest.
@@ -205,10 +205,10 @@ def owner_minute_deltas(
     with span("kernel:merkle", "owner_minute_deltas",
               owners=len(owner_rows),
               n=sum(len(v) for v in owner_rows.values())):
-        return _owner_minute_deltas_timed(mesh, owner_rows)
+        return _owner_minute_deltas_timed(mesh, owner_rows, ctx)
 
 
-def _owner_minute_deltas_timed(mesh, owner_rows):
+def _owner_minute_deltas_timed(mesh, owner_rows, ctx=None):
     owners = list(owner_rows)
     # ONE vectorized parse for every owner's timestamps (per-owner calls
     # would pay the numpy setup ~owners times); the per-row case flags
@@ -222,7 +222,7 @@ def _owner_minute_deltas_timed(mesh, owner_rows):
         owner_index[o] = np.arange(pos, pos + k)
         pos += k
     return deltas_from_columns(
-        mesh, owner_index, all_m, all_c, all_n, case_ok, flat
+        mesh, owner_index, all_m, all_c, all_n, case_ok, flat, ctx=ctx
     )
 
 
@@ -235,6 +235,7 @@ def deltas_from_columns(
     all_n: np.ndarray,
     case_ok: np.ndarray,
     ts_strings: Sequence[str],
+    ctx=None,
 ) -> Tuple[Dict[str, Dict[str, int]], int]:
     """Device Merkle pass over already-parsed columns: `owner_index`
     maps owner → row indices to hash (callers pre-filter to the rows
@@ -242,7 +243,9 @@ def deltas_from_columns(
     are quarantined to the shared host fold (`ts_strings` provides the
     raw strings for it); everyone else rides one sharded dispatch."""
     return deltas_finish(
-        deltas_dispatch(mesh, owner_index, all_m, all_c, all_n, case_ok, ts_strings)
+        deltas_dispatch(
+            mesh, owner_index, all_m, all_c, all_n, case_ok, ts_strings, ctx=ctx
+        )
     )
 
 
@@ -255,12 +258,22 @@ def deltas_dispatch(
     all_n: np.ndarray,
     case_ok: np.ndarray,
     ts_strings: Sequence[str],
+    ctx=None,
 ):
     """First half of `deltas_from_columns` — host packing, device
     dispatch, async transfer START. Returns an opaque state for
     `deltas_finish`. Between the two calls the device computes and the
     tunnel streams outputs back, so a pipelining caller can run batch
-    k's SQLite work while batch k+1 is in flight here."""
+    k's SQLite work while batch k+1 is in flight here.
+
+    With a `ctx` (parallel.mesh.MeshContext — the PR-12 sharded-engine
+    path), the layout uses STABLE owner→device placement
+    (`ctx.assign_stable`) instead of per-batch LPT, and records the
+    per-device occupancy / padding-waste / cross-device-reduce
+    telemetry. The kernels, decode, and outputs are IDENTICAL — only
+    row layout changes, and the delta decoders are layout-agnostic, so
+    the sharded path is byte-identical by construction (parity-pinned
+    in tests/test_mesh_engine.py anyway)."""
     require_single_process("engine.deltas_from_columns")
     owners = list(owner_index)
     deltas: Dict[str, Dict[str, int]] = {o: {} for o in owners}
@@ -298,11 +311,28 @@ def deltas_dispatch(
         else:
             for j, start in enumerate(range(0, len(ix), target)):
                 units[(o, j)] = ix[start : start + target]
-    shards = assign_owners_to_shards({u: len(ix) for u, ix in units.items()},
-                                     mesh.devices.size)
-    shard_len = max((sum(len(units[u]) for u in s) for s in shards), default=0)
-    shard_size = bucket_size(max(shard_len, 1))
+    unit_sizes = {u: len(ix) for u, ix in units.items()}
+    if ctx is not None:
+        shards = ctx.assign_stable(unit_sizes)
+    else:
+        shards = assign_owners_to_shards(unit_sizes, mesh.devices.size)
+    loads = [sum(len(units[u]) for u in s) for s in shards]
+    shard_size = bucket_size(max(max(loads, default=0), 1))
     total = mesh.devices.size * shard_size
+    if ctx is not None:
+        ctx.record_occupancy(loads, shard_size)
+        # The in-kernel XOR all-reduce of the batch digest is one
+        # cross-device reduction per dispatch; owners whose row-split
+        # chunks landed on several devices additionally XOR-merge
+        # their (owner, minute) partials in the host decode.
+        ctx.record_xdev_reduce("digest")
+        shard_of = {u: si for si, s in enumerate(shards) for u in s}
+        split_owners = {}
+        for (o, _j), si in shard_of.items():
+            split_owners.setdefault(o, set()).add(si)
+        for o, devs in split_owners.items():
+            if len(devs) > 1:
+                ctx.record_xdev_reduce("owner_delta_partials")
 
     # Transfer-lean upload: 20 bytes/row — packed HLC key (millis<<16 |
     # counter), node, and int32 owner with -1 marking padding. The
@@ -489,8 +519,19 @@ class BatchReconciler:
     entry points (`reconcile*`) stay synchronous — deferral is a
     property of the live serving path only."""
 
-    def __init__(self, store, mesh: Optional[Mesh] = None, write_behind=None):
+    def __init__(
+        self, store, mesh: Optional[Mesh] = None, write_behind=None, mesh_ctx=None
+    ):
         self.store = store
+        # PR-12 sharded-engine path: a parallel.mesh.MeshContext pins
+        # the mesh AND switches every device layout this reconciler
+        # builds to stable owner→device placement (deltas_dispatch's
+        # `ctx=` leg). None = the per-batch LPT layout (the default
+        # until the parity gate is green in a deployment —
+        # Config.mesh_engine).
+        self.mesh_ctx = mesh_ctx
+        if mesh_ctx is not None and mesh is None:
+            mesh = mesh_ctx.mesh
         self.mesh = mesh or create_mesh()
         self.write_behind = write_behind
         self._executor = None
@@ -711,7 +752,7 @@ class BatchReconciler:
             )
             deltas_by_owner, _digest = deltas_from_columns(
                 self.mesh, merged, all_m, all_c, all_n, case_ok,
-                _PackedRows(buffers, offsets),
+                _PackedRows(buffers, offsets), ctx=self.mesh_ctx,
             )
             tree_rows: List[List[Tuple[str, str]]] = [[] for _ in stores]
             for o, deltas in deltas_by_owner.items():
@@ -822,7 +863,8 @@ class BatchReconciler:
                 (p[0] if len(p) == 1 else np.concatenate(p)) for p in col_parts
             )
             dev_state = deltas_dispatch(
-                self.mesh, merged, all_m, all_c, all_n, case_ok, packed
+                self.mesh, merged, all_m, all_c, all_n, case_ok, packed,
+                ctx=self.mesh_ctx,
             )
             if dev_state[3] is not None:
                 # Start the blocking pull NOW on the pull thread: under
@@ -972,7 +1014,11 @@ class BatchReconciler:
 
         # Device: per-(owner, minute) XOR deltas for all new timestamps.
         deltas_by_owner, _digest = (
-            owner_minute_deltas(self.mesh, {o: [m.timestamp for m in ms] for o, ms in new_by_owner.items()})
+            owner_minute_deltas(
+                self.mesh,
+                {o: [m.timestamp for m in ms] for o, ms in new_by_owner.items()},
+                ctx=self.mesh_ctx,
+            )
             if new_by_owner
             else ({}, 0)
         )

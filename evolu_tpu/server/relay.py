@@ -364,8 +364,37 @@ class ShardedRelayStore:
             s.close()
 
 
+def mesh_stats_payload() -> dict:
+    """The `mesh` section of GET /stats — the `evolu_mesh_*` family
+    read back from the metrics registry (docs/OBSERVABILITY.md): device
+    count, sharded dispatches, cross-device reduce counts by kind, and
+    the occupancy/padding-waste distribution the stable placement
+    trades LPT balance for. Pure registry reads — never imports jax."""
+    occ = metrics.registry.get_histogram("evolu_mesh_shard_rows")
+    waste = metrics.registry.get_histogram("evolu_mesh_padding_waste_rows")
+    return {
+        "devices": metrics.get_gauge("evolu_mesh_devices"),
+        "dispatches_total": metrics.get_counter("evolu_mesh_dispatches_total"),
+        "xdev_reduce_total": {
+            kind: metrics.get_counter("evolu_mesh_xdev_reduce_total", kind=kind)
+            for kind in ("digest", "owner_delta_partials",
+                         "winner_minute_partials")
+        },
+        "shard_rows": {
+            "count": (occ or (None, None, 0.0, 0))[3],
+            "p50": metrics.quantile("evolu_mesh_shard_rows", 0.50),
+            "p99": metrics.quantile("evolu_mesh_shard_rows", 0.99),
+        },
+        "padding_waste_rows": {
+            "count": (waste or (None, None, 0.0, 0))[3],
+            "p50": metrics.quantile("evolu_mesh_padding_waste_rows", 0.50),
+            "p99": metrics.quantile("evolu_mesh_padding_waste_rows", 0.99),
+        },
+    }
+
+
 def relay_stats_payload(store, replication=None, fleet=None,
-                        write_behind=None) -> dict:
+                        write_behind=None, mesh_engine: bool = False) -> dict:
     """The GET /stats JSON: store-derived row counts per shard (shared
     truth in a MultiprocessRelay — every worker reads the same files)
     plus this process's request counters from the metrics registry
@@ -399,6 +428,8 @@ def relay_stats_payload(store, replication=None, fleet=None,
         payload["fleet"] = fleet.stats_payload()
     if write_behind is not None:
         payload["write_behind"] = write_behind.stats_payload()
+    if mesh_engine:
+        payload["mesh"] = mesh_stats_payload()
     return payload
 
 
@@ -408,6 +439,7 @@ class _Handler(BaseHTTPRequestHandler):
     replication = None  # ReplicationManager when the relay has peers
     fleet = None  # FleetManager when the relay is an owner-sharded fleet member
     write_behind = None  # WriteBehindQueue when the PR-11 inversion is on
+    mesh_engine = False  # PR-12 sharded engine: adds the /stats mesh section
     # Capabilities this relay echoes back (intersected with the
     # request's advertised set — sync/protocol.py capability
     # extension). A request with no capabilities gets the v1 wire,
@@ -594,7 +626,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # must surface as an HTTP 500, not a dropped connection.
                 body = json.dumps(
                     relay_stats_payload(self.store, self.replication,
-                                        self.fleet, self.write_behind)
+                                        self.fleet, self.write_behind,
+                                        mesh_engine=self.mesh_engine)
                 ).encode("utf-8")
             except Exception as e:  # noqa: BLE001
                 metrics.inc("evolu_relay_errors_total")
@@ -1075,7 +1108,9 @@ class RelayServer:
                  checkpoint_path: Optional[str] = None,
                  capabilities: Optional[Sequence[str]] = None,
                  write_behind: Optional[bool] = None,
-                 write_behind_log: Optional[str] = None):
+                 write_behind_log: Optional[str] = None,
+                 mesh_engine: Optional[bool] = None,
+                 mesh_ctx=None):
         self.store = store or RelayStore()
         # capabilities=() emulates a v1 peer (never echoes the
         # extension — tests pin the byte-identical fallback with it).
@@ -1118,12 +1153,29 @@ class RelayServer:
                 drain_batch_rows=default_config.write_behind_drain_rows,
             )
             batching = True
+        # PR-12 mesh-sharded engine (docs/MESH.md): opt-in via
+        # constructor arg, EVOLU_MESH_ENGINE, or Config.mesh_engine —
+        # default OFF until the parity gate is green in a deployment.
+        # It is a property of the ENGINE pass, so enabling it implies
+        # batching; the mesh context itself is resolved lazily on the
+        # scheduler's dispatcher thread (importing jax here would break
+        # the no-backend-at-import contract).
+        if mesh_engine is None and mesh_ctx is None:
+            env = os.environ.get("EVOLU_MESH_ENGINE", "")
+            if env:
+                mesh_engine = env.lower() not in ("0", "false", "no", "off")
+            else:
+                mesh_engine = default_config.mesh_engine
+        self.mesh_engine = bool(mesh_engine) or mesh_ctx is not None
+        if self.mesh_engine:
+            batching = True
         self.scheduler = scheduler
         if batching and scheduler is None:
             from evolu_tpu.server.scheduler import SyncScheduler
 
             self.scheduler = SyncScheduler(
-                self.store, write_behind=self.write_behind
+                self.store, write_behind=self.write_behind,
+                mesh_ctx=mesh_ctx, mesh_engine=self.mesh_engine,
             )
         self.replication = replication
         if peers is not None and replication is None:
@@ -1162,7 +1214,8 @@ class RelayServer:
             {"store": self.store, "scheduler": self.scheduler,
              "replication": self.replication,
              "capabilities": self.capabilities,
-             "write_behind": self.write_behind},
+             "write_behind": self.write_behind,
+             "mesh_engine": self.mesh_engine},
         )
         self._httpd = _RelayHTTPServer((host, port), self._handler_cls)
         self._thread: Optional[threading.Thread] = None

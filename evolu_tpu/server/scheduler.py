@@ -149,8 +149,21 @@ class SyncScheduler:
         retry_after_s: float = 1.0,
         submit_timeout_s: float = 120.0,
         write_behind=None,
+        mesh_ctx=None,
+        mesh_engine: bool = False,
     ):
         self.store = store
+        # PR-12 sharded-engine wiring: an explicit
+        # parallel.mesh.MeshContext (embedders/tests), or
+        # mesh_engine=True to resolve the process-wide context lazily
+        # on the dispatcher thread (get_mesh_context imports jax — it
+        # must never run at relay import time). Several relays handing
+        # traffic to one scheduler — or several schedulers sharing one
+        # context — share ONE device pool: the mesh object keys every
+        # compiled shard_map kernel, and placement is stable
+        # process-wide.
+        self._mesh_ctx = mesh_ctx
+        self._mesh_engine = mesh_engine or (mesh_ctx is not None)
         # PR-11: a storage.write_behind.WriteBehindQueue makes the
         # engine serve from device-derived in-memory state and defer
         # SQLite to the queue's drain thread. The scheduler's jobs:
@@ -406,8 +419,16 @@ class SyncScheduler:
             try:
                 from evolu_tpu.server.engine import BatchReconciler
 
+                if self._mesh_engine and self._mesh_ctx is None:
+                    from evolu_tpu.parallel.mesh import get_mesh_context
+                    from evolu_tpu.utils.config import default_config
+
+                    self._mesh_ctx = get_mesh_context(
+                        default_config.mesh_devices
+                    )
                 self._engine = BatchReconciler(
-                    self.store, self._mesh, write_behind=self._write_behind
+                    self.store, self._mesh, write_behind=self._write_behind,
+                    mesh_ctx=self._mesh_ctx,
                 )
             except Exception as e:  # noqa: BLE001
                 self._engine_broken = e

@@ -122,6 +122,22 @@ class Config:
     write_behind_max_rows: int = 1 << 20
     # Drain transaction sizing (rows per btree commit).
     write_behind_drain_rows: int = 1 << 16
+    # PR-12 mesh-sharded engine (parallel/mesh.py::MeshContext): one
+    # pjit/shard_map pass reconciles every owner across the device mesh
+    # with STABLE owner->device placement (crc32, like the fleet ring)
+    # instead of per-batch LPT, so device-resident per-owner state
+    # (sharded winner-cache slot arrays, write-behind serving trees fed
+    # from sharded deltas) stays placement-consistent across batches.
+    # Default OFF until the parity gate (benchmarks/mesh_engine.py,
+    # tests/test_mesh_engine.py: responses + SQLite end state
+    # byte-identical to the single-device engine) is green in a
+    # deployment; EVOLU_MESH_ENGINE=1 overrides at the relay.
+    mesh_engine: bool = False
+    # Cap the mesh at this many devices (None = all visible). The
+    # placement hash is computed over the CAPPED size, so changing it
+    # re-places owners (fine: the engine holds no per-owner device
+    # state that outlives a batch without the cache-reset hooks).
+    mesh_devices: "int | None" = None
     # After a swallowed offline sync failure, probe the relay's
     # GET /ping starting at this cadence in seconds (backing off 2x per
     # failure up to 30s); the first success fires the reconnect hook

@@ -36,3 +36,14 @@ def pytest_configure(config):
     for var in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
         env.pop(var, None)
     os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+
+def relay_store_dump(store):
+    """Byte-identity parity dump of a relay store (message + merkleTree
+    rows per shard) — ONE copy shared by every end-state parity gate
+    (test_mesh_engine, test_model_check's oracle-twin episodes)."""
+    return [
+        (s.db.exec('SELECT * FROM "message" ORDER BY "timestamp", "userId"'),
+         s.db.exec('SELECT * FROM "merkleTree" ORDER BY "userId"'))
+        for s in store.shards
+    ]

@@ -491,3 +491,35 @@ def test_v2_only_after_negotiation_then_fleet_failover_downgrades():
             a.stop()
         except Exception:
             pass
+
+
+@pytest.mark.xfail(
+    reason="known PRE-EXISTING fused/pure structural divergence on one "
+    "malformed wire shape (found by the mutation fuzz at ~1/40 process "
+    "salts — the seeded rng mutates salt-dependent bytes, so the 120-trial "
+    "fuzz above flakes at that rate on ANY commit): the C response walker "
+    "parses this mutated wire into 2 messages + an empty tree while the "
+    "pure decoder's field walk reads a different structure and raises "
+    "UnicodeDecodeError. Malformed-input-only (well-formed traffic is "
+    "bit-parity-pinned); fixing means auditing the C protobuf walk vs the "
+    "pure decoder on corrupt length prefixes. Fixture pinned so the fix "
+    "session has a deterministic repro instead of a flaky fuzz.",
+    strict=False,
+)
+def test_known_divergent_malformed_wire_fixture():
+    if not native_crypto.native_available():
+        pytest.skip("libevolu_crypto unavailable")
+    import pathlib
+
+    data = (pathlib.Path(__file__).parent
+            / "fixtures" / "fuzz_divergent_response.bin").read_bytes()
+    try:
+        fused = native_crypto.decrypt_response(data, MN)
+    except (PgpError, ValueError) as e:
+        fused = type(e)
+    try:
+        resp = protocol.decode_sync_response(data)
+        oracle = (decrypt_messages(resp.messages, MN), resp.merkle_tree)
+    except (PgpError, ValueError) as e:
+        oracle = type(e)
+    assert fused is None or fused == oracle
