@@ -1,0 +1,297 @@
+"""Push subscriptions + event-loop connection tier bench (ISSUE 13).
+
+Three measurements, one child-process relay (the 20k-FD container
+limit means 10^4 connections must split their endpoints across two
+processes; the split also lets us read the RELAY's /proc accounting
+untainted by the driver):
+
+1. Idle-connection scaling — parked long-polls vs the relay process's
+   thread count and RSS. THE acceptance gate: threads must NOT grow
+   with connections (10^4 idle subscriptions on the event tier cost
+   file descriptors, not threads).
+
+2. Mutation→client-visible latency, push vs poll, with the idle fleet
+   parked: K probe subscribers on the hot owner measure
+   wake→sync-round-complete; the polling baseline measures
+   mutation→first-interval-poll-that-sees-it at POLL_INTERVAL_S
+   (1.0 s — generous to polling: the reference's headless analog
+   syncs on a timer of seconds; halve it and push's factor halves,
+   recorded honestly in docs/BENCHMARKS.md). Acceptance: push p50
+   ≥ 5× better at 10^3+ subscribers.
+
+3. Byte-identity gate — the same mutation stream driven at an
+   event-tier relay and a threaded oracle relay: every response and
+   both SQLite end states must match (modulo the Date header).
+
+`--smoke` (CI): 2k idle connections, fewer rounds, same asserts.
+Output: ONE JSON line, like every bench here.
+"""
+
+import json
+import os
+import selectors
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+POLL_INTERVAL_S = 1.0
+NODE_W = "a" * 16  # writer node
+NODE_S = "5" * 16  # subscriber node
+
+
+def _serve():
+    """Child mode: run one event-tier relay, print its URL, serve
+    until stdin closes."""
+    from evolu_tpu.server.relay import RelayServer, RelayStore
+
+    srv = RelayServer(RelayStore(), connection_tier="eventloop").start()
+    print("READY " + srv.url, flush=True)
+    try:
+        sys.stdin.read()  # parent closes stdin to stop us
+    finally:
+        srv.stop()
+
+
+def _proc_status(pid):
+    threads = rss_kb = None
+    with open(f"/proc/{pid}/status") as f:
+        for line in f:
+            if line.startswith("Threads:"):
+                threads = int(line.split()[1])
+            elif line.startswith("VmRSS:"):
+                rss_kb = int(line.split()[1])
+    return threads, rss_kb
+
+
+def _raw_poll(owner, node, cursor=0, timeout=50.0):
+    path = (f"/push/poll?owner={owner}&node={node}"
+            f"&cursor={cursor}&timeout={timeout}")
+    return (f"GET {path} HTTP/1.0\r\nContent-Length: 0\r\n\r\n").encode()
+
+
+def _park(addr, owner, node, timeout=50.0):
+    s = socket.create_connection(addr, timeout=30)
+    s.sendall(_raw_poll(owner, node, timeout=timeout))
+    s.setblocking(False)
+    return s
+
+
+def _msgs(node, start, n):
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.sync import protocol
+
+    base = 1_740_000_000_000
+    return tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(Timestamp(base + (start + i) * 1000, 0, node)),
+            b"ct-%d" % (start + i))
+        for i in range(n)
+    )
+
+
+def _sync_body(owner, node, messages, tree="{}"):
+    from evolu_tpu.sync import protocol
+
+    return protocol.encode_sync_request(
+        protocol.SyncRequest(messages, owner, node, tree))
+
+
+def _post(url, body):
+    import urllib.request
+
+    with urllib.request.urlopen(
+            urllib.request.Request(url, data=body), timeout=60) as r:
+        return r.read()
+
+
+def _recv_all(sock, deadline):
+    sock.setblocking(True)
+    sock.settimeout(max(0.05, deadline - time.monotonic()))
+    out = bytearray()
+    while True:
+        chunk = sock.recv(65536)
+        if not chunk:
+            return bytes(out)
+        out += chunk
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(q * len(xs)))]
+
+
+def run(smoke: bool):
+    n_idle = 2000 if smoke else 10_000
+    # Probes are measurement taps; the parked idle fleet provides the
+    # subscriber scale. Too many SIMULTANEOUS probes would measure the
+    # 1-core thundering-herd of their own confirmation pulls, not the
+    # push path (32 concurrent pulls serialized behind one core added
+    # ~3x to p50 — recorded in docs/BENCHMARKS.md).
+    n_probes = 8
+    rounds = 6 if smoke else 12
+    checkpoints = [0, n_idle // 2, n_idle]
+
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--serve"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+    )
+    out = {"bench": "push_subscriptions", "smoke": smoke, "n_idle": n_idle}
+    idle_socks = []
+    try:
+        line = child.stdout.readline()
+        assert line.startswith("READY "), line
+        url = line.split()[1]
+        host, port = url.split("//")[1].split(":")
+        addr = (host, int(port))
+
+        # -- 1: idle-connection scaling --
+        scaling = []
+        k = 0
+        for target in checkpoints:
+            while k < target:
+                idle_socks.append(_park(addr, f"idle-{k}", NODE_S))
+                k += 1
+            import urllib.request
+
+            deadline = time.monotonic() + 60
+            while True:
+                with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+                    st = json.loads(r.read())
+                if st["push"]["subscriptions"] >= target:
+                    break
+                assert time.monotonic() < deadline, \
+                    (target, st["push"]["subscriptions"])
+                time.sleep(0.05)
+            threads, rss_kb = _proc_status(child.pid)
+            scaling.append({"connections": target, "threads": threads,
+                            "rss_kb": rss_kb,
+                            "parked": st["push"]["subscriptions"]})
+        out["idle_scaling"] = scaling
+        # THE gate: threads flat across 0 → n_idle parked connections
+        # (the first checkpoint may still be warming the handler pool,
+        # so compare the two loaded checkpoints AND bound absolutely).
+        t_half, t_full = scaling[1]["threads"], scaling[2]["threads"]
+        assert t_full <= t_half, \
+            f"threads grew with connections: {t_half} -> {t_full}"
+        assert t_full < 64, f"unbounded thread count: {t_full}"
+
+        # -- 2: push vs poll latency, idle fleet still parked --
+        hot = "hot-owner"
+        push_lat = []
+        seq = 0
+        for rnd in range(rounds):
+            probes = [_park(addr, hot, NODE_S, timeout=30.0)
+                      for _ in range(n_probes)]
+            time.sleep(0.3)  # let them park
+            t0 = time.monotonic()
+            _post(url + "/", _sync_body(hot, NODE_W, _msgs(NODE_W, seq, 1)))
+            seq += 1
+            sel = selectors.DefaultSelector()
+            for s in probes:
+                sel.register(s, selectors.EVENT_READ)
+            deadline = t0 + 30
+            done = 0
+            while done < len(probes) and time.monotonic() < deadline:
+                for key, _ in sel.select(timeout=1.0):
+                    s = key.fileobj
+                    sel.unregister(s)
+                    resp = _recv_all(s, deadline)
+                    assert b'"wake": true' in resp.replace(b'"wake":true', b'"wake": true'), resp[-200:]
+                    # client-visible = wake + the sync round it triggers
+                    _post(url + "/", _sync_body(hot, NODE_S, ()))
+                    push_lat.append(time.monotonic() - t0)
+                    s.close()
+                    done += 1
+            assert done == len(probes), f"round {rnd}: {done}/{len(probes)}"
+        # Polling baseline: same relay, same owner, interval pollers.
+        poll_lat = []
+        for rnd in range(rounds):
+            # Pollers offset uniformly across the interval (the honest
+            # steady-state phase distribution, not worst- or best-case).
+            offsets = [(i + 0.5) / n_probes * POLL_INTERVAL_S
+                       for i in range(n_probes)]
+            t0 = time.monotonic()
+            _post(url + "/", _sync_body(hot, NODE_W, _msgs(NODE_W, seq, 1)))
+            seq += 1
+            target_n = seq  # rows the hot owner now has
+            for off in offsets:
+                now = time.monotonic() - t0
+                wait = (off - now) % POLL_INTERVAL_S
+                time.sleep(max(0.0, wait))
+                while True:
+                    resp = _post(url + "/", _sync_body(hot, NODE_S, ()))
+                    from evolu_tpu.sync import protocol
+
+                    got = protocol.decode_sync_response(resp)
+                    if len(got.messages) >= target_n:
+                        poll_lat.append(time.monotonic() - t0)
+                        break
+                    time.sleep(POLL_INTERVAL_S)
+        out["push_ms"] = {"p50": round(_percentile(push_lat, 0.5) * 1e3, 2),
+                          "p99": round(_percentile(push_lat, 0.99) * 1e3, 2)}
+        out["poll_ms"] = {"p50": round(_percentile(poll_lat, 0.5) * 1e3, 2),
+                          "p99": round(_percentile(poll_lat, 0.99) * 1e3, 2),
+                          "interval_s": POLL_INTERVAL_S}
+        factor = out["poll_ms"]["p50"] / max(out["push_ms"]["p50"], 1e-9)
+        out["push_vs_poll_p50_factor"] = round(factor, 1)
+        assert factor >= 5.0, \
+            f"push p50 only {factor:.1f}x better than {POLL_INTERVAL_S}s polling"
+    finally:
+        for s in idle_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        child.stdin.close()
+        try:
+            child.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            child.kill()
+
+    # -- 3: byte-identity gate vs the threaded oracle --
+    from evolu_tpu.server.relay import RelayServer, RelayStore
+
+    def _dump(store):
+        msgs = store.db.exec_sql_query(
+            'SELECT "timestamp", "userId", "content" FROM "message" '
+            'ORDER BY "userId", "timestamp"', ())
+        trees = store.db.exec_sql_query(
+            'SELECT "userId", "merkleTree" FROM "merkleTree" '
+            'ORDER BY "userId"', ())
+        return ([(r["timestamp"], r["userId"], bytes(r["content"]))
+                 for r in msgs],
+                [(r["userId"], r["merkleTree"]) for r in trees])
+
+    twins = [RelayServer(RelayStore(), connection_tier=t).start()
+             for t in ("threaded", "eventloop")]
+    try:
+        n_div = 0
+        for i in range(12):
+            owner = f"ow-{i % 3}"
+            body = _sync_body(owner, NODE_W, _msgs(NODE_W, i * 10, 3))
+            got = [_post(s.url + "/", body) for s in twins]
+            if got[0] != got[1]:
+                n_div += 1
+        pull = _sync_body("ow-0", NODE_S, ())
+        got = [_post(s.url + "/", pull) for s in twins]
+        assert got[0] == got[1], "cold pull diverged between tiers"
+        assert n_div == 0, f"{n_div} responses diverged between tiers"
+        assert _dump(twins[0].store) == _dump(twins[1].store), \
+            "SQLite end state diverged between tiers"
+        out["byte_identity"] = "ok"
+    finally:
+        for s in twins:
+            s.stop()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    if "--serve" in sys.argv:
+        _serve()
+    else:
+        run(smoke="--smoke" in sys.argv)

@@ -394,7 +394,8 @@ def mesh_stats_payload() -> dict:
 
 
 def relay_stats_payload(store, replication=None, fleet=None,
-                        write_behind=None, mesh_engine: bool = False) -> dict:
+                        write_behind=None, mesh_engine: bool = False,
+                        push_hub=None, conn_tier=None) -> dict:
     """The GET /stats JSON: store-derived row counts per shard (shared
     truth in a MultiprocessRelay — every worker reads the same files)
     plus this process's request counters from the metrics registry
@@ -430,6 +431,10 @@ def relay_stats_payload(store, replication=None, fleet=None,
         payload["write_behind"] = write_behind.stats_payload()
     if mesh_engine:
         payload["mesh"] = mesh_stats_payload()
+    if push_hub is not None:
+        payload["push"] = push_hub.stats_payload()
+    if conn_tier is not None:
+        payload["conn"] = conn_tier.stats_payload()
     return payload
 
 
@@ -440,6 +445,8 @@ class _Handler(BaseHTTPRequestHandler):
     fleet = None  # FleetManager when the relay is an owner-sharded fleet member
     write_behind = None  # WriteBehindQueue when the PR-11 inversion is on
     mesh_engine = False  # PR-12 sharded engine: adds the /stats mesh section
+    push_hub = None  # PushHub when push subscriptions are on (server/push.py)
+    conn_tier = None  # EventLoopHTTPServer when that tier serves this relay
     # Capabilities this relay echoes back (intersected with the
     # request's advertised set — sync/protocol.py capability
     # extension). A request with no capabilities gets the v1 wire,
@@ -627,7 +634,9 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(
                     relay_stats_payload(self.store, self.replication,
                                         self.fleet, self.write_behind,
-                                        mesh_engine=self.mesh_engine)
+                                        mesh_engine=self.mesh_engine,
+                                        push_hub=self.push_hub,
+                                        conn_tier=self.conn_tier)
                 ).encode("utf-8")
             except Exception as e:  # noqa: BLE001
                 metrics.inc("evolu_relay_errors_total")
@@ -700,8 +709,62 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_error(500, str(e))
                 return
             self._respond(200, body, "application/json")
+        elif self.path.startswith("/push/poll"):
+            self._do_push_poll()
         else:
             self.send_error(404)
+
+    def _do_push_poll(self) -> None:
+        """GET /push/poll — the long-poll subscription leg
+        (server/push.py). On THIS tier the poll parks the handler
+        thread on an Event (the reference shape, fine at small scale);
+        the event-loop tier (server/conn.py) intercepts the same path
+        before the handler pool and parks the bare connection instead.
+        This branch is also that tier's byte-identity fallback for the
+        shapes it won't answer itself (no hub → 404, malformed query
+        → 400). Framing here and in conn.frame_response must stay
+        aligned — the twin-relay oracle test pins it."""
+        from evolu_tpu.server import push as push_mod
+
+        metrics.inc("evolu_relay_requests_total", endpoint="/push/poll")
+        if self.push_hub is None:
+            self.send_error(404)
+            return
+        import urllib.parse
+
+        parts = urllib.parse.urlsplit(self.path)
+        try:
+            owner, node, cursor, timeout = push_mod.parse_poll_query(
+                parts.query)
+        except ValueError as e:
+            metrics.inc("evolu_relay_errors_total")
+            self.send_error(400, str(e))
+            return
+        if self.fleet is not None:
+            # A subscription lives at the owner's PLACED relay — where
+            # its mutations are served and hub-notified. 307 even in
+            # forward mode: proxying a long-poll would pin a handler
+            # (or a poller, on the event tier) for the whole park.
+            from evolu_tpu.server.fleet import FleetNotReady
+
+            try:
+                action, peer = self.fleet.route(owner)
+            except FleetNotReady as e:
+                self._respond_retry_after(e.retry_after)
+                return
+            if action != "local":
+                metrics.inc("evolu_push_redirects_total")
+                self.send_response(307)
+                self.send_header("Location", peer + self.path)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+        try:
+            body = self.push_hub.poll_blocking(owner, node, cursor, timeout)
+        except push_mod.HubFull as e:
+            self._respond_retry_after(e.retry_after)
+            return
+        self._respond(200, body, "application/json")
 
     def do_POST(self) -> None:  # POST / (index.ts:224-248)
         if self.path.startswith("/replicate/"):
@@ -763,6 +826,14 @@ class _Handler(BaseHTTPRequestHandler):
             # each message counts once fleet-wide, at the relay that
             # actually ingested it.
             _count_ingest_mix(request.messages)
+            if self.push_hub is not None and request.messages:
+                # Wake parked subscriptions AFTER the serve committed
+                # (a woken client's sync round must observe the rows);
+                # the timestamps carry the author-node metadata the
+                # hub's own-write exclusion gates on (server/push.py).
+                self.push_hub.notify(
+                    request.user_id,
+                    [m.timestamp for m in request.messages])
         except Exception as e:  # noqa: BLE001 - index.ts:231-233
             # The flight dump rides the exception (server-side only —
             # the wire response stays a bare 500, no event leakage).
@@ -1014,6 +1085,14 @@ class _Handler(BaseHTTPRequestHandler):
                 if out is None:
                     return  # 503 backpressure already answered
                 _count_ingest_mix(request.messages)
+                if self.push_hub is not None and request.messages:
+                    # The forward SERVE is where the owner's rows land
+                    # — and where its subscriptions are parked (push
+                    # polls 307 to placement): notify here, never at
+                    # the forwarding hop.
+                    self.push_hub.notify(
+                        request.user_id,
+                        [m.timestamp for m in request.messages])
                 if self.replication is not None and request.messages:
                     self.replication.hint(origin=fspan.context)
                 out = self._negotiate_caps(request, out)
@@ -1097,6 +1176,15 @@ class RelayServer:
     `<store path>.checkpoint` for file-backed stores) runs periodic
     local snapshot checkpoints for crash-consistent fast restart
     (`snapshot.write_checkpoint` / `snapshot.restore_checkpoint`).
+
+    `connection_tier` (ISSUE 13, `server/conn.py`): "threaded" (the
+    reference-shaped ThreadingHTTPServer — default, and every
+    byte-identity pin's baseline) or "eventloop" (one selectors loop
+    owns every socket, requests run the same handler on a bounded
+    pool, push long-polls park the bare connection — 10^4-10^5 idle
+    subscriptions cost FDs, not threads). `push` enables the
+    long-poll subscription hub (`server/push.py`, default on — a new
+    GET endpoint, zero effect on existing responses) on either tier.
     `start()`/`stop()` own every lifecycle."""
 
     def __init__(self, store: Optional[RelayStore] = None, host: str = "127.0.0.1",
@@ -1110,7 +1198,9 @@ class RelayServer:
                  write_behind: Optional[bool] = None,
                  write_behind_log: Optional[str] = None,
                  mesh_engine: Optional[bool] = None,
-                 mesh_ctx=None):
+                 mesh_ctx=None,
+                 connection_tier: Optional[str] = None,
+                 push: Optional[bool] = None):
         self.store = store or RelayStore()
         # capabilities=() emulates a v1 peer (never echoes the
         # extension — tests pin the byte-identical fallback with it).
@@ -1209,15 +1299,62 @@ class RelayServer:
                          if self.write_behind is not None else None),
             )
         self.fleet = None
+        # Push subscriptions (ISSUE 13, server/push.py): on by default
+        # — a new GET endpoint, zero effect on existing responses.
+        # Both connection tiers serve the same hub.
+        if push is None:
+            push = default_config.push_subscriptions
+        self.push_hub = None
+        if push:
+            from evolu_tpu.server.push import PushHub
+
+            self.push_hub = PushHub(
+                max_subscriptions=default_config.push_max_subscriptions,
+                default_timeout_s=default_config.push_poll_timeout_s,
+            )
+            if self.replication is not None and getattr(
+                    self.replication, "push_hub", None) is None:
+                # Replication ingest is a wakeup source too: rows a
+                # gossip round lands (a partition HEALING) must wake
+                # this relay's parked subscribers — they will never
+                # arrive as a local sync POST.
+                self.replication.push_hub = self.push_hub
+        # Connection tier (ISSUE 13 tentpole, server/conn.py):
+        # "threaded" (the reference-shaped ThreadingHTTPServer,
+        # default) or "eventloop" (idle connections cost FDs, not
+        # threads). Constructor arg > EVOLU_CONN_TIER > Config.
+        if connection_tier is None:
+            connection_tier = (os.environ.get("EVOLU_CONN_TIER")
+                               or default_config.connection_tier)
+        if connection_tier not in ("threaded", "eventloop"):
+            raise ValueError(
+                f"connection_tier must be 'threaded' or 'eventloop', "
+                f"got {connection_tier!r}")
+        self.connection_tier = connection_tier
         self._handler_cls = type(
             "BoundHandler", (_Handler,),
             {"store": self.store, "scheduler": self.scheduler,
              "replication": self.replication,
              "capabilities": self.capabilities,
              "write_behind": self.write_behind,
-             "mesh_engine": self.mesh_engine},
+             "mesh_engine": self.mesh_engine,
+             "push_hub": self.push_hub},
         )
-        self._httpd = _RelayHTTPServer((host, port), self._handler_cls)
+        if connection_tier == "eventloop":
+            from evolu_tpu.server.conn import EventLoopHTTPServer
+
+            self._httpd = EventLoopHTTPServer(
+                (host, port), self._handler_cls,
+                push_hub=self.push_hub,
+                handler_threads=default_config.conn_handler_threads,
+                max_pending=default_config.conn_max_pending,
+                read_timeout_s=default_config.conn_read_timeout_s,
+                write_timeout_s=default_config.conn_write_timeout_s,
+                max_header_bytes=default_config.conn_max_header_bytes,
+            )
+            self._handler_cls.conn_tier = self._httpd
+        else:
+            self._httpd = _RelayHTTPServer((host, port), self._handler_cls)
         self._thread: Optional[threading.Thread] = None
 
     def enable_fleet(self, config, self_url: Optional[str] = None):
@@ -1259,6 +1396,12 @@ class RelayServer:
         return self
 
     def stop(self) -> None:
+        if self.push_hub is not None:
+            # BEFORE the HTTP server stops: resolve every parked
+            # long-poll (wake=false) so threaded-tier handler threads
+            # unblock and the event tier can flush the responses in
+            # its shutdown drain window.
+            self.push_hub.close()
         self._httpd.shutdown()
         if self._thread:
             self._thread.join()

@@ -231,6 +231,7 @@ class ReplicationManager:
         bootstrap_lag_owners: Optional[int] = None,
         snapshot_chunk_bytes: Optional[int] = None,
         write_behind=None,
+        push_hub=None,
     ):
         import functools
         import random
@@ -292,6 +293,12 @@ class ReplicationManager:
         # state (a tree advertised ahead of its rows would make peers
         # pull ranges the store cannot yet serve).
         self.write_behind = write_behind
+        # ISSUE 13: rows this manager ingests (anti-entropy pulls,
+        # partition heals) are newly visible at THIS relay — parked
+        # push subscriptions for those owners must wake exactly as for
+        # a local client write (server/push.py; attached by
+        # RelayServer alongside the hub).
+        self.push_hub = push_hub
         now = time.monotonic()
         self._peers = [_Peer(u, now) for u in peers]
         self._swap_checked = False
@@ -877,6 +884,11 @@ class ReplicationManager:
         log("server", "snapshot bootstrap installed", peer=peer.url,
             snapshot=manifest.snapshot_id, rows=manifest.message_count,
             owners=len(manifest.owners))
+        if self.push_hub is not None:
+            # A whole-store install changed arbitrarily many owners at
+            # once: per-row attribution is gone, so wake everything —
+            # the changed-set contract's "don't know escalates" rule.
+            self.push_hub.notify_all(reason="conservative")
         return manifest.message_count
 
     def _ingest(self, requests: List[protocol.SyncRequest]) -> None:
@@ -903,16 +915,45 @@ class ReplicationManager:
                 self._ingest_pool().submit(self.scheduler.submit, r) for r in requests
             ]
             first_err: Optional[BaseException] = None
-            for f in futures:
+            served = []
+            for r, f in zip(requests, futures):
                 e = f.exception()
+                if e is None:
+                    served.append(r)
                 first_err = first_err or e
+            # Notify BEFORE re-raising: the requests that DID commit
+            # made rows visible, and their subscribers must wake even
+            # when a batchmate failed (review finding — the raise used
+            # to skip the notify for all of them).
+            self._notify_push(served)
             if first_err is not None:
                 raise first_err
             return
         from evolu_tpu.server.relay import serve_single_request
 
+        served = []
+        try:
+            for r in requests:
+                serve_single_request(self.store, r)
+                served.append(r)
+        finally:
+            self._notify_push(served)
+
+    def _notify_push(self, requests: List[protocol.SyncRequest]) -> None:
+        """Wake parked push subscriptions for rows replication just
+        landed (AFTER the serve committed them). The pulled messages'
+        plaintext timestamps carry the ORIGINAL author nodes, so the
+        hub's own-write exclusion still holds across relays — a
+        subscriber never wakes for rows it authored, whichever relay
+        they arrive through."""
+        if self.push_hub is None:
+            return
         for r in requests:
-            serve_single_request(self.store, r)
+            if r.messages:
+                self.push_hub.notify(
+                    r.user_id, [m.timestamp for m in r.messages],
+                    reason="replication",
+                )
 
     def _ingest_pool(self):
         if self._stopping:

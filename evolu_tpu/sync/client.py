@@ -180,6 +180,12 @@ class SyncTransport:
         self._prober: Optional[threading.Thread] = None
         self._offline = False
         self._pending_reconnect = False  # transport-thread only
+        # Optional push-subscription leg (ISSUE 13, server/push.py):
+        # attached by connect() under Config.push_subscribe. Bound
+        # lazily from the first successful round (which is where the
+        # owner id, the clock's node id, and the owner's PLACED relay
+        # become known on this thread).
+        self.push_subscriber = None
         self._thread = threading.Thread(target=self._loop, daemon=True, name="evolu-sync")
         self._thread.start()
 
@@ -187,6 +193,8 @@ class SyncTransport:
         self._queue.put(request)
 
     def stop(self) -> None:
+        if self.push_subscriber is not None:
+            self.push_subscriber.stop()
         self._probe_stop.set()
         with self._probe_lock:
             prober = self._prober
@@ -524,6 +532,12 @@ class SyncTransport:
         except _Abort:
             return None
         self._note_online()
+        if self.push_subscriber is not None:
+            # Bind/retarget the push leg with what this round learned:
+            # the owner, the clock's node id (its own-write exclusion
+            # key), and the relay that actually served — the placed
+            # one, after any 307 follow.
+            self.push_subscriber.ensure(owner_id, node_id, url)
         # Push-mix counters AFTER the POST landed: a round that ended
         # offline, errored, or was downgraded mid-flight must count as
         # what actually reached a relay, not what was first encoded
@@ -675,6 +689,185 @@ def _http_ping(url: str) -> None:
         resp.read()
 
 
+class _NoRedirect(urllib.request.HTTPRedirectHandler):
+    """Surface 3xx as HTTPError instead of auto-following: the push
+    loop must LEARN the placed relay from a 307's Location (and cache
+    it), not pay a redirect hop on every poll."""
+
+    def redirect_request(self, *a, **k):
+        return None
+
+
+_PUSH_OPENER = urllib.request.build_opener(_NoRedirect)
+
+
+def _push_get(url: str, timeout: float) -> bytes:
+    with _PUSH_OPENER.open(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+class PushSubscriber:
+    """The client half of relay-held push subscriptions (ISSUE 13,
+    server/push.py): one daemon thread long-polls
+    `GET /push/poll?owner&node&cursor` against the owner's placed
+    relay and fires `on_wake` — typically `evolu.sync` — whenever the
+    relay reports foreign-authored rows. The parked poll replaces the
+    polling interval: mutation→visible becomes the push round trip.
+
+    Robustness mirrors the sync transport's: at most one 307 follow
+    per poll with the learned route cached (invalidated on 404/error/
+    connection failure, failing back to the bound URL), bounded
+    exponential backoff + full jitter while the relay is unreachable
+    (offline is a normal state), cursor-resume across reconnects (the
+    hub answers a conservative wake for a cursor its ring outgrew —
+    a wakeup is never missed, ISSUE 13). `ensure` is idempotent and
+    re-callable: every successful sync round re-binds the target, so
+    the subscription follows fleet placement exactly as the sync leg
+    does."""
+
+    def __init__(self, config: Config, on_wake: Callable[[], None],
+                 http_get: Optional[Callable[[str, float], bytes]] = None,
+                 poll_timeout_s: Optional[float] = None):
+        self.config = config
+        self.on_wake = on_wake
+        self._http_get = http_get or _push_get
+        self._poll_timeout_s = (
+            float(poll_timeout_s) if poll_timeout_s is not None
+            else float(config.push_poll_timeout_s)
+        )
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._owner: Optional[str] = None
+        self._node: Optional[str] = None
+        self._base: Optional[str] = None  # bound by ensure()
+        self._route: Optional[str] = None  # learned via 307
+        self.cursor = 0
+        self.wakes = 0  # total on_wake firings (tests/bench read it)
+
+    def ensure(self, owner_id: str, node: str, url: str) -> None:
+        """Bind (or re-bind) the subscription; starts the loop thread
+        on first call. Safe from any thread, idempotent."""
+        with self._lock:
+            self._owner, self._node = owner_id, node
+            self._base = url.rstrip("/")
+            start = self._thread is None and not self._stop.is_set()
+            if start:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="evolu-push")
+                self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            # Bounded: the loop may be parked in a long poll; it is a
+            # daemon thread that only touches the network.
+            t.join(timeout=0.2)
+
+    def _target(self) -> Tuple[str, str, str]:
+        with self._lock:
+            return (self._route or self._base, self._owner, self._node)
+
+    def _loop(self) -> None:
+        import json as _json
+        import random
+
+        delay = BACKOFF_BASE_S
+        attempt = 0
+        follows = 0  # consecutive 307s without a successful poll
+        while not self._stop.is_set():
+            base, owner, node = self._target()
+            url = (
+                f"{base}/push/poll?owner={urllib.parse.quote(owner)}"
+                f"&node={node}&cursor={self.cursor}"
+                f"&timeout={self._poll_timeout_s}"
+            )
+            try:
+                raw = self._http_get(url, self._poll_timeout_s + 10.0)
+            except urllib.error.HTTPError as e:
+                if e.code == 307:
+                    location = e.headers.get("Location") if e.headers else None
+                    follows += 1
+                    if location and follows <= 1:
+                        with self._lock:
+                            self._route = urllib.parse.urljoin(
+                                base + "/", location).split("/push/", 1)[0]
+                        metrics.inc("evolu_push_client_redirects_total")
+                        continue
+                    # A SECOND consecutive 307 means the relays'
+                    # rings disagree (mid-rebalance ping-pong, the
+                    # sync transport's one-follow rule): drop the
+                    # learned route and back off instead of spinning
+                    # a hot redirect loop (review finding).
+                    with self._lock:
+                        self._route = None
+                    if self._stop.wait(min(BACKOFF_MAX_S, delay)):
+                        return
+                    delay = min(BACKOFF_MAX_S, delay * 2)
+                    follows = 0
+                    continue
+                if e.code in (429, 503):
+                    # Flow control (hub full / relay shedding): honor
+                    # Retry-After, degrade toward polling cadence.
+                    ra = _retry_after_seconds(e)
+                    if self._stop.wait(ra if ra is not None else
+                                       min(BACKOFF_MAX_S, delay)):
+                        return
+                    delay = min(BACKOFF_MAX_S, max(delay * 2, BACKOFF_BASE_S))
+                    continue
+                # Definitive rejection (404: stale route or push-less
+                # relay; 400): drop the learned route, fail back, and
+                # back off — never spin.
+                with self._lock:
+                    self._route = None
+                metrics.inc("evolu_push_client_errors_total")
+                if self._stop.wait(min(BACKOFF_MAX_S, delay)):
+                    return
+                delay = min(BACKOFF_MAX_S, delay * 2)
+                continue
+            except Exception:  # noqa: BLE001 - offline: backoff + jitter
+                with self._lock:
+                    self._route = None
+                metrics.inc("evolu_push_client_offline_total")
+                jittered = min(BACKOFF_MAX_S,
+                               BACKOFF_BASE_S * (2 ** attempt))
+                if self._stop.wait(jittered * random.random() + 0.01):
+                    return
+                attempt = min(attempt + 1, 10)
+                continue
+            attempt = 0
+            delay = BACKOFF_BASE_S
+            follows = 0
+            metrics.inc("evolu_push_client_polls_total")
+            try:
+                body = _json.loads(raw)
+                cursor = int(body["cursor"])
+                wake = bool(body["wake"])
+            except (ValueError, KeyError, TypeError):
+                metrics.inc("evolu_push_client_errors_total")
+                if self._stop.wait(min(BACKOFF_MAX_S, delay)):
+                    return
+                delay = min(BACKOFF_MAX_S, delay * 2)
+                continue
+            # ADOPT the relay's cursor, never max() it: cursors are
+            # per-hub sequence numbers, and a relay restart (or a
+            # retarget to a different relay) legitimately answers a
+            # SMALLER one. Clinging to the old epoch's larger value
+            # would make qualifies() read fresh events as already-seen
+            # — silently missed wakeups until the new hub's seq caught
+            # up (review finding; the hub's cursor>seq conservative
+            # wake is the server-side half of this fix).
+            self.cursor = cursor
+            if wake and not self._stop.is_set():
+                self.wakes += 1
+                metrics.inc("evolu_push_client_wakes_total")
+                try:
+                    self.on_wake()
+                except Exception:  # noqa: BLE001 - the wake hook must
+                    pass           # never kill the subscription loop
+
+
 class PeriodicSyncer:
     """Timer analog of the reference's load/online/focus sync triggers
     (db.ts:390-412): posts a pull-only sync round every `interval`
@@ -726,6 +919,18 @@ def connect(evolu, config: Optional[Config] = None) -> SyncTransport:
         on_error=lambda e: evolu._dispatch_output(OnError(e)),
         on_reconnect=on_reconnect,
     )
+    if cfg.push_subscribe:
+        # The push leg (ISSUE 13): wake-driven sync rounds instead of
+        # a timer. A wake only means "foreign rows may exist" — the
+        # sync round it triggers is the same anti-entropy round a
+        # timer would fire, so correctness is unchanged and a spurious
+        # wake costs one empty round.
+        def on_push_wake():
+            if getattr(evolu, "_disposed", False):
+                return
+            evolu.sync(refresh_queries=False)
+
+        transport.push_subscriber = PushSubscriber(cfg, on_push_wake)
     evolu.attach_transport(transport)
     prev = getattr(evolu, "_auto_syncer", None)
     if prev is not None:

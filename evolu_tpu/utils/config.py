@@ -145,6 +145,39 @@ class Config:
     # reference's online/focus re-sync listeners (db.ts:390-412).
     # None disables probing.
     reconnect_probe_interval: "float | None" = 1.0
+    # PR-13 connection tier (server/conn.py): "threaded" = the
+    # reference-shaped ThreadingHTTPServer (one thread per connection,
+    # the default and every pin's baseline until event-loop parity is
+    # proven in a deployment); "eventloop" = one selectors loop owns
+    # every socket, complete requests run on a BOUNDED handler pool,
+    # and push long-polls park the bare connection — 10^4-10^5 idle
+    # subscriptions cost file descriptors, not threads.
+    # EVOLU_CONN_TIER overrides at the relay.
+    connection_tier: str = "threaded"
+    # Event-tier bounds (flow control + slow-client hardening — see
+    # docs/PUSH.md): handler-pool size (the only threads request
+    # handling ever uses), in-flight dispatch bound past which the
+    # loop sheds 503 + Retry-After itself, the ABSOLUTE budget a
+    # request must fully arrive within (slowloris can't trickle past
+    # it), the no-progress write stall budget, and the header cap
+    # (431 past it).
+    conn_handler_threads: int = 8
+    conn_max_pending: int = 512
+    conn_read_timeout_s: float = 30.0
+    conn_write_timeout_s: float = 30.0
+    conn_max_header_bytes: int = 16384
+    # PR-13 push subscriptions (server/push.py): relay-held long-poll
+    # subscriptions woken by a mutation's changed set at the
+    # granularity E2EE exposes (owner + author-node row metadata) —
+    # mutation→client-visible drops from the polling interval to the
+    # push round trip. Relay default-on (a new GET endpoint, zero
+    # effect on existing responses); push_subscribe wires the CLIENT
+    # leg in connect(): wake-driven sync rounds instead of (or on top
+    # of) the sync_interval timer.
+    push_subscriptions: bool = True
+    push_subscribe: bool = False
+    push_poll_timeout_s: float = 25.0
+    push_max_subscriptions: int = 1 << 17
 
 
 default_config = Config()

@@ -953,6 +953,59 @@ bool read_packets(const uint8_t *d, size_t n, std::vector<Pkt> &out) {
   return true;
 }
 
+// Strict UTF-8 validation matching CPython's decoder: rejects bare
+// continuations, overlong encodings, surrogates (U+D800..U+DFFF), and
+// code points above U+10FFFF. The columnar receive path commits these
+// bytes to SQLite with explicit lengths; anything Python's .decode()
+// would reject must bounce the batch to the object path instead.
+static bool utf8_ok(const uint8_t *s, size_t n) {
+  size_t i = 0;
+  while (i < n) {
+    uint8_t b = s[i];
+    if (b < 0x80) { i++; continue; }
+    if (b < 0xC2) return false;  // continuation byte or overlong 2-byte
+    if (b < 0xE0) {
+      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return false;
+      i += 2;
+    } else if (b < 0xF0) {
+      if (i + 2 >= n) return false;
+      uint8_t b1 = s[i + 1], b2 = s[i + 2];
+      if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80) return false;
+      if (b == 0xE0 && b1 < 0xA0) return false;   // overlong
+      if (b == 0xED && b1 >= 0xA0) return false;  // surrogate
+      i += 3;
+    } else if (b < 0xF5) {
+      if (i + 3 >= n) return false;
+      uint8_t b1 = s[i + 1], b2 = s[i + 2], b3 = s[i + 3];
+      if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80 || (b3 & 0xC0) != 0x80)
+        return false;
+      if (b == 0xF0 && b1 < 0x90) return false;   // overlong
+      if (b == 0xF4 && b1 >= 0x90) return false;  // > U+10FFFF
+      i += 4;
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
+// Top-level SyncResponse field-3 (capability) validation, shared by
+// both fused response walkers. The pure decoder (_decode_capability)
+// decodes every capability entry as strict UTF-8 and raises past 64
+// entries; the C walkers used to SKIP field 3 entirely — so a
+// response whose capability bytes the pure path rejects decoded
+// "successfully" on the fused path (the pinned
+// tests/fixtures/fuzz_divergent_response.bin divergence). Returns
+// false on exactly the shapes the pure decoder raises for; the caller
+// demotes the whole response to the pure decoder, which owns the
+// exact ValueError surface. Well-formed capabilities stay skipped
+// (the client scans them separately, pre-decrypt).
+static bool capability_ok(const uint8_t *body, size_t blen, int &n_caps) {
+  if (n_caps >= 64) return false;  // protocol._MAX_CAPABILITIES
+  n_caps++;
+  return utf8_ok(body, blen);
+}
+
 // Canonical-wire-type CrdtMessageContent decode (protocol.py:194-217).
 // Any deviation (unexpected wire type on a known field, truncation)
 // → false → Python oracle reproduces the exact lenient/strict result.
@@ -1200,6 +1253,7 @@ int ehc_decrypt_response(const uint8_t *resp, int64_t resp_len,
   std::vector<uint8_t> plain;
   std::vector<Pkt> pkts, inner;
 
+  int n_caps = 0;
   size_t pos = 0;
   while (pos < n_) {
     uint64_t key;
@@ -1219,6 +1273,13 @@ int ehc_decrypt_response(const uint8_t *resp, int64_t resp_len,
     if (field == 2) {
       tree = body;  // last wins, like the Python decoder
       tree_len = blen;
+      continue;
+    }
+    if (field == 3) {
+      // Capabilities: the pure decoder PARSES these (raising on bad
+      // UTF-8 / >64 entries); skipping them unvalidated is the pinned
+      // fused/pure divergence — reject exactly what it rejects.
+      if (!capability_ok(body, blen, n_caps)) return 2;
       continue;
     }
     if (field != 1) continue;  // unknown length-delimited field: skip
@@ -1271,41 +1332,8 @@ int ehc_decrypt_response(const uint8_t *resp, int64_t resp_len,
   return 0;
 }
 
-// Strict UTF-8 validation matching CPython's decoder: rejects bare
-// continuations, overlong encodings, surrogates (U+D800..U+DFFF), and
-// code points above U+10FFFF. The columnar receive path commits these
-// bytes to SQLite with explicit lengths; anything Python's .decode()
-// would reject must bounce the batch to the object path instead.
-static bool utf8_ok(const uint8_t *s, size_t n) {
-  size_t i = 0;
-  while (i < n) {
-    uint8_t b = s[i];
-    if (b < 0x80) { i++; continue; }
-    if (b < 0xC2) return false;  // continuation byte or overlong 2-byte
-    if (b < 0xE0) {
-      if (i + 1 >= n || (s[i + 1] & 0xC0) != 0x80) return false;
-      i += 2;
-    } else if (b < 0xF0) {
-      if (i + 2 >= n) return false;
-      uint8_t b1 = s[i + 1], b2 = s[i + 2];
-      if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80) return false;
-      if (b == 0xE0 && b1 < 0xA0) return false;   // overlong
-      if (b == 0xED && b1 >= 0xA0) return false;  // surrogate
-      i += 3;
-    } else if (b < 0xF5) {
-      if (i + 3 >= n) return false;
-      uint8_t b1 = s[i + 1], b2 = s[i + 2], b3 = s[i + 3];
-      if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80 || (b3 & 0xC0) != 0x80)
-        return false;
-      if (b == 0xF0 && b1 < 0x90) return false;   // overlong
-      if (b == 0xF4 && b1 >= 0x90) return false;  // > U+10FFFF
-      i += 4;
-    } else {
-      return false;
-    }
-  }
-  return true;
-}
+// (utf8_ok lives in the anonymous namespace above, next to the
+// response walkers' shared capability validation.)
 
 // Columnar twin of ehc_decrypt_response for the fused receive→apply
 // path (reference sync.worker.ts:135-173 → receive.ts:144 →
@@ -1349,6 +1377,7 @@ int ehc_decrypt_response_columns(const uint8_t *resp, int64_t resp_len,
   intern.reserve(size_t(resp_len / 90) + 8);
   std::string keybuf;
 
+  int n_caps = 0;
   size_t pos = 0;
   while (pos < n_) {
     uint64_t key;
@@ -1365,6 +1394,13 @@ int ehc_decrypt_response_columns(const uint8_t *resp, int64_t resp_len,
     if (field == 2) {
       tree = body;  // last wins, like the Python decoder
       tree_len = blen;
+      continue;
+    }
+    if (field == 3) {
+      // Same capability validation as ehc_decrypt_response — the pure
+      // decoder raises on bad UTF-8 / >64 entries, so the fused path
+      // must never succeed on those shapes.
+      if (!capability_ok(body, blen, n_caps)) return 2;
       continue;
     }
     if (field != 1) continue;  // unknown length-delimited field: skip

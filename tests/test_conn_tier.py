@@ -1,0 +1,533 @@
+"""Event-loop connection tier (evolu_tpu/server/conn.py — ISSUE 13).
+
+Ground truth #1 — byte-identity: the event tier drives the UNCHANGED
+relay handler over an in-memory socket, so every endpoint's raw HTTP
+response (status line, headers, body) must equal the threaded tier's
+for the same request against the same store state, modulo only the
+Date header. The twin-relay oracle below drives one request sequence
+at both tiers over raw sockets and compares everything, then compares
+SQLite end state.
+
+Ground truth #2 — threads don't grow with connections: idle and
+parked connections are loop-owned; only the bounded handler pool ever
+runs request code. Asserted directly against threading.active_count.
+
+Ground truth #3 — slow-client hardening: a request must fully arrive
+within the read budget (absolute — a trickle can't slide it), headers
+are capped, oversized bodies are never buffered, a hung client can't
+pin anything. Raw-socket shapes for each.
+"""
+
+import json
+import re
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.server.relay import MAX_BODY_BYTES, RelayServer, RelayStore
+from evolu_tpu.sync import protocol
+
+BASE = 1_700_000_000_000
+NODE_A = "a" * 16
+NODE_B = "b" * 16
+FRESH = "f" * 16
+
+
+def _msgs(node: str, start: int, n: int):
+    return tuple(
+        protocol.EncryptedCrdtMessage(
+            timestamp_to_string(Timestamp(BASE + (start + i) * 1000, 0, node)),
+            b"ct-%d" % (start + i),
+        )
+        for i in range(n)
+    )
+
+
+def _raw_request(method: str, path: str, body: bytes = b"",
+                 headers=()) -> bytes:
+    lines = [f"{method} {path} HTTP/1.0",
+             "Content-Length: " + str(len(body))]
+    lines += [f"{k}: {v}" for k, v in headers]
+    return ("\r\n".join(lines) + "\r\n\r\n").encode() + body
+
+
+def _exchange(addr, raw: bytes, timeout: float = 30.0) -> bytes:
+    """Send one raw request, read the FULL raw response to EOF."""
+    with socket.create_connection(addr, timeout=timeout) as s:
+        s.sendall(raw)
+        out = bytearray()
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                return bytes(out)
+            out += chunk
+
+
+_DATE_RE = re.compile(rb"\r\nDate: [^\r\n]*")
+
+
+def _normalize(resp: bytes) -> bytes:
+    """Drop the only legitimately nondeterministic header."""
+    return _DATE_RE.sub(b"\r\nDate: -", resp)
+
+
+def _dump_store(store: RelayStore):
+    msgs = store.db.exec_sql_query(
+        'SELECT "timestamp", "userId", "content" FROM "message" '
+        'ORDER BY "userId", "timestamp"', ())
+    trees = store.db.exec_sql_query(
+        'SELECT "userId", "merkleTree" FROM "merkleTree" ORDER BY "userId"',
+        ())
+    return (
+        [(r["timestamp"], r["userId"], bytes(r["content"])) for r in msgs],
+        [(r["userId"], r["merkleTree"]) for r in trees],
+    )
+
+
+def _sync_body(owner: str, node: str, messages, tree: str = "{}") -> bytes:
+    return protocol.encode_sync_request(
+        protocol.SyncRequest(messages, owner, node, tree))
+
+
+def test_twin_relay_oracle_byte_identity():
+    """One request sequence, two tiers, every response byte-identical
+    (modulo Date) and both stores ending byte-identical."""
+    from evolu_tpu.server.replicate import ReplicationManager
+
+    def _twin(tier):
+        store = RelayStore()
+        # Pin the replica id: the gossip surface echoes it, and a
+        # random per-manager id would fail the byte compare for
+        # reasons that have nothing to do with the tier.
+        repl = ReplicationManager(store, [], replica_id="twin-relay")
+        return RelayServer(store, replication=repl,
+                           connection_tier=tier).start()
+
+    twins = [_twin(tier) for tier in ("threaded", "eventloop")]
+    try:
+        addrs = [s._httpd.server_address[:2] for s in twins]
+        requests = [
+            _raw_request("GET", "/ping"),
+            _raw_request("GET", "/health"),
+            # push rows for two owners, then pulls (cold + warm)
+            _raw_request("POST", "/", _sync_body("ow-1", NODE_A,
+                                                 _msgs(NODE_A, 0, 8))),
+            _raw_request("POST", "/", _sync_body("ow-2", NODE_B,
+                                                 _msgs(NODE_B, 100, 5))),
+            _raw_request("POST", "/", _sync_body("ow-1", FRESH, ())),
+            # duplicate delivery (idempotent ingest)
+            _raw_request("POST", "/", _sync_body("ow-1", NODE_A,
+                                                 _msgs(NODE_A, 0, 8))),
+            # capability-advertising request (negotiated echo appended)
+            _raw_request("POST", "/", _sync_body("ow-1", FRESH, ())
+                         + protocol.encode_request_capabilities(
+                             ("aead-batch-v1",))),
+            # malformed body → 500 shape; bad/negative Content-Length → 400
+            _raw_request("POST", "/", b"\xff\xfe\xfd"),
+            b"POST / HTTP/1.0\r\nContent-Length: nope\r\n\r\n",
+            b"POST / HTTP/1.0\r\nContent-Length: -5\r\n\r\n",
+            # oversized declaration → 413 without a body ever sent
+            b"POST / HTTP/1.0\r\nContent-Length: "
+            + str(MAX_BODY_BYTES + 1).encode() + b"\r\n\r\n",
+            # unknown path / method
+            _raw_request("GET", "/nope"),
+            _raw_request("PUT", "/"),
+            # replication listener surface: malformed → 400, valid
+            # summary and pull from an empty peer
+            _raw_request("POST", "/replicate/summary", b"\xff\xff"),
+            _raw_request("POST", "/replicate/summary",
+                         protocol.encode_replica_summary(
+                             protocol.ReplicaSummary((), "twin-peer"))),
+            _raw_request("POST", "/replicate/pull",
+                         protocol.encode_replica_pull(
+                             protocol.ReplicaPull(
+                                 (("ow-1", timestamp_to_string(
+                                     Timestamp(0, 0, "0" * 16))),),
+                                 "twin-peer"))),
+            _raw_request("POST", "/replicate/nope", b""),
+            # fleet surface without fleet: 404
+            _raw_request("POST", "/fleet/forward", b""),
+            _raw_request("GET", "/fleet"),
+            # push poll (immediate lanes only — parked polls are
+            # timing, not bytes): malformed query → 400, zero timeout
+            _raw_request("GET", "/push/poll?owner=ow-1&node=zz&cursor=0"),
+            _raw_request("GET", "/push/poll?owner=ow-1&node=" + FRESH
+                         + "&cursor=0&timeout=0"),
+            # stale cursor after the writes above → immediate wake
+            _raw_request("GET", "/push/poll?owner=ow-1&node=" + FRESH
+                         + "&cursor=-999&timeout=0"),
+        ]
+        for i, raw in enumerate(requests):
+            got = [_normalize(_exchange(a, raw)) for a in addrs]
+            assert got[0] == got[1], (
+                f"request #{i} diverged between tiers:\n"
+                f"threaded:  {got[0][:400]!r}\n"
+                f"eventloop: {got[1][:400]!r}"
+            )
+        assert _dump_store(twins[0].store) == _dump_store(twins[1].store)
+        d = _dump_store(twins[0].store)
+        assert len(d[0]) == 13 and len(d[1]) == 2  # 8+5 rows, 2 owners
+    finally:
+        for s in twins:
+            s.stop()
+
+
+def test_twin_oracle_observability_endpoints():
+    """/stats and /metrics between the tiers: same structure, same
+    deterministic fields (timing histograms and the tiers' own
+    counters differ by construction — the registry is process-global
+    and self-observing, so raw bytes cannot match; what must match is
+    that the tier serves the same payload shape unaltered)."""
+    twins = [
+        RelayServer(RelayStore(), connection_tier=tier).start()
+        for tier in ("threaded", "eventloop")
+    ]
+    try:
+        for srv in twins:
+            body = _sync_body("ow-s", NODE_A, _msgs(NODE_A, 0, 3))
+            with urllib.request.urlopen(
+                    urllib.request.Request(srv.url + "/", data=body),
+                    timeout=10) as r:
+                assert r.status == 200
+        stats = []
+        for srv in twins:
+            with urllib.request.urlopen(srv.url + "/stats", timeout=10) as r:
+                stats.append(json.loads(r.read()))
+        for st in stats:
+            assert st["messages"] == 3 and st["users"] == 1
+            assert "push" in st
+        assert "conn" in stats[1] and stats[1]["conn"]["tier"] == "eventloop"
+        assert "conn" not in stats[0]
+        proms = []
+        for srv in twins:
+            with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                proms.append(r.read().decode())
+        fams = [set(ln.split("{")[0].split(" ")[0] for ln in p.splitlines()
+                    if ln and not ln.startswith("#")) for p in proms]
+        assert fams[0] == fams[1]
+    finally:
+        for s in twins:
+            s.stop()
+
+
+# -- slow-client hardening (raw sockets) --
+
+
+@pytest.fixture()
+def fast_timeout_server():
+    from evolu_tpu.utils import config as cfg_mod
+
+    old = cfg_mod.default_config
+    c = cfg_mod.Config(conn_read_timeout_s=0.5, conn_write_timeout_s=0.5,
+                       conn_max_header_bytes=2048)
+    cfg_mod.set_config(c)
+    srv = RelayServer(RelayStore(), connection_tier="eventloop").start()
+    try:
+        yield srv
+    finally:
+        srv.stop()
+        cfg_mod.set_config(old)
+
+
+def test_partial_header_times_out(fast_timeout_server):
+    addr = fast_timeout_server._httpd.server_address[:2]
+    with socket.create_connection(addr, timeout=10) as s:
+        s.sendall(b"GET /ping HT")  # never finishes the request line
+        s.settimeout(5)
+        t0 = time.monotonic()
+        assert s.recv(100) == b""  # server closes, no response
+        assert 0.3 < time.monotonic() - t0 < 4.0
+    # The server is still healthy afterwards.
+    with urllib.request.urlopen(fast_timeout_server.url + "/ping",
+                                timeout=5) as r:
+        assert r.read() == b"ok"
+
+
+def test_partial_body_times_out(fast_timeout_server):
+    addr = fast_timeout_server._httpd.server_address[:2]
+    with socket.create_connection(addr, timeout=10) as s:
+        s.sendall(b"POST / HTTP/1.0\r\nContent-Length: 1000\r\n\r\nonly-a-bit")
+        s.settimeout(5)
+        assert s.recv(100) == b""
+
+
+def test_slow_trickle_cannot_slide_the_deadline(fast_timeout_server):
+    """The read budget is ABSOLUTE: byte-per-100ms progress must not
+    keep the connection alive past it (the slowloris shape)."""
+    addr = fast_timeout_server._httpd.server_address[:2]
+    with socket.create_connection(addr, timeout=10) as s:
+        s.settimeout(0.1)
+        t0 = time.monotonic()
+        closed_at = None
+        payload = b"GET /ping HTTP/1.0\r\nX-Slow: " + b"x" * 500
+        i = 0
+        while time.monotonic() - t0 < 4.0:
+            try:
+                s.sendall(payload[i:i + 1])
+                i = min(i + 1, len(payload) - 1)
+            except OSError:
+                closed_at = time.monotonic() - t0
+                break
+            try:
+                if s.recv(100) == b"":
+                    closed_at = time.monotonic() - t0
+                    break
+            except socket.timeout:
+                pass
+        assert closed_at is not None and closed_at < 3.0, \
+            "trickling client outlived the absolute read budget"
+
+
+def test_header_overflow_answers_431(fast_timeout_server):
+    addr = fast_timeout_server._httpd.server_address[:2]
+    raw = b"GET /ping HTTP/1.0\r\nX-Big: " + b"x" * 4096 + b"\r\n\r\n"
+    resp = _exchange(addr, raw, timeout=10)
+    assert resp.startswith(b"HTTP/1.0 431")
+
+
+def test_mid_response_hangup_is_cleaned_up(fast_timeout_server):
+    """Client vanishes after sending a full request: the tier serves
+    into a dead socket, observes the failure, and stays healthy."""
+    addr = fast_timeout_server._httpd.server_address[:2]
+    for _ in range(8):
+        s = socket.create_connection(addr, timeout=10)
+        s.sendall(_raw_request("GET", "/ping"))
+        s.close()  # hang up before reading
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(fast_timeout_server.url + "/stats",
+                                    timeout=5) as r:
+            st = json.loads(r.read())
+        if st["conn"]["open_connections"] == 1:  # just this scrape
+            break
+        time.sleep(0.05)
+    assert st["conn"]["open_connections"] == 1
+
+
+def test_idle_connections_do_not_grow_threads():
+    """The tentpole's core claim at test scale (the bench drives 10^4):
+    hundreds of parked long-polls add ZERO threads, and every one of
+    them still gets its wakeup."""
+    srv = RelayServer(RelayStore(), connection_tier="eventloop").start()
+    try:
+        addr = srv._httpd.server_address[:2]
+        # Warm the pool: a couple of real requests.
+        for _ in range(3):
+            with urllib.request.urlopen(srv.url + "/ping", timeout=5):
+                pass
+        baseline = threading.active_count()
+        socks = []
+        n = 256
+        for i in range(n):
+            s = socket.create_connection(addr, timeout=10)
+            s.sendall(_raw_request(
+                "GET", f"/push/poll?owner=ow-idle&node={NODE_B}"
+                       f"&cursor=0&timeout=30"))
+            socks.append(s)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if srv.push_hub.stats_payload()["subscriptions"] == n:
+                break
+            time.sleep(0.02)
+        assert srv.push_hub.stats_payload()["subscriptions"] == n
+        grown = threading.active_count() - baseline
+        assert grown <= 0, f"{grown} threads grew with {n} idle connections"
+        # One mutation wakes them all (authored by a different node).
+        body = _sync_body("ow-idle", NODE_A, _msgs(NODE_A, 0, 1))
+        with urllib.request.urlopen(
+                urllib.request.Request(srv.url + "/", data=body),
+                timeout=10) as r:
+            assert r.status == 200
+        woken = 0
+        for s in socks:
+            s.settimeout(10)
+            resp = bytearray()
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                resp += chunk
+            head, _, payload = bytes(resp).partition(b"\r\n\r\n")
+            assert head.startswith(b"HTTP/1.0 200")
+            if json.loads(payload)["wake"]:
+                woken += 1
+            s.close()
+        assert woken == n
+    finally:
+        srv.stop()
+
+
+def test_dispatch_admission_sheds_503():
+    """Past max_pending in-flight dispatches the LOOP answers 503 +
+    Retry-After itself — a request flood can't buffer without bound."""
+    from evolu_tpu.utils import config as cfg_mod
+
+    old = cfg_mod.default_config
+    cfg_mod.set_config(cfg_mod.Config(conn_handler_threads=1,
+                                      conn_max_pending=2))
+    srv = RelayServer(RelayStore(), connection_tier="eventloop").start()
+    try:
+        addr = srv._httpd.server_address[:2]
+        # Stall the single handler thread with a parked threaded-style
+        # request? No — fill the pipeline with real posts instead: one
+        # slow-ish body each; with 1 worker and max_pending=2 a burst
+        # must shed some 503s while still serving the rest.
+        results = []
+        lock = threading.Lock()
+
+        def one(i):
+            raw = _raw_request("POST", "/", _sync_body(
+                f"ow-{i}", NODE_A, _msgs(NODE_A, i * 10, 4)))
+            resp = _exchange(addr, raw, timeout=30)
+            with lock:
+                results.append(resp.split(b" ", 2)[1])
+
+        threads = [threading.Thread(target=one, args=(i,)) for i in range(24)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        codes = {c: sum(1 for x in results if x == c) for c in set(results)}
+        assert codes.get(b"200", 0) >= 1
+        assert set(codes) <= {b"200", b"503"}, codes
+        # Whatever shed carried the backpressure contract.
+        if codes.get(b"503"):
+            from evolu_tpu.obs import metrics
+
+            assert metrics.get_counter("evolu_conn_shed_total") > 0
+    finally:
+        srv.stop()
+        cfg_mod.set_config(old)
+
+
+def test_scheduler_batching_rides_the_event_tier():
+    """The PR-2 admission path unchanged underneath: a batching relay
+    on the event tier serves concurrent distinct-owner posts through
+    fused engine passes, byte-identical to the per-request oracle."""
+    oracle = RelayServer(RelayStore(), connection_tier="threaded").start()
+    srv = RelayServer(RelayStore(), batching=True,
+                      connection_tier="eventloop").start()
+    try:
+        bodies = {f"ow-{i}": _sync_body(f"ow-{i}", NODE_A,
+                                        _msgs(NODE_A, i * 100, 6))
+                  for i in range(12)}
+        expect = {}
+        for owner, body in bodies.items():
+            with urllib.request.urlopen(
+                    urllib.request.Request(oracle.url + "/", data=body),
+                    timeout=30) as r:
+                expect[owner] = r.read()
+        got = {}
+        lock = threading.Lock()
+
+        def post(owner, body):
+            with urllib.request.urlopen(
+                    urllib.request.Request(srv.url + "/", data=body),
+                    timeout=30) as r:
+                data = r.read()
+            with lock:
+                got[owner] = data
+
+        threads = [threading.Thread(target=post, args=kv)
+                   for kv in bodies.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert got == expect
+        assert _dump_store(srv.store) == _dump_store(oracle.store)
+    finally:
+        srv.stop()
+        oracle.stop()
+
+
+# -- review-fix regressions --
+
+
+def test_push_poll_with_huge_content_length_does_not_pin_the_pool():
+    """A GET /push/poll declaring an absurd Content-Length must still
+    park IN-LOOP (never ride the headers-only 413 dispatch into the
+    bounded pool, where poll_blocking would pin a handler thread)."""
+    from evolu_tpu.utils import config as cfg_mod
+
+    old = cfg_mod.default_config
+    cfg_mod.set_config(cfg_mod.Config(conn_handler_threads=1))
+    srv = RelayServer(RelayStore(), connection_tier="eventloop").start()
+    try:
+        addr = srv._httpd.server_address[:2]
+        socks = []
+        for _ in range(4):  # 4 > the single pool thread
+            s = socket.create_connection(addr, timeout=10)
+            s.sendall(
+                b"GET /push/poll?owner=ow&node=" + NODE_B.encode()
+                + b"&cursor=0&timeout=20 HTTP/1.0\r\n"
+                  b"Content-Length: 99999999999\r\n\r\n")
+            socks.append(s)
+        deadline = time.monotonic() + 5
+        while srv.push_hub.stats_payload()["subscriptions"] != 4:
+            assert time.monotonic() < deadline, \
+                srv.push_hub.stats_payload()
+            time.sleep(0.02)
+        # The single pool thread is free: a normal request answers.
+        with urllib.request.urlopen(srv.url + "/ping", timeout=5) as r:
+            assert r.read() == b"ok"
+        # And the parks resolve on notify like any other poll.
+        body = _sync_body("ow", NODE_A, _msgs(NODE_A, 0, 1))
+        with urllib.request.urlopen(
+                urllib.request.Request(srv.url + "/", data=body),
+                timeout=10) as r:
+            assert r.status == 200
+        for s in socks:
+            s.settimeout(10)
+            resp = bytearray()
+            while True:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                resp += chunk
+            assert b'"wake": true' in bytes(resp)
+            s.close()
+    finally:
+        srv.stop()
+        cfg_mod.set_config(old)
+
+
+def test_parked_connection_cannot_buffer_unbounded_bytes():
+    """Bytes streamed AFTER a complete request are discarded, and a
+    flood past the post-request allowance closes the connection and
+    frees its subscription."""
+    srv = RelayServer(RelayStore(), connection_tier="eventloop").start()
+    try:
+        addr = srv._httpd.server_address[:2]
+        s = socket.create_connection(addr, timeout=10)
+        s.sendall(_raw_request(
+            "GET", f"/push/poll?owner=ow&node={NODE_B}&cursor=0&timeout=30"))
+        deadline = time.monotonic() + 5
+        while srv.push_hub.stats_payload()["subscriptions"] != 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        # Flood ~1 MB of garbage down the parked connection.
+        closed = False
+        try:
+            for _ in range(16):
+                s.sendall(b"x" * 65536)
+                time.sleep(0.01)
+        except OSError:
+            closed = True
+        deadline = time.monotonic() + 5
+        while srv.push_hub.stats_payload()["subscriptions"] != 0:
+            assert time.monotonic() < deadline, \
+                "flooding parked subscription was not cancelled"
+            time.sleep(0.02)
+        s.close()
+        assert closed or True  # send() may succeed into the RST window
+        # Relay healthy after.
+        with urllib.request.urlopen(srv.url + "/ping", timeout=5) as r:
+            assert r.read() == b"ok"
+    finally:
+        srv.stop()

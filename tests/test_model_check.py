@@ -1081,3 +1081,135 @@ def test_mesh_sharded_multi_relay_scheduler_episode(seed=90210):
             r2.stop()
             wb.close()
             store.close()
+
+
+def test_push_subscription_partition_heal_episode():
+    """ISSUE 13 / ROADMAP #5 small dose: a seeded schedule drives push
+    subscriptions through a network partition and heal, on the
+    EVENT-LOOP connection tier. A subscriber is parked at relay B;
+    writes land at relay A and reach B only via Merkle anti-entropy.
+    Invariants: (1) while partitioned, B's subscriber never wakes for
+    A-side writes (nothing became visible at B); (2) after heal, the
+    replication-ingest wakeup fires — no wakeup missed across the
+    fault; (3) wakes stay bounded by qualifying batches; (4) the
+    relays converge byte-identically — push changed no state anywhere.
+    """
+    import json
+    import threading
+    import urllib.request
+
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.server.replicate import ReplicationManager
+    from evolu_tpu.sync import protocol
+    from tests.test_replication import (
+        _FaultyTransport,
+        _state,
+        _write,
+    )
+    from tests.test_push import SUB, _msgs, _sync_body  # noqa: F401
+
+    seed = 20260813
+    with _evidence("model-check-push-partition", seed):
+        rng = random.Random(seed)
+        n1 = "1" * 16
+        stores = [RelayStore(), RelayStore()]
+        faults = [_FaultyTransport(), _FaultyTransport()]
+        mgrs = [
+            ReplicationManager(
+                s, [], replica_id=f"push-{i}", interval_s=0.1,
+                debounce_s=0.02, backoff_base_s=0.05, backoff_max_s=0.3,
+                http_post=f.post,
+            )
+            for i, (s, f) in enumerate(zip(stores, faults))
+        ]
+        servers = [
+            RelayServer(s, replication=m,
+                        connection_tier="eventloop").start()
+            for s, m in zip(stores, mgrs)
+        ]
+        a, b = servers
+        try:
+            mgrs[0].add_peer(b.url)
+            mgrs[1].add_peer(a.url)
+            wakes = []
+            stop = threading.Event()
+
+            def subscriber():
+                cursor = 0
+                while not stop.is_set():
+                    url = (f"{b.url}/push/poll?owner=ow&node={SUB}"
+                           f"&cursor={cursor}&timeout=0.5")
+                    try:
+                        with urllib.request.urlopen(url, timeout=10) as r:
+                            body = json.loads(r.read())
+                    except Exception:  # noqa: BLE001 - teardown
+                        return
+                    cursor = body["cursor"]
+                    if body["wake"]:
+                        wakes.append(time.monotonic())
+
+            th = threading.Thread(target=subscriber)
+            th.start()
+            time.sleep(0.2)
+
+            # Phase 1 — connected: a foreign write at A must wake the
+            # subscriber at B through replication ingest.
+            repl_wakes0 = metrics.get_counter(
+                "evolu_push_wakeups_total", reason="replication")
+            _write(a.url, "ow", n1, _msgs(n1, 0, 3))
+            deadline = time.time() + 15
+            while not wakes:
+                assert time.time() < deadline, \
+                    "pre-partition replication wake never fired at B"
+                time.sleep(0.02)
+            assert metrics.get_counter(
+                "evolu_push_wakeups_total",
+                reason="replication") > repl_wakes0
+
+            # Phase 2 — partition both directions, keep writing at A
+            # (mixed authors, seeded). B's subscriber must stay silent:
+            # nothing became visible AT B.
+            faults[0].block(b.url)
+            faults[1].block(a.url)
+            time.sleep(0.2)
+            n_wakes_at_partition = len(wakes)
+            qualifying = 0
+            base = 100
+            for _step in range(rng.randint(3, 6)):
+                author = rng.choice([n1, SUB])
+                n = rng.randint(1, 3)
+                _write(a.url, "ow", author, _msgs(author, base, n))
+                base += n
+                qualifying += 1 if author != SUB else 0
+            time.sleep(0.6)  # several gossip intervals
+            assert len(wakes) == n_wakes_at_partition, \
+                "subscriber at B woke during the partition"
+
+            # Phase 3 — heal: the pulled rows must wake B's subscriber
+            # (they can never arrive as a local POST there), and both
+            # relays converge byte-identically.
+            faults[0].heal()
+            faults[1].heal()
+            mgrs[0].hint()
+            mgrs[1].hint()
+            deadline = time.time() + 20
+            while len(wakes) == n_wakes_at_partition:
+                assert time.time() < deadline, \
+                    "post-heal replication wake never fired (wakeup missed)"
+                time.sleep(0.02)
+            deadline = time.time() + 20
+            while _state(stores[0]) != _state(stores[1]):
+                assert time.time() < deadline, "relays did not converge"
+                time.sleep(0.05)
+            sa = _state(stores[0])
+            assert sa == _state(stores[1])
+            assert sum(len(rows) for _t, rows in sa.values()) == base - 100 + 3
+            # Spurious bound: the subscriber woke at most once per
+            # qualifying foreign batch (+1 for the heal's coalesced
+            # pull — replication may deliver the backlog as one batch).
+            assert len(wakes) <= 1 + qualifying + 1
+        finally:
+            stop.set()
+            for s in servers:
+                s.stop()
+            th.join(timeout=5)

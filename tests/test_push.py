@@ -1,0 +1,512 @@
+"""Push subscriptions (evolu_tpu/server/push.py + the client leg in
+sync/client.py — ISSUE 13).
+
+Semantic ground truth — wakeup == changed-set oracle at the relay's
+E2EE granularity: a parked subscription (owner O, node N) must wake
+for EXACTLY the batches that make rows visible for O authored by a
+node other than N ("no wakeup missed"), and never more often than
+once per such batch ("spurious wakeups bounded"). Anti-entropy stays
+the correctness mechanism (the sync round a wake triggers is the same
+round a timer would fire), so every lane here is about latency
+precision, with the conservative over-approximations explicitly
+pinned: unknown authors wake everyone, an out-ringed cursor wakes
+conservatively, a snapshot install wakes everything.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.server import push as push_mod
+from evolu_tpu.server.push import HubFull, PushHub, parse_poll_query
+from evolu_tpu.server.relay import RelayServer, RelayStore
+from evolu_tpu.sync import protocol
+from evolu_tpu.sync.client import PushSubscriber
+from evolu_tpu.utils.config import Config, FleetConfig
+
+BASE = 1_730_000_000_000
+NODE_A = "a" * 16
+NODE_B = "b" * 16
+SUB = "5" * 16  # the subscriber's node
+
+
+def _ts(node: str, i: int) -> str:
+    return timestamp_to_string(Timestamp(BASE + i * 1000, 0, node))
+
+
+def _msgs(node: str, start: int, n: int):
+    return tuple(
+        protocol.EncryptedCrdtMessage(_ts(node, start + i), b"c%d" % (start + i))
+        for i in range(n)
+    )
+
+
+def _sync_body(owner, node, messages, tree="{}"):
+    return protocol.encode_sync_request(
+        protocol.SyncRequest(messages, owner, node, tree))
+
+
+# -- hub unit surface --
+
+
+def test_hub_wake_and_own_write_exclusion():
+    hub = PushHub()
+    results = {}
+
+    def poll(name, node, cursor, timeout):
+        results[name] = json.loads(hub.poll_blocking("o", node, cursor, timeout))
+
+    t = threading.Thread(target=poll, args=("sub", SUB, 0, 5.0))
+    t.start()
+    time.sleep(0.1)
+    # Self-authored batch: parked subscriber must NOT wake.
+    assert hub.notify("o", [_ts(SUB, 0)]) == 0
+    # Foreign batch wakes it.
+    assert hub.notify("o", [_ts(NODE_A, 1)]) == 1
+    t.join(timeout=5)
+    assert results["sub"] == {"wake": True, "cursor": 2}
+    # Resume from that cursor: nothing new → parks → times out false.
+    body = json.loads(hub.poll_blocking("o", SUB, 2, 0.1))
+    assert body == {"wake": False, "cursor": 2}
+    # A cursor behind events that were ALL self-authored: no wake, but
+    # the returned cursor advances past them.
+    hub.notify("o", [_ts(SUB, 2)])
+    body = json.loads(hub.poll_blocking("o", SUB, 2, 0.1))
+    assert body == {"wake": False, "cursor": 3}
+    # Mixed batch (self + foreign) wakes: any foreign row qualifies.
+    t2 = threading.Thread(target=poll, args=("sub2", SUB, 3, 5.0))
+    t2.start()
+    time.sleep(0.05)
+    hub.notify("o", [_ts(SUB, 3), _ts(NODE_B, 4)])
+    t2.join(timeout=5)
+    assert results["sub2"]["wake"] is True
+
+
+def test_hub_immediate_answers_and_stale_cursor():
+    hub = PushHub()
+    hub.notify("o", [_ts(NODE_A, 0)])
+    # Events already past the cursor: answered without parking.
+    assert json.loads(hub.poll_blocking("o", SUB, 0, 5.0)) == {
+        "wake": True, "cursor": 1}
+    # Unknown-author batch wakes even the author-matching node.
+    hub.notify("o", None)
+    assert json.loads(hub.poll_blocking("o", NODE_A, 1, 5.0))["wake"] is True
+    # A cursor the bounded ring outgrew: conservative wake, never a miss.
+    for i in range(push_mod.EVENT_RING + 10):
+        hub.notify("o", [_ts(SUB, i)])  # all self-authored!
+    body = json.loads(hub.poll_blocking("o", SUB, 1, 5.0))
+    assert body["wake"] is True  # can't prove self-only → wake
+
+
+def test_hub_capacity_and_close():
+    hub = PushHub(max_subscriptions=2)
+    t1 = threading.Thread(
+        target=lambda: hub.poll_blocking("o1", SUB, 0, 5.0))
+    t2 = threading.Thread(
+        target=lambda: hub.poll_blocking("o2", SUB, 0, 5.0))
+    t1.start(), t2.start()
+    deadline = time.monotonic() + 5
+    while hub.stats_payload()["subscriptions"] < 2:
+        assert time.monotonic() < deadline
+        time.sleep(0.01)
+    with pytest.raises(HubFull):
+        hub.poll_blocking("o3", SUB, 0, 5.0)
+    hub.close()  # resolves both parks with wake=false
+    t1.join(timeout=5), t2.join(timeout=5)
+    assert not t1.is_alive() and not t2.is_alive()
+    assert hub.stats_payload()["subscriptions"] == 0
+
+
+def test_parse_poll_query_contract():
+    assert parse_poll_query(f"owner=o&node={SUB}&cursor=3") == ("o", SUB, 3, None)
+    assert parse_poll_query(
+        f"owner=o&node={SUB}&cursor=0&timeout=2.5") == ("o", SUB, 0, 2.5)
+    for bad in ("", "owner=o", f"owner=o&node=XYZ&cursor=0",
+                f"owner=o&node={SUB}&cursor=x",
+                f"owner=o&node={SUB}&cursor=0&timeout=nan",
+                f"owner=o&node={SUB}&cursor=0&timeout=-1",
+                f"owner=o&node={'A' * 16}&cursor=0"):
+        with pytest.raises(ValueError):
+            parse_poll_query(bad)
+
+
+# -- wakeup == changed-set oracle, through a live relay --
+
+
+@pytest.mark.parametrize("tier", ["threaded", "eventloop"])
+def test_wakeups_match_changed_set_oracle(tier):
+    """A seeded mutation schedule against a live relay: the subscriber
+    (long-polling continuously) must wake at least once after every
+    foreign-authored batch (no miss), never for self-only batches, and
+    no more than once per qualifying batch overall (spurious bound)."""
+    import random
+
+    rng = random.Random(20260804)
+    srv = RelayServer(RelayStore(), connection_tier=tier).start()
+    wakes = []
+    stop = threading.Event()
+
+    def subscriber():
+        cursor = 0
+        while not stop.is_set():
+            url = (f"{srv.url}/push/poll?owner=ow&node={SUB}"
+                   f"&cursor={cursor}&timeout=1.0")
+            try:
+                with urllib.request.urlopen(url, timeout=10) as r:
+                    body = json.loads(r.read())
+            except Exception:  # noqa: BLE001 - server stopping
+                return
+            cursor = body["cursor"]
+            if body["wake"]:
+                wakes.append(time.monotonic())
+
+    th = threading.Thread(target=subscriber)
+    th.start()
+    try:
+        time.sleep(0.2)  # let the first poll park
+        foreign_batches = 0
+        i = 0
+        for _step in range(12):
+            author = rng.choice([SUB, NODE_A, NODE_B])
+            n = rng.randint(1, 4)
+            body = _sync_body("ow", author, _msgs(author, i, n))
+            i += n
+            before = len(wakes)
+            with urllib.request.urlopen(
+                    urllib.request.Request(srv.url + "/", data=body),
+                    timeout=10) as r:
+                assert r.status == 200
+            if author != SUB:
+                foreign_batches += 1
+                # No wakeup missed: the parked subscriber (or its next
+                # poll via cursor) must observe this batch.
+                deadline = time.monotonic() + 5
+                while len(wakes) == before:
+                    assert time.monotonic() < deadline, \
+                        f"missed wakeup for foreign batch at step {_step}"
+                    time.sleep(0.01)
+            else:
+                # Self-only batch: give a wrongful wake a moment to
+                # appear, then assert it didn't.
+                time.sleep(0.15)
+                assert len(wakes) == before, \
+                    "subscriber woke for its own writes"
+        # Spurious bound: at most one wake per qualifying batch.
+        assert len(wakes) <= foreign_batches
+        assert foreign_batches > 0
+    finally:
+        stop.set()
+        srv.stop()
+        th.join(timeout=5)
+
+
+# -- fleet interplay: the subscription follows placement --
+
+
+@pytest.mark.parametrize("forward", [False, True])
+def test_push_poll_follows_fleet_placement(forward):
+    """A poll landing on a non-placed relay answers 307 to the placed
+    one — in forward mode too (a proxied long-poll would pin the hop).
+    A mutation arriving at the placed relay (directly or via
+    forward/redirect routing) wakes the parked subscription there."""
+    a = RelayServer(RelayStore(), connection_tier="eventloop")
+    b = RelayServer(RelayStore(), connection_tier="eventloop")
+    cfg = FleetConfig(relays=(a.url, b.url), replication_factor=1,
+                      forward=forward)
+    a.enable_fleet(cfg)
+    b.enable_fleet(cfg)
+    a.start(), b.start()
+    try:
+        ring = a.fleet.ring
+        owner = next(f"own-{i}" for i in range(1000)
+                     if ring.placement(f"own-{i}")[0] == b.url)
+        wrong, right = a, b
+        # Poll at the WRONG relay: 307 naming the placed one.
+        import urllib.error
+
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **k):
+                return None
+
+        opener = urllib.request.build_opener(NoRedirect)
+        path = f"/push/poll?owner={owner}&node={SUB}&cursor=0&timeout=5"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            opener.open(wrong.url + path, timeout=10)
+        assert ei.value.code == 307
+        assert ei.value.headers["Location"] == right.url + path
+        # Park at the RIGHT relay; write through the WRONG one (the
+        # fleet routes it — forward or redirect) and assert the wake.
+        result = {}
+
+        def poll():
+            with urllib.request.urlopen(right.url + path, timeout=15) as r:
+                result["body"] = json.loads(r.read())
+
+        th = threading.Thread(target=poll)
+        th.start()
+        deadline = time.monotonic() + 5
+        while right.push_hub.stats_payload()["subscriptions"] != 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        body = _sync_body(owner, NODE_A, _msgs(NODE_A, 0, 2))
+        if forward:
+            with urllib.request.urlopen(
+                    urllib.request.Request(wrong.url + "/", data=body),
+                    timeout=10) as r:
+                assert r.status == 200
+        else:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                opener.open(urllib.request.Request(
+                    wrong.url + "/", data=body), timeout=10)
+            assert ei.value.code == 307
+            with urllib.request.urlopen(
+                    urllib.request.Request(right.url + "/", data=body),
+                    timeout=10) as r:
+                assert r.status == 200
+        th.join(timeout=10)
+        assert result["body"]["wake"] is True
+    finally:
+        a.stop(), b.stop()
+
+
+# -- client subscriber --
+
+
+def test_client_subscriber_wakes_and_resumes():
+    srv = RelayServer(RelayStore(), connection_tier="eventloop").start()
+    woken = threading.Event()
+    try:
+        sub = PushSubscriber(Config(sync_url=srv.url), woken.set,
+                             poll_timeout_s=2.0)
+        sub.ensure("ow", SUB, srv.url)
+        time.sleep(0.2)
+        with urllib.request.urlopen(urllib.request.Request(
+                srv.url + "/", data=_sync_body("ow", NODE_A,
+                                               _msgs(NODE_A, 0, 1))),
+                timeout=10) as r:
+            assert r.status == 200
+        assert woken.wait(5), "push wake never fired"
+        assert sub.cursor >= 1
+        # Resume: a second foreign write wakes again from the new cursor.
+        woken.clear()
+        with urllib.request.urlopen(urllib.request.Request(
+                srv.url + "/", data=_sync_body("ow", NODE_A,
+                                               _msgs(NODE_A, 10, 1))),
+                timeout=10) as r:
+            assert r.status == 200
+        assert woken.wait(5)
+        sub.stop()
+    finally:
+        srv.stop()
+
+
+def test_client_subscriber_survives_outage_with_backoff():
+    """Relay unreachable: the loop backs off (never spins), then
+    resumes — with its cursor — once polls succeed again."""
+    calls = []
+    gate = {"fail": True}
+
+    def fake_get(url, timeout):
+        calls.append((time.monotonic(), url))
+        if gate["fail"]:
+            raise OSError("refused")
+        return json.dumps({"wake": True, "cursor": 7}).encode()
+
+    woken = threading.Event()
+    sub = PushSubscriber(Config(sync_url="http://127.0.0.1:9"),
+                         woken.set, http_get=fake_get, poll_timeout_s=0.2)
+    sub.ensure("ow", SUB, "http://127.0.0.1:9")
+    time.sleep(1.0)
+    n_during_outage = len(calls)
+    assert 1 <= n_during_outage <= 12, \
+        f"{n_during_outage} polls in 1s of outage — backoff missing"
+    gate["fail"] = False
+    assert woken.wait(10)
+    assert sub.cursor == 7
+    assert "cursor=0" in calls[0][1]
+    sub.stop()
+
+
+def test_client_subscriber_follows_307():
+    import urllib.error
+    from email.message import Message
+
+    target = {"hits": []}
+
+    def fake_get(url, timeout):
+        target["hits"].append(url)
+        if url.startswith("http://wrong"):
+            hdrs = Message()
+            hdrs["Location"] = "http://right:1/push/poll?x=1"
+            raise urllib.error.HTTPError(url, 307, "moved", hdrs, None)
+        return json.dumps({"wake": False, "cursor": 0}).encode()
+
+    sub = PushSubscriber(Config(sync_url="http://wrong:1"),
+                         lambda: None, http_get=fake_get,
+                         poll_timeout_s=0.1)
+    sub.ensure("ow", SUB, "http://wrong:1")
+    deadline = time.monotonic() + 5
+    while not any(u.startswith("http://right:1/push/poll")
+                  for u in target["hits"]):
+        assert time.monotonic() < deadline, target["hits"]
+        time.sleep(0.02)
+    sub.stop()
+
+
+def test_connect_wires_push_subscribe():
+    """Config.push_subscribe: the transport binds the subscriber from
+    its first successful round, and a foreign mutation then reaches
+    the client without any explicit sync — the full client loop."""
+    from evolu_tpu.api.query import table
+    from evolu_tpu.runtime.client import create_evolu
+    from evolu_tpu.sync.client import connect
+
+    schema = {"todo": ("title", "isCompleted", "createdAt", "updatedAt",
+                       "isDeleted", "createdBy")}
+    srv = RelayServer(RelayStore(), connection_tier="eventloop").start()
+    cfg = Config(sync_url=srv.url, push_subscribe=True)
+    a = create_evolu(schema, config=cfg)
+    b = create_evolu(schema, config=cfg, mnemonic=a.owner.mnemonic)
+    ta, tb = connect(a), connect(b)
+    try:
+        assert tb.push_subscriber is not None
+        a.sync(refresh_queries=False)
+        b.sync(refresh_queries=False)
+        a.worker.flush(); ta.flush(); b.worker.flush(); tb.flush()
+        q = table("todo").select("title").serialize()
+        a.create("todo", {"title": "pushed", "isCompleted": False})
+        a.worker.flush(); ta.flush()
+        deadline = time.monotonic() + 10
+        rows = []
+        while time.monotonic() < deadline:
+            rows = b.query_once(q)
+            if rows:
+                break
+            time.sleep(0.05)
+        assert rows == [{"title": "pushed"}]
+        assert tb.push_subscriber.wakes >= 1
+        # Own-write exclusion end to end: A's subscriber was not woken
+        # by A's own mutation (B's ack rows may wake it later, so pin
+        # only the pre-convergence window semantics via the counter
+        # BEFORE b writes anything).
+        assert ta.push_subscriber.wakes == 0
+    finally:
+        a.dispose(); b.dispose(); srv.stop()
+
+
+# -- review-fix regressions --
+
+
+def test_cursor_from_newer_epoch_wakes_conservatively():
+    """A cursor AHEAD of the channel (minted by another hub epoch —
+    relay restart, retarget) must wake conservatively, never park as
+    'seen everything' (the missed-wakeup hole)."""
+    hub = PushHub()
+    hub.notify("o", [_ts(NODE_A, 0)])  # seq = 1
+    body = json.loads(hub.poll_blocking("o", SUB, 999, 5.0))
+    assert body["wake"] is True and body["cursor"] == 1
+    # And with no channel at all, a stale-epoch cursor parks safely:
+    # the first foreign notify wakes by author, cursor-independent.
+    t = threading.Thread(
+        target=lambda: hub.poll_blocking("fresh", SUB, 999, 5.0))
+    t.start()
+    time.sleep(0.1)
+    assert hub.notify("fresh", [_ts(NODE_A, 0)]) == 1
+    t.join(timeout=5)
+
+
+def test_client_adopts_smaller_cursor_after_relay_restart():
+    """The subscriber must ADOPT the relay's cursor (per-hub epochs),
+    not max() it — else post-restart polls carry the dead epoch's
+    cursor forever."""
+    seen = []
+
+    def fake_get(url, timeout):
+        seen.append(url)
+        if len(seen) == 1:
+            return json.dumps({"wake": True, "cursor": 500}).encode()
+        return json.dumps({"wake": False, "cursor": 2}).encode()
+
+    sub = PushSubscriber(Config(sync_url="http://x:1"), lambda: None,
+                         http_get=fake_get, poll_timeout_s=0.1)
+    sub.ensure("ow", SUB, "http://x:1")
+    deadline = time.monotonic() + 5
+    while len(seen) < 3:
+        assert time.monotonic() < deadline
+        time.sleep(0.02)
+    sub.stop()
+    assert sub.cursor == 2
+    assert any("cursor=2" in u for u in seen[2:])
+
+
+def test_client_307_pingpong_is_bounded():
+    """Two relays 307-ing at each other (mid-rebalance ring
+    disagreement) must not spin a hot request loop: the second
+    consecutive 307 drops the route and backs off."""
+    import urllib.error
+    from email.message import Message
+
+    calls = []
+
+    def fake_get(url, timeout):
+        calls.append(time.monotonic())
+        hdrs = Message()
+        other = "http://b:1" if url.startswith("http://a:1") else "http://a:1"
+        hdrs["Location"] = other + "/push/poll?x=1"
+        raise urllib.error.HTTPError(url, 307, "moved", hdrs, None)
+
+    sub = PushSubscriber(Config(sync_url="http://a:1"), lambda: None,
+                         http_get=fake_get, poll_timeout_s=0.1)
+    sub.ensure("ow", SUB, "http://a:1")
+    time.sleep(1.0)
+    sub.stop()
+    assert len(calls) <= 30, \
+        f"{len(calls)} requests in 1s of 307 ping-pong — no backoff"
+
+
+def test_notify_all_reaches_between_polls_subscribers():
+    """A snapshot install (notify_all) must be observable by a
+    subscriber that was BETWEEN polls at the time — for owners with an
+    existing channel (bumped) AND for owners the hub never saw a
+    notify for (conservative first-poll wake after any install)."""
+    hub = PushHub()
+    # Known owner: subscriber synced before (channel exists), is
+    # between polls when the install lands.
+    hub.notify("known", [_ts(SUB, 0)])
+    cursor = json.loads(hub.poll_blocking("known", SUB, 0, 0.05))["cursor"]
+    hub.notify_all()
+    body = json.loads(hub.poll_blocking("known", SUB, cursor, 5.0))
+    assert body["wake"] is True, "install missed for a known owner"
+    # Never-seen owner: no channel at all at install time.
+    body = json.loads(hub.poll_blocking("unseen", SUB, 0, 5.0))
+    assert body["wake"] is True, "install missed for a never-seen owner"
+    # The conservative wake self-terminates: next poll parks normally.
+    body2 = json.loads(hub.poll_blocking("unseen", SUB, body["cursor"], 0.05))
+    assert body2["wake"] is False
+
+
+def test_expiry_heap_handles_many_staggered_parks():
+    """Staggered event-tier parks expire individually (lazy-deletion
+    heap) and a wakeup between expiries is never blocked or lost."""
+    hub = PushHub()
+    resolved = []
+    hub.on_wake = lambda token, body: resolved.append(
+        (token, json.loads(body)))
+    for i in range(50):
+        kind, _ = hub.park(f"o{i}", SUB, 0, 0.05 + i * 0.01, token=f"t{i}")
+        assert kind == "parked"
+    # Wake one mid-schedule before its expiry.
+    hub.notify("o40", [_ts(NODE_A, 0)])
+    deadline = time.monotonic() + 10
+    while len(resolved) < 50:
+        hub.expire_due()
+        assert time.monotonic() < deadline, len(resolved)
+        time.sleep(0.01)
+    woken = {t: b for t, b in resolved}
+    assert woken["t40"]["wake"] is True
+    assert sum(1 for b in woken.values() if not b["wake"]) == 49
+    assert hub.stats_payload()["subscriptions"] == 0

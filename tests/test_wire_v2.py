@@ -493,20 +493,15 @@ def test_v2_only_after_negotiation_then_fleet_failover_downgrades():
             pass
 
 
-@pytest.mark.xfail(
-    reason="known PRE-EXISTING fused/pure structural divergence on one "
-    "malformed wire shape (found by the mutation fuzz at ~1/40 process "
-    "salts — the seeded rng mutates salt-dependent bytes, so the 120-trial "
-    "fuzz above flakes at that rate on ANY commit): the C response walker "
-    "parses this mutated wire into 2 messages + an empty tree while the "
-    "pure decoder's field walk reads a different structure and raises "
-    "UnicodeDecodeError. Malformed-input-only (well-formed traffic is "
-    "bit-parity-pinned); fixing means auditing the C protobuf walk vs the "
-    "pure decoder on corrupt length prefixes. Fixture pinned so the fix "
-    "session has a deterministic repro instead of a flaky fuzz.",
-    strict=False,
-)
 def test_known_divergent_malformed_wire_fixture():
+    """The once-xfailed fused/pure structural divergence (PR-12 rode-along,
+    fixed in PR 13): the mutated wire carries a top-level field-3
+    capability whose bytes are not UTF-8 — the pure decoder's
+    _decode_capability raises, but the C response walker used to SKIP
+    field 3 unvalidated and decode 2 messages + an empty tree. Both
+    walkers now bounce capability shapes the pure decoder rejects
+    (native capability_ok), so the fused path demotes and the pure
+    error surface is the only one a caller ever sees."""
     if not native_crypto.native_available():
         pytest.skip("libevolu_crypto unavailable")
     import pathlib
@@ -523,3 +518,58 @@ def test_known_divergent_malformed_wire_fixture():
     except (PgpError, ValueError) as e:
         oracle = type(e)
     assert fused is None or fused == oracle
+    # The fixture's specific shape: structurally valid protobuf whose
+    # capability bytes fail UTF-8 — the pure decoder must raise and
+    # BOTH fused walkers must demote rather than succeed.
+    with pytest.raises(ValueError):
+        protocol.decode_sync_response(data)
+    assert native_crypto.decrypt_response(data, MN) is None
+    assert native_crypto.decrypt_response_columns(data, MN) is None
+
+
+def _caps_field(raw: bytes) -> bytes:
+    """One top-level SyncResponse field-3 entry with raw payload bytes."""
+    return bytes([0x1A, len(raw)]) + raw
+
+
+def test_capability_lanes_fused_matches_pure():
+    """Every capability lane the pure decoder distinguishes, pinned on
+    both fused walkers: valid caps decode fused (and are surfaced by the
+    separate capability scan), bad-UTF-8 caps and >64 entries demote to
+    the pure decoder's ValueError."""
+    if not native_crypto.native_available():
+        pytest.skip("libevolu_crypto unavailable")
+    from evolu_tpu.sync.client import encrypt_messages
+
+    ts0 = timestamp_to_string(Timestamp(0, 0, "a1b2c3d4e5f60718"))
+    enc = encrypt_messages(
+        [CrdtMessage(ts0, "t", "r", "c", "v")], MN)
+    base = protocol.encode_sync_response(
+        protocol.SyncResponse(tuple(enc), "{}"))
+
+    # Valid capability: both paths succeed with identical (messages, tree).
+    ok = base + _caps_field(b"aead-batch-v1")
+    fused = native_crypto.decrypt_response(ok, MN)
+    resp = protocol.decode_sync_response(ok)
+    oracle = (decrypt_messages(resp.messages, MN), resp.merkle_tree)
+    assert fused == oracle
+    assert native_crypto.decrypt_response_columns(ok, MN) is not None
+    assert protocol.scan_sync_response_capabilities(ok) == ("aead-batch-v1",)
+
+    # Bad UTF-8 capability: pure raises, fused demotes (never succeeds).
+    bad = base + _caps_field(b"\xa1\xff")
+    with pytest.raises(ValueError):
+        protocol.decode_sync_response(bad)
+    assert native_crypto.decrypt_response(bad, MN) is None
+    assert native_crypto.decrypt_response_columns(bad, MN) is None
+
+    # 65 capability entries: pure raises "too many", fused demotes.
+    many = base + _caps_field(b"c") * 65
+    with pytest.raises(ValueError):
+        protocol.decode_sync_response(many)
+    assert native_crypto.decrypt_response(many, MN) is None
+    assert native_crypto.decrypt_response_columns(many, MN) is None
+    # 64 entries is within the pure decoder's bound: both succeed.
+    limit = base + _caps_field(b"c") * 64
+    assert protocol.decode_sync_response(limit).capabilities == ("c",) * 64
+    assert native_crypto.decrypt_response(limit, MN) == oracle
