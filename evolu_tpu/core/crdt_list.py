@@ -1,0 +1,426 @@
+"""RGA sequence CRDT — the `"col:list"` column type (ISSUE 14).
+
+Collaborative list/text editing on the PR-7 typed-op substrate: inserts
+and deletes are ordinary `CrdtMessage`s (the Merkle/anti-entropy
+algebra stays TIMESTAMP-ONLY and byte-for-byte unchanged — the
+Merkle-CRDT argument, arXiv:2004.00107), and only the app-table
+materialization differs. Semantics follow the RGA family from the
+op-based composition framework (arXiv:2004.04303):
+
+- **insert op** `["i", origin, value]`: places a new element AFTER the
+  element identified by `origin` (an element's identity is its insert
+  op's own HLC timestamp — globally unique for free, exactly like the
+  AW-set add tag); `origin == ""` inserts at the head.
+- **delete op** `["d", tag]`: tombstones element `tag`. Tombstones are
+  permanent (GC is an explicit non-goal — see docs/LIST_CRDT.md): a
+  dead element keeps its position so concurrent inserts anchored on it
+  still land deterministically, and a delete arriving BEFORE its
+  insert (anti-entropy has no causal delivery) is tombstoned in
+  `__crdt_list_kill` so the insert is dead on arrival.
+
+**The one ordering rule** (the whole merge): replay the DISTINCT
+insert-op set in ascending raw-string timestamp order, placing each
+element immediately after its origin (head for `""`). Because HLC
+timestamps of causally-later ops compare greater, every element a
+replica could have observed is already placed when its insert replays
+— so this is exactly the reference-semantics RGA: siblings anchored on
+the same origin end up in DESCENDING raw-string timestamp order (a
+later concurrent insert at the same anchor lands closer to the
+anchor). A dangling origin (hostile bytes, or an op whose origin is
+not in the delivered set / not smaller than the op's own timestamp)
+deterministically roots at the head group — materialization is a pure
+function of the delivered op SET, so any permutation / partition /
+redelivery schedule converges.
+
+Layer map (the PR-7 playbook):
+- this module: codecs (ValueError-only), the pure host-oracle
+  linearization (the semantics ground truth), `__crdt_list` /
+  `__crdt_list_kill` SQL merge state, and materialization;
+- `ops/crdt_list_merge.py`: the device twin (Euler-tour list ranking
+  over one global sort + the `pallas_scan` segmented machinery),
+  bit-identical to the oracle and routed only for in-bounds batches;
+- `storage/apply.py` → `crdt_types.apply_typed_ops`: folds new list
+  ops inside the apply transaction (dedup = `__message` timestamp-PK
+  screen), before the batch's `__message` insert;
+- `runtime/client.py`: `list_insert` / `list_append` / `list_delete` /
+  `list_elements` (drain-before-observe, the `set_remove` lesson);
+- `sync/protocol.py`: the advisory `crdt-list-v1` capability.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.obs import metrics
+
+ROOT_ORIGIN = ""  # the type tag itself lives in crdt_types.LIST
+
+# An origin/target tag is an HLC timestamp string (46 chars canonical).
+# Anything longer is hostile framing; rejecting it at the codec keeps
+# the state tables bounded and is convergence-safe (malformed ops drop
+# identically on every replica).
+_MAX_TAG_LEN = 256
+
+# The device linearization packs (cell, parent, rank) into one i64 sort
+# key (ops/crdt_list_merge.py); batches beyond these bounds route to
+# the host oracle BEFORE any side effect (the r5 oversized contract).
+DEVICE_MAX_ELEMS = (1 << 20) - 2
+DEVICE_MAX_CELLS = (1 << 22) - 2
+
+LIST_STATE_TABLES_SQL = (
+    # One row per insert op; "tag" is the element identity (the insert
+    # op's timestamp), "origin" the anchor tag ("" = head), "value" the
+    # canonical JSON element encoding. alive=0 marks a tombstoned
+    # element — the row STAYS (position anchor; GC non-goal).
+    'CREATE TABLE IF NOT EXISTS "__crdt_list" ('
+    '"tag" BLOB PRIMARY KEY, "table" BLOB, "row" BLOB, "column" BLOB, '
+    '"origin" BLOB, "value" BLOB, "alive" INTEGER NOT NULL)',
+    'CREATE INDEX IF NOT EXISTS "index__crdt_list_cell" ON "__crdt_list" '
+    '("table", "row", "column")',
+    # Delete tombstones for elements not (yet) inserted — same shape as
+    # the AW-set `__crdt_kill` (a delete may arrive before its insert).
+    'CREATE TABLE IF NOT EXISTS "__crdt_list_kill" ("tag" BLOB PRIMARY KEY)',
+)
+
+Cell = Tuple[str, str, str]
+
+
+# --- op codecs (ValueError-only, like every wire decoder) ---
+
+
+def _check_tag(tag, what: str) -> str:
+    if not isinstance(tag, str):
+        raise ValueError(f"list op {what} must be a timestamp string: {tag!r}")
+    if len(tag) > _MAX_TAG_LEN:
+        raise ValueError(f"list op {what} exceeds {_MAX_TAG_LEN} chars")
+    return tag
+
+
+def list_insert_value(value, after: Optional[str] = None) -> str:
+    """Encode an insert op value. `after` is the origin element's tag
+    (None/"" = head). The op's OWN timestamp becomes the element tag."""
+    from evolu_tpu.core.crdt_types import elem_key
+
+    origin = _check_tag(after if after is not None else ROOT_ORIGIN, "origin")
+    return json.dumps(["i", origin, json.loads(elem_key(value))],
+                      separators=(",", ":"))
+
+
+def list_delete_value(tag: str) -> str:
+    """Encode a delete op tombstoning element `tag`."""
+    return json.dumps(["d", _check_tag(tag, "target")], separators=(",", ":"))
+
+
+def decode_list_op(value) -> Tuple[str, str, str]:
+    """Decode a list op value → ("i", origin, elem_json) or
+    ("d", target, ""). ValueError only — the fold layer catches, counts
+    and drops malformed ops so a hostile peer can never wedge sync."""
+    from evolu_tpu.core.crdt_types import elem_key
+
+    if not isinstance(value, str):
+        raise ValueError(f"list op value must be a JSON string: {value!r}")
+    try:
+        op = json.loads(value)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"malformed list op JSON: {e}") from e
+    if not isinstance(op, list) or not op or op[0] not in ("i", "d"):
+        raise ValueError(f"malformed list op shape: {value!r}")
+    if op[0] == "i":
+        if len(op) != 3:
+            raise ValueError(f"insert op must be ['i', origin, value]: {value!r}")
+        return "i", _check_tag(op[1], "origin"), elem_key(op[2])
+    if len(op) != 2:
+        raise ValueError(f"delete op must be ['d', tag]: {value!r}")
+    return "d", _check_tag(op[1], "target"), ""
+
+
+def decode_list_batch(
+    msgs: Sequence[CrdtMessage],
+) -> Tuple[List[Tuple[CrdtMessage, str, str]], List[Tuple[CrdtMessage, str]], int]:
+    """→ (inserts [(msg, origin, elem_json)] tagged by msg.timestamp,
+    deletes [(msg, target_tag)], malformed_count). Malformed ops drop
+    HERE so they can never touch a cell — whether a cell materializes
+    must be a function of the delivered VALID op set only (the same
+    batching-independence argument as `decode_set_batch`)."""
+    inserts: List[Tuple[CrdtMessage, str, str]] = []
+    deletes: List[Tuple[CrdtMessage, str]] = []
+    bad = 0
+    for m in msgs:
+        try:
+            kind, a, b = decode_list_op(m.value)
+        except ValueError:
+            bad += 1
+            continue
+        if kind == "i":
+            inserts.append((m, a, b))
+        else:
+            deletes.append((m, a))
+    return inserts, deletes, bad
+
+
+# --- the host-oracle linearization (the semantics ground truth) ---
+
+
+def linearize(tags: Sequence[str], origins: Sequence[str]) -> List[int]:
+    """Document position (0-based, tombstones INCLUDED — they anchor)
+    per element, for one cell. `tags` must be distinct (the state
+    table's PK guarantees it); order of the input arrays is irrelevant.
+
+    Equivalent to replaying inserts in ascending raw-string timestamp
+    order, each placed immediately after its origin: build the sibling
+    tree (parent = origin iff origin is a delivered element AND
+    compares smaller than the tag, else the head group), then DFS with
+    children in DESCENDING tag order. O(n log n)."""
+    n = len(tags)
+    order = sorted(range(n), key=lambda i: tags[i])
+    present = set(tags)
+    if len(present) != n:
+        raise ValueError("duplicate element tags in linearize input")
+    children: Dict[str, List[int]] = {}
+    for i in order:
+        o = origins[i]
+        parent = o if (o != ROOT_ORIGIN and o in present and o < tags[i]) \
+            else ROOT_ORIGIN
+        children.setdefault(parent, []).append(i)  # ascending append
+    pos = [0] * n
+    stack = list(children.get(ROOT_ORIGIN, ()))  # pop() → highest tag first
+    c = 0
+    while stack:
+        i = stack.pop()
+        pos[i] = c
+        c += 1
+        stack.extend(children.get(tags[i], ()))
+    return pos
+
+
+def materialize_list_value(values_in_doc_order: Iterable[str]) -> str:
+    """Canonical JSON array over ALIVE element values in document order
+    — NOT sorted, NOT deduped (it is a sequence, not a set)."""
+    return "[" + ",".join(values_in_doc_order) + "]"
+
+
+def fold_cell(
+    elems: Sequence[Tuple[str, str, str, bool]],
+) -> Tuple[List[int], str]:
+    """Pure per-cell fold: [(tag, origin, elem_json, alive)] →
+    (positions, materialized value). The one-call oracle the device
+    twin and the model-check replay are pinned against."""
+    tags = [e[0] for e in elems]
+    pos = linearize(tags, [e[1] for e in elems])
+    by_pos = sorted(range(len(elems)), key=lambda i: pos[i])
+    return pos, materialize_list_value(
+        elems[i][2] for i in by_pos if elems[i][3]
+    )
+
+
+def replay_log(msgs: Sequence[CrdtMessage]) -> Dict[Cell, str]:
+    """Host-oracle replay of a FULL op log (any order, duplicates
+    fine): → {cell: materialized value}. Ground truth for model-check
+    episodes — must equal whatever the incremental apply materialized."""
+    seen: Set[str] = set()
+    per_cell: Dict[Cell, List[Tuple[CrdtMessage, str, str]]] = {}
+    kills: Set[str] = set()
+    for m in msgs:
+        if m.timestamp in seen:
+            continue
+        seen.add(m.timestamp)
+        try:
+            kind, a, b = decode_list_op(m.value)
+        except ValueError:
+            continue
+        if kind == "d":
+            kills.add(a)
+            per_cell.setdefault((m.table, m.row, m.column), [])
+        else:
+            per_cell.setdefault((m.table, m.row, m.column), []).append((m, a, b))
+    out: Dict[Cell, str] = {}
+    for cell, inserts in per_cell.items():
+        elems = [(m.timestamp, origin, val, m.timestamp not in kills)
+                 for m, origin, val in inserts]
+        out[cell] = fold_cell(elems)[1] if elems else "[]"
+    return out
+
+
+# --- SQL state fold (runs INSIDE the caller's apply transaction) ---
+
+
+def apply_list_ops(db, new_msgs: Sequence[CrdtMessage]) -> Set[Cell]:
+    """Fold NEW list ops (already screened against __message) into
+    `__crdt_list` / `__crdt_list_kill`. Returns touched cells; the
+    caller (`crdt_types.apply_typed_ops`) materializes them."""
+    from evolu_tpu.core.crdt_types import LIST as _LT, _chunked_in, alive_add_flags
+
+    inserts, deletes, bad = decode_list_batch(new_msgs)
+    if bad:
+        metrics.inc("evolu_crdt_malformed_ops_total", bad, type=_LT)
+    if not inserts and not deletes:
+        return set()
+    metrics.inc("evolu_crdt_ops_total", len(inserts) + len(deletes), type=_LT)
+    if inserts:
+        metrics.inc("evolu_crdt_list_ops_total", len(inserts), kind="insert")
+    if deletes:
+        metrics.inc("evolu_crdt_list_ops_total", len(deletes), kind="delete")
+
+    kills: Set[str] = {t for _m, t in deletes}
+    insert_tags = [m.timestamp for m, _o, _v in inserts]
+    state_killed: Set[str] = set()
+    if insert_tags:
+        state_killed = {
+            r["tag"]
+            for r in _chunked_in(
+                db, 'SELECT "tag" FROM "__crdt_list_kill" WHERE "tag" IN ({})',
+                insert_tags,
+            )
+        }
+    alive = alive_add_flags(insert_tags, kills, state_killed)
+
+    touched: Set[Cell] = set()
+    if kills:
+        # Tombstone first, then kill matching EXISTING alive elements
+        # (their rows stay — position anchors; only `alive` flips).
+        db.run_many(
+            'INSERT OR IGNORE INTO "__crdt_list_kill" ("tag") VALUES (?)',
+            [(t,) for t in sorted(kills)],
+        )
+        killed_rows = _chunked_in(
+            db,
+            'SELECT "tag", "table", "row", "column" FROM "__crdt_list" '
+            'WHERE "alive" = 1 AND "tag" IN ({})',
+            sorted(kills),
+        )
+        if killed_rows:
+            db.run_many(
+                'UPDATE "__crdt_list" SET "alive" = 0 WHERE "tag" = ?',
+                [(r["tag"],) for r in killed_rows],
+            )
+            touched.update((r["table"], r["row"], r["column"]) for r in killed_rows)
+    if inserts:
+        db.run_many(
+            'INSERT OR IGNORE INTO "__crdt_list" '
+            '("tag", "table", "row", "column", "origin", "value", "alive") '
+            "VALUES (?, ?, ?, ?, ?, ?, ?)",
+            [
+                (m.timestamp, m.table, m.row, m.column, origin, val, int(a))
+                for (m, origin, val), a in zip(inserts, alive)
+            ],
+        )
+        touched.update((m.table, m.row, m.column) for m, _o, _v in inserts)
+    # Every VALID op touches its cell — a delete targeting a cell with
+    # no stored elements still materializes it (possibly as "[]"),
+    # identically on every replica regardless of batching.
+    touched.update((m.table, m.row, m.column) for m, _t in deletes)
+    return touched
+
+
+def _cell_rows(db, table: str, column: str, rows: Sequence[str]) -> Dict[str, list]:
+    """ALL stored elements (alive AND dead — positions need both) of
+    the touched cells, grouped per row."""
+    out: Dict[str, list] = {}
+    for i in range(0, len(rows), 500):
+        part = rows[i : i + 500]
+        q = (
+            'SELECT "row", "tag", "origin", "value", "alive" FROM "__crdt_list" '
+            'WHERE "table" = ? AND "column" = ? AND "row" IN ({})'
+        ).format(",".join("?" * len(part)))
+        for r in db.exec_sql_query(q, (table, column, *part)):
+            out.setdefault(r["row"], []).append(
+                (r["tag"], r["origin"], r["value"], bool(r["alive"]))
+            )
+    return out
+
+
+def materialize_list_values(
+    db, table: str, column: str, rows: Sequence[str]
+) -> Dict[str, str]:
+    """→ {row: canonical JSON array} for the touched cells of one
+    (table, column). Linearization routes to the device twin
+    (`ops.crdt_list_merge.rga_order`) when the combined element count
+    clears `DEVICE_FOLD_MIN` and fits the packed-key bounds; anything
+    oversized stays on the host oracle (routed BEFORE any side effect
+    — this function only reads)."""
+    from evolu_tpu.core.crdt_types import DEVICE_FOLD_MIN
+
+    per_row = _cell_rows(db, table, column, rows)
+    total = sum(len(v) for v in per_row.values())
+    oversized = total > DEVICE_MAX_ELEMS or len(per_row) > DEVICE_MAX_CELLS
+    use_device = DEVICE_FOLD_MIN <= total and not oversized
+    if oversized:
+        metrics.inc("evolu_crdt_list_oversized_host_routes_total")
+    metrics.inc("evolu_crdt_list_linearize_total",
+                path="device" if use_device else "host")
+    metrics.inc("evolu_crdt_list_linearized_elements_total", total)
+    if use_device:
+        return _materialize_device(per_row)
+    return {
+        row: fold_cell(elems)[1] for row, elems in per_row.items()
+    }
+
+
+def _materialize_device(per_row: Dict[str, list]) -> Dict[str, str]:
+    """Batch every touched cell into ONE device linearization dispatch
+    (`rga_order`), then place alive values by the kernel's segmented
+    alive-slot output — bit-identical to `fold_cell` (test-pinned)."""
+    import numpy as np
+
+    from evolu_tpu.ops.crdt_list_merge import rga_order
+
+    cell_id: List[int] = []
+    parent_ix: List[int] = []
+    alive: List[int] = []
+    vals: List[str] = []
+    spans: List[Tuple[str, int, int]] = []  # (row, start, count)
+    orphans = 0
+    for ci, row in enumerate(sorted(per_row)):
+        elems = sorted(per_row[row])  # ascending tag — the rank order
+        base = len(cell_id)
+        ix = {tag: j for j, (tag, _o, _v, _a) in enumerate(elems)}
+        for j, (tag, origin, val, a) in enumerate(elems):
+            if origin != ROOT_ORIGIN and origin in ix and origin < tag:
+                p = ix[origin]
+            else:
+                p = -1
+                if origin != ROOT_ORIGIN:
+                    orphans += 1
+            cell_id.append(ci)
+            parent_ix.append(base + p if p >= 0 else -1)
+            alive.append(int(a))
+            vals.append(val)
+        spans.append((row, base, len(elems)))
+    if orphans:
+        metrics.inc("evolu_crdt_list_orphan_inserts_total", orphans)
+    pos, slot = rga_order(
+        np.asarray(cell_id, np.int32),
+        np.asarray(parent_ix, np.int32),
+        np.asarray(alive, np.int32),
+    )
+    out: Dict[str, str] = {}
+    for row, base, count in spans:
+        n_alive = int(np.sum(np.asarray(alive[base : base + count])))
+        parts: List[str] = [""] * n_alive
+        for j in range(base, base + count):
+            if alive[j]:
+                parts[int(slot[j])] = vals[j]
+        out[row] = materialize_list_value(parts)
+    return out
+
+
+# --- reads for the client API (drain-before-observe callers) ---
+
+
+def list_state(db, table: str, row: str, column: str) -> List[Tuple[str, str]]:
+    """Alive (tag, elem_json) pairs of one cell in document order —
+    what `Evolu.list_elements` returns (after draining the worker) and
+    what `list_append` / index-addressed deletes observe."""
+    rows = db.exec_sql_query(
+        'SELECT "tag", "origin", "value", "alive" FROM "__crdt_list" '
+        'WHERE "table" = ? AND "row" = ? AND "column" = ?',
+        (table, row, column),
+    )
+    if not rows:
+        return []
+    elems = [(r["tag"], r["origin"], r["value"], bool(r["alive"])) for r in rows]
+    pos = linearize([e[0] for e in elems], [e[1] for e in elems])
+    by_pos = sorted(range(len(elems)), key=lambda i: pos[i])
+    return [(elems[i][0], elems[i][2]) for i in by_pos if elems[i][3]]

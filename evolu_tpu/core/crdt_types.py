@@ -22,6 +22,11 @@ op-based column types on the SAME substrate — ops are ordinary
   arrival order (a kill arriving before its add still wins — kills are
   tombstoned in `__crdt_kill`).
 
+A third type, the **RGA sequence** (`"list"`, ISSUE 14), lives in its
+own module `core/crdt_list.py` (insert-after ordering with tombstoned
+deletes — the genuinely order-SENSITIVE merge); this module dispatches
+its fold and materialization through the same typed-apply leg.
+
 Design invariants (see docs/CRDT_TYPES.md):
 - The LWW xor/Merkle algebra is TIMESTAMP-ONLY and stays byte-for-byte
   unchanged for typed cells: replication, snapshot bootstrap, and the
@@ -56,7 +61,8 @@ from evolu_tpu.obs import metrics
 LWW = "lww"
 COUNTER = "counter"
 AWSET = "awset"
-COLUMN_TYPES = (LWW, COUNTER, AWSET)
+LIST = "list"  # RGA sequence CRDT (ISSUE 14) — semantics in core/crdt_list.py
+COLUMN_TYPES = (LWW, COUNTER, AWSET, LIST)
 
 # Counter deltas are bounded to int32 so 2^31 ops can never overflow
 # the int64 pos/neg accumulators (SQLite INTEGER and the device's i64
@@ -142,7 +148,9 @@ def ensure_schema_table(db) -> None:
 
 
 def ensure_state_tables(db) -> None:
-    for sql in _STATE_TABLES_SQL:
+    from evolu_tpu.core.crdt_list import LIST_STATE_TABLES_SQL
+
+    for sql in _STATE_TABLES_SQL + LIST_STATE_TABLES_SQL:
         db.exec(sql)
 
 
@@ -210,10 +218,7 @@ def _fold_predeclaration_ops(db, decls: Sequence[Tuple[str, str, str]]) -> None:
     if not msgs:
         return
     metrics.inc("evolu_crdt_predeclaration_folds_total", len(msgs))
-    by_type = partition_typed(schema, msgs)
-    touched: Set[Cell] = set()
-    touched |= apply_counter_ops(db, by_type.get(COUNTER, ()))
-    touched |= apply_set_ops(db, by_type.get(AWSET, ()))
+    touched = _fold_by_type(db, partition_typed(schema, msgs))
     if touched:
         materialize_cells(db, schema, touched)
 
@@ -596,6 +601,11 @@ def materialize_cells(db, schema: CrdtSchema, cells: Iterable[Cell]) -> None:
                 for r in db.exec_sql_query(q, (table, column, *part)):
                     elems.setdefault(r["row"], set()).add(r["elem"])
             values = {row: materialize_set_value(e) for row, e in elems.items()}
+        elif ct == LIST:
+            from evolu_tpu.core.crdt_list import materialize_list_values
+
+            default = "[]"
+            values = materialize_list_values(db, table, column, rows)
         else:  # pragma: no cover - partition_typed never routes LWW here
             continue
         db.run_many(
@@ -606,16 +616,27 @@ def materialize_cells(db, schema: CrdtSchema, cells: Iterable[Cell]) -> None:
         metrics.inc("evolu_crdt_materialized_cells_total", len(rows), type=ct)
 
 
+def _fold_by_type(db, by_type: Dict[str, List[CrdtMessage]]) -> Set[Cell]:
+    """ONE copy of the per-type fold dispatch (incremental apply,
+    pre-declaration fold, and full rebuild all route through it)."""
+    touched: Set[Cell] = set()
+    touched |= apply_counter_ops(db, by_type.get(COUNTER, ()))
+    touched |= apply_set_ops(db, by_type.get(AWSET, ()))
+    list_msgs = by_type.get(LIST)
+    if list_msgs:
+        from evolu_tpu.core.crdt_list import apply_list_ops
+
+        touched |= apply_list_ops(db, list_msgs)
+    return touched
+
+
 def apply_typed_ops(db, schema: CrdtSchema, typed_msgs: Sequence[CrdtMessage]) -> None:
     """The whole typed apply leg: dedup against __message, fold per
     type, materialize touched cells. MUST run inside the apply
     transaction BEFORE the batch's __message insert (the dedup screen
     reads pre-batch state)."""
     new_ops = screen_new_ops(db, typed_msgs)
-    by_type = partition_typed(schema, new_ops)
-    touched: Set[Cell] = set()
-    touched |= apply_counter_ops(db, by_type.get(COUNTER, ()))
-    touched |= apply_set_ops(db, by_type.get(AWSET, ()))
+    touched = _fold_by_type(db, partition_typed(schema, new_ops))
     # Redelivered-only batches still touch no state; nothing to write.
     if touched:
         materialize_cells(db, schema, touched)
@@ -642,7 +663,8 @@ def rebuild_state(db, schema: CrdtSchema) -> None:
     if not schema:
         return
     ensure_state_tables(db)
-    for t in ("__crdt_counter", "__crdt_set", "__crdt_kill"):
+    for t in ("__crdt_counter", "__crdt_set", "__crdt_kill",
+              "__crdt_list", "__crdt_list_kill"):
         db.run(f'DELETE FROM "{t}"')
     rows = db.exec_sql_query(
         'SELECT "timestamp", "table", "row", "column", "value" FROM "__message" '
@@ -653,9 +675,6 @@ def rebuild_state(db, schema: CrdtSchema) -> None:
         for r in rows
         if schema.is_typed(r["table"], r["column"])
     ]
-    by_type = partition_typed(schema, msgs)
-    touched: Set[Cell] = set()
-    touched |= apply_counter_ops(db, by_type.get(COUNTER, ()))
-    touched |= apply_set_ops(db, by_type.get(AWSET, ()))
+    touched = _fold_by_type(db, partition_typed(schema, msgs))
     if touched:
         materialize_cells(db, schema, touched)

@@ -1,0 +1,238 @@
+"""Device kernels for the RGA list linearization (ISSUE 14).
+
+Host oracle: `core/crdt_list.py::linearize` / `fold_cell` — everything
+here is pinned bit-identical to it (tests/test_crdt_list.py, incl.
+Pallas interpret mode).
+
+RGA linearization is a parent-pointer ordering problem: each element
+names the element it was inserted after, siblings order by DESCENDING
+timestamp rank, and the document order is the DFS of that forest. Done
+naively that is a sequential replay; here it is the classic
+**Euler-tour list-ranking** factorization, built entirely from the
+recorded-cost-model primitives:
+
+1. **One global `lax.sort`** on a packed i64 key — group(cell) |
+   parent | descending-rank (the r5 spare-key-bits trick; same layout
+   discipline as `merge.plan_merge_sorted_core`) — groups every
+   (cell, parent) sibling run; first/last/prev-sibling pointers fall
+   out of segment adjacency with three scatters.
+2. Each element contributes a **down** edge (enter) and an **up** edge
+   (leave); the tour PREDECESSOR of every edge is a local function of
+   (prev-sibling, parent, last-child) — no walk. Pointer-jumping over
+   the predecessor chain (log2(2N) gathers — gathers are ~4× a sort
+   per the v5e law, but there are only ~21 of them and each is i32)
+   accumulates the count of down-edges strictly before each element's
+   down edge = its document position, tombstones included.
+3. A second sort by (cell, position) + the **segmented sum scan** from
+   the shared machinery (`crdt_merge.segmented_sum_scan`: blocked
+   two-level XLA on CPU, single-pass Pallas
+   `pallas_scan.segmented_sum_scan_pallas` on TPU silicon) turns alive
+   flags into per-cell output slots, so the host materializer places
+   values without re-sorting anything.
+
+Bounds: the batch core packs cell(22) | parent+1(20) | rank(20) into
+one positive i64, so N ≤ 2^20-2 elements and ≤ 2^22-2 cells per
+dispatch; the reconcile-shaped shard core reuses the SHARED
+`reconcile.pack_owner_cell_key` owner|cell layout (37 group bits) and
+therefore bounds its per-shard batch at 2^13-2. The host wrapper and
+`crdt_list.materialize_list_values` route anything beyond the bounds
+to the host oracle BEFORE any side effect (the r5 contract).
+
+Everything traces under enable_x64(True) (i64 packed keys) and pads to
+power-of-two buckets (no per-batch recompiles).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evolu_tpu.ops import bucket_size, to_host_many, with_x64
+from evolu_tpu.utils.log import span
+
+_B = 20  # parent / rank field width in the batch core's packed key
+_PAD_LIST_CELL = (1 << 22) - 1  # pad sentinel: sorts after every real cell
+_SHARD_B = 13  # per-field width under the 37-bit owner|cell group
+
+
+def _rga_positions(group, parent_ix, b_bits: int):
+    """Shared core: document position (0-based, within each group's
+    tree, tombstones included) per element.
+
+    `group` int64 (cell id, or the packed owner|cell composite),
+    `parent_ix` int32 index into these same arrays (-1 = head/root —
+    the wrapper resolved dangling origins already), `b_bits` the
+    packed-key field width (elements and parent+1 must fit it).
+    PRECONDITION (wrapper-enforced): elements arrive sorted ascending
+    by (group, tag), so the array index IS the timestamp rank and
+    parent_ix < own index for every non-root element."""
+    n = group.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    key = (
+        (group << jnp.int64(2 * b_bits))
+        | ((parent_ix + 1).astype(jnp.int64) << jnp.int64(b_bits))
+        | (jnp.int64(n - 1) - idx.astype(jnp.int64))
+    )
+    if key.dtype != jnp.dtype("int64"):  # x64 disabled: would mis-order
+        raise TypeError(
+            "rga linearization must be traced under enable_x64(True): "
+            f"packed key degraded to {key.dtype}"
+        )
+    # Sort → (group, parent) sibling runs in DESCENDING rank order.
+    key_s, e_s = jax.lax.sort((key, idx), num_keys=1, is_stable=False)
+    seg = key_s >> jnp.int64(b_bits)  # group|parent bits
+    parent_s = (
+        (key_s >> jnp.int64(b_bits)) & jnp.int64((1 << b_bits) - 1)
+    ).astype(jnp.int32) - 1
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), seg[1:] != seg[:-1]])
+    seg_end = jnp.concatenate([seg_start[1:], jnp.ones((1,), bool)])
+
+    # Sibling pointers from segment adjacency (scatters; pad and root
+    # segments carry parent −1 and dump on the out-of-range slot).
+    last_child = jnp.full(n, -1, jnp.int32).at[
+        jnp.where(seg_end & (parent_s >= 0), parent_s, jnp.int32(n))
+    ].set(e_s, mode="drop")
+    prev_sib = jnp.full(n, -1, jnp.int32).at[e_s[1:]].set(
+        jnp.where(seg[1:] == seg[:-1], e_s[:-1], jnp.int32(-1))
+    )
+
+    # Euler-tour PREDECESSOR per edge (down = 2x enters x, up = 2x+1
+    # leaves x); the chain of each tree ends (TERM) at the down edge of
+    # the head element (first root child, no previous sibling).
+    m = 2 * n  # TERM sentinel index
+    pred_down = jnp.where(
+        prev_sib >= 0,
+        2 * prev_sib + 1,
+        jnp.where(parent_ix >= 0, 2 * parent_ix, jnp.int32(m)),
+    )
+    pred_up = jnp.where(last_child >= 0, 2 * last_child + 1, 2 * idx)
+    pred = jnp.concatenate(
+        [jnp.stack([pred_down, pred_up], axis=1).reshape(m), jnp.full((1,), m, jnp.int32)]
+    )  # index m = TERM self-loop
+    weight = jnp.concatenate(
+        [
+            jnp.tile(jnp.array([1, 0], jnp.int32), n),  # down edges count
+            jnp.zeros((1,), jnp.int32),
+        ]
+    )
+
+    # Pointer jumping: wdist[i] = Σ weight over edges STRICTLY before i
+    # on its chain → at a down edge, the element's document position.
+    def body(_i, carry):
+        p, w = carry
+        return p[p], w + w[p]
+
+    jumps = max(1, int(m).bit_length() + 1)
+    pred, wdist = jax.lax.fori_loop(0, jumps, body, (pred, weight[pred]))
+    return wdist[2 * idx]
+
+
+def _alive_slots(group, pos, alive, b_bits: int, scan, interpret: bool):
+    """Second stage: per-group output slot for every ALIVE element
+    (dead elements get −1) via sort-by-(group, pos) + the segmented
+    sum scan — the machinery the host materializer consumes directly."""
+    n = group.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    key2 = (group << jnp.int64(b_bits)) | pos.astype(jnp.int64)
+    key2_s, e2_s, alive_s = jax.lax.sort(
+        (key2, idx, alive.astype(jnp.int32)), num_keys=1, is_stable=False
+    )
+    g2 = key2_s >> jnp.int64(b_bits)
+    cstart = jnp.concatenate([jnp.ones((1,), bool), g2[1:] != g2[:-1]])
+    if interpret:
+        from evolu_tpu.ops.pallas_scan import segmented_sum_scan_pallas
+
+        incl = segmented_sum_scan_pallas(
+            cstart, alive_s.astype(jnp.uint64), interpret=True
+        )
+    else:
+        incl = scan(cstart, alive_s.astype(jnp.uint64))
+    slot_s = jnp.where(alive_s > 0, incl.astype(jnp.int32) - 1, jnp.int32(-1))
+    return jnp.zeros(n, jnp.int32).at[e2_s].set(slot_s)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret_pallas",))
+def rga_order_core(cell_id, parent_ix, alive, interpret_pallas: bool = False):
+    """Traceable batch core: → (pos, slot) int32 arrays. `cell_id`
+    int32 (< 2^22-1; pad rows use _PAD_LIST_CELL), `parent_ix` int32
+    (-1 = head; pad rows -1), `alive` int32 0/1. Pad rows form their
+    own sibling chain under the sentinel cell and never collide with a
+    real group. Must trace under enable_x64(True).
+
+    `interpret_pallas=True` forces the alive-slot scan through the
+    Pallas kernel in interpret mode — the bit-identity test hook (the
+    production path routes via `crdt_merge.segmented_sum_scan`)."""
+    from evolu_tpu.ops.crdt_merge import segmented_sum_scan
+
+    group = cell_id.astype(jnp.int64)
+    pos = _rga_positions(group, parent_ix, _B)
+    slot = _alive_slots(group, pos, alive, _B, segmented_sum_scan,
+                        interpret_pallas)
+    return pos, slot
+
+
+@with_x64
+def rga_order(cell_id: np.ndarray, parent_ix: np.ndarray, alive: np.ndarray,
+              interpret_pallas: bool = False):
+    """Host entry: → (pos, slot) numpy int32 arrays, bit-identical to
+    the host oracle (`crdt_list.fold_cell`) per cell. Elements MUST be
+    sorted ascending by (cell, tag) with parent indices resolved
+    against that order (`crdt_list._materialize_device` builds exactly
+    this layout). Batches beyond the packed-key bounds raise — callers
+    route those to the host oracle instead."""
+    from evolu_tpu.core.crdt_list import DEVICE_MAX_CELLS, DEVICE_MAX_ELEMS
+
+    n = len(cell_id)
+    if n == 0:
+        z = np.zeros(0, np.int32)
+        return z, z.copy()
+    if n > DEVICE_MAX_ELEMS:
+        raise ValueError(f"batch of {n} elements exceeds the packed-key bound")
+    if int(np.max(cell_id)) > DEVICE_MAX_CELLS:
+        raise ValueError("cell id exceeds the packed-key bound")
+    with span("kernel:crdt_list", "rga_order", n=n):
+        size = bucket_size(n)
+        c_p = np.concatenate(
+            [cell_id.astype(np.int32),
+             np.full(size - n, _PAD_LIST_CELL, np.int32)]
+        )
+        p_p = np.concatenate(
+            [parent_ix.astype(np.int32), np.full(size - n, -1, np.int32)]
+        )
+        a_p = np.concatenate(
+            [alive.astype(np.int32), np.zeros(size - n, np.int32)]
+        )
+        pos, slot = to_host_many(*rga_order_core(
+            jnp.asarray(c_p), jnp.asarray(p_p), jnp.asarray(a_p),
+            interpret_pallas=interpret_pallas,
+        ))
+        return pos[:n], slot[:n]
+
+
+# --- sharded (owner, cell) linearization — the reconcile-shaped form ---
+
+
+def list_shard_order_core(owner_ix, cell_id, parent_ix, alive):
+    """Per-shard RGA linearization for the multi-owner reconcile shape
+    (`parallel.reconcile`): elements group by the SAME packed
+    owner|cell i64 layout as the LWW shard kernel and the counter fold
+    (`pack_owner_cell_key`, idx/lo zeroed — only the 37 group bits are
+    used), so the (owner, cell) grouping contract can never drift
+    between the planners and this kernel. The remaining 26 key bits
+    split 13/13 between parent and rank, bounding a shard dispatch at
+    2^13-2 elements — wider batches route to the host oracle. Returns
+    (pos, slot) in shard-local order; owners are never split across
+    shards, so local trees are globally complete. Must trace under
+    enable_x64(True); callers wrap in shard_map over the owners axis."""
+    from evolu_tpu.ops.crdt_merge import segmented_sum_scan
+    from evolu_tpu.parallel.reconcile import pack_owner_cell_key
+
+    n = cell_id.shape[0]
+    zeros = jnp.zeros(n, jnp.int32)
+    group = pack_owner_cell_key(owner_ix, cell_id, zeros, lo_bits=0) >> jnp.int64(24)
+    pos = _rga_positions(group, parent_ix, _SHARD_B)
+    slot = _alive_slots(group, pos, alive, _SHARD_B, segmented_sum_scan, False)
+    return pos, slot

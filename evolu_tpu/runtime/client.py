@@ -292,6 +292,58 @@ class Evolu:
             NewCrdtMessage(table, row_id, column, set_remove_value(elem, observed))
         ])
 
+    # -- list (RGA sequence) mutations, ISSUE 14 --
+
+    def list_insert(self, table: str, row_id: str, column: str, value,
+                    after: Optional[str] = None) -> None:
+        """RGA insert op for a `"<column>:list"` cell: place `value`
+        AFTER the element tagged `after` (None = head). The op's own
+        timestamp becomes the new element's tag — read it back via
+        `list_elements` after a flush. A concurrent insert at the same
+        anchor orders deterministically on every replica (later
+        timestamp lands closer to the anchor)."""
+        from evolu_tpu.core.crdt_list import list_insert_value
+
+        self._mutate_raw([
+            NewCrdtMessage(table, row_id, column, list_insert_value(value, after))
+        ])
+
+    def list_append(self, table: str, row_id: str, column: str, value) -> None:
+        """Insert `value` after the cell's LAST alive element. The
+        worker queue is drained first so a just-queued same-replica
+        insert is observed (the `set_remove` drain lesson — without it,
+        two unflushed appends would both anchor on the old tail and
+        end up reversed). Appends queued in a still-open `batching()`
+        block are not yet stamped — close the batch first."""
+        from evolu_tpu.core.crdt_list import list_state
+
+        self.worker.flush()
+        elems = list_state(self.db, table, row_id, column)
+        self.list_insert(table, row_id, column, value,
+                         after=elems[-1][0] if elems else None)
+
+    def list_delete(self, table: str, row_id: str, column: str, tag: str) -> None:
+        """Tombstone the element tagged `tag` (from `list_elements`).
+        The element keeps its position as an anchor for concurrent
+        inserts; a delete racing an unseen insert at the same tag still
+        wins on every replica (kill tombstones, like `set_remove`)."""
+        from evolu_tpu.core.crdt_list import list_delete_value
+
+        self._mutate_raw([NewCrdtMessage(table, row_id, column,
+                                         list_delete_value(tag))])
+
+    def list_elements(self, table: str, row_id: str, column: str):
+        """Alive (tag, value) pairs in document order, after draining
+        the worker (drain-before-observe) — the read that anchors
+        `after=` inserts and tag-addressed deletes."""
+        import json as _json
+
+        from evolu_tpu.core.crdt_list import list_state
+
+        self.worker.flush()
+        return [(tag, _json.loads(v))
+                for tag, v in list_state(self.db, table, row_id, column)]
+
     def create(self, table: str, values: Dict[str, object], on_complete=None) -> str:
         values = dict(values)
         values.pop("id", None)

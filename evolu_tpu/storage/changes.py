@@ -121,3 +121,5 @@ def record_typed_tables(changes: Optional[ChangedSet]) -> None:
     changes.add_table("__crdt_counter")
     changes.add_table("__crdt_set")
     changes.add_table("__crdt_kill")
+    changes.add_table("__crdt_list")
+    changes.add_table("__crdt_list_kill")

@@ -70,7 +70,8 @@ _NONDETERMINISTIC = (
 # Only the tables the contract explicitly records may be gated;
 # reading any other "__" table means "always re-execute".
 _RECORDED_INTERNAL = frozenset(
-    ("__message", "__crdt_counter", "__crdt_set", "__crdt_kill"))
+    ("__message", "__crdt_counter", "__crdt_set", "__crdt_kill",
+     "__crdt_list", "__crdt_list_kill"))
 
 
 @dataclass(frozen=True)

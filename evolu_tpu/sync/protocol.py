@@ -265,6 +265,12 @@ def decode_encrypted_message(data: bytes) -> EncryptedCrdtMessage:
 # surface it (sync/client.py records the negotiated set per relay).
 
 CAP_CRDT_TYPES = "crdt-types-v1"
+# RGA sequence CRDT (ISSUE 14, core/crdt_list.py): advisory like
+# crdt-types-v1 — list ops are ordinary E2EE-opaque messages, so a
+# non-advertising peer relays them byte-identically; the capability
+# only surfaces fleet support (e.g. to gate enabling `"col:list"`
+# columns for an owner shared with reference TS peers).
+CAP_CRDT_LIST = "crdt-list-v1"
 # Batched-AEAD v2 sync payload (ISSUE 8, sync/aead.py): a NEGOTIATED
 # pair replaces per-message OpenPGP S2K with session-keyed AES-256-GCM
 # records. Unlike crdt-types-v1 this capability GATES emission: a
@@ -274,7 +280,7 @@ CAP_CRDT_TYPES = "crdt-types-v1"
 # records self-describe via a magic prefix — so negotiation only
 # controls what gets written, never what can be read.
 CAP_AEAD_BATCH = "aead-batch-v1"
-KNOWN_CAPABILITIES = (CAP_CRDT_TYPES, CAP_AEAD_BATCH)
+KNOWN_CAPABILITIES = (CAP_CRDT_TYPES, CAP_CRDT_LIST, CAP_AEAD_BATCH)
 _MAX_CAPABILITIES = 64  # decode bound: a hostile body must not mint unbounded strings
 
 

@@ -58,7 +58,8 @@ class Config:
     # Wire capabilities advertised in every sync request (field 5 —
     # sync/protocol.py capability extension, ISSUE 7). The relay echoes
     # the intersection with its own set; () sends the v1 wire
-    # byte-identically. `crdt-types-v1` is advisory (typed CRDT ops are
+    # byte-identically. `crdt-types-v1` / `crdt-list-v1` (ISSUEs 7, 14)
+    # are advisory (typed CRDT ops are
     # E2EE-opaque and relay through v1 peers unchanged; the echo only
     # SURFACES fleet support). `aead-batch-v1` (ISSUE 8, sync/aead.py)
     # GATES emission: only after a relay echoes it does the client send
@@ -67,7 +68,8 @@ class Config:
     # framework DECODES v2 records unconditionally; drop the capability
     # here for owners shared with reference OpenPGP.js peers, which
     # cannot (the same interop dial as wire_extensions).
-    sync_capabilities: Tuple[str, ...] = ("crdt-types-v1", "aead-batch-v1")
+    sync_capabilities: Tuple[str, ...] = (
+        "crdt-types-v1", "crdt-list-v1", "aead-batch-v1")
     # -- relay fleet knobs (no reference equivalent). These are LIVE
     # defaults: `RelayServer` / `ReplicationManager` resolve any
     # constructor arg left at None from the process `default_config`

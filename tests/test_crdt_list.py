@@ -1,0 +1,675 @@
+"""RGA sequence CRDT — the `"col:list"` column type (ISSUE 14).
+
+Layers under test, host-oracle-first (the PR-7 playbook):
+1. op codecs (ValueError-only fuzz) + hand-model golden fixtures
+   (tests/fixtures/crdt_list_golden.json — computed BY HAND, pinned,
+   never updated) under arbitrary permutation/partition/redelivery on
+   both storage backends;
+2. the pure linearization oracle against an INDEPENDENT literal
+   replay-the-inserts model, plus orphan/dangling-origin determinism;
+3. the device twin (`ops/crdt_list_merge.py`) bit-identical to the
+   oracle on random forests — batch core, Pallas-interpret scan route,
+   and the reconcile-shaped shard core over the shared
+   `pack_owner_cell_key` layout;
+4. apply routing: list cells never LWW-upsert, batched == sequential
+   oracle, device-routed materialization == host-routed, redelivery
+   idempotence, late declaration, owner reset;
+5. client API (drain-before-observe) + end-to-end: 2-relay
+   anti-entropy + snapshot checkpoint carrying a MIXED
+   counter/awset/list log crc-identically, `crdt-list-v1` negotiated.
+"""
+
+import json
+import random
+import time
+import zlib
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from evolu_tpu.core import crdt_list as cl
+from evolu_tpu.core import crdt_types as ct
+from evolu_tpu.core.merkle import create_initial_merkle_tree
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.core.types import CrdtMessage, TableDefinition
+from evolu_tpu.obs import metrics
+from evolu_tpu.storage.apply import apply_messages, apply_messages_sequential
+from evolu_tpu.storage.native import native_available, open_database
+from evolu_tpu.storage.schema import init_db_model, update_db_schema
+from evolu_tpu.utils.config import Config
+
+MN = "legal winner thank year wave sausage worth useful legal winner thank yellow"
+GOLDEN = json.loads(
+    (Path(__file__).parent / "fixtures" / "crdt_list_golden.json").read_text())
+
+SCHEMA_DEF = TableDefinition.of("doc", ("title", "body:list"))
+BACKENDS = ["python"] + (["native"] if native_available() else [])
+
+
+def _mk_db(backend="python"):
+    db = open_database(":memory:", backend)
+    init_db_model(db, MN)
+    update_db_schema(db, [SCHEMA_DEF])
+    return db
+
+
+def _golden_msgs(section):
+    t, r, c = section["cell"]
+    return [CrdtMessage(op["timestamp"], t, r, c, op["value"])
+            for op in section["ops"]]
+
+
+def _app_value(db, column, row="r1", table="doc"):
+    rows = db.exec_sql_query(
+        f'SELECT "{column}" AS v FROM "{table}" WHERE "id" = ?', (row,))
+    return rows[0]["v"] if rows else None
+
+
+def _dump_all(db):
+    return (
+        db.exec_sql_query('SELECT * FROM "__message" ORDER BY "timestamp"'),
+        db.exec_sql_query('SELECT * FROM "doc" ORDER BY "id"'),
+        db.exec_sql_query('SELECT * FROM "__crdt_list" ORDER BY "tag"'),
+        db.exec_sql_query('SELECT * FROM "__crdt_list_kill" ORDER BY "tag"'),
+    )
+
+
+# --- 1. codecs: ValueError-only ---
+
+
+def test_list_op_codecs_roundtrip():
+    v = cl.list_insert_value("hi")
+    assert cl.decode_list_op(v) == ("i", "", '"hi"')
+    v = cl.list_insert_value(7, after="tagA")
+    assert cl.decode_list_op(v) == ("i", "tagA", "7")
+    assert cl.decode_list_op(cl.list_delete_value("tagB")) == ("d", "tagB", "")
+    # None `after` is the head, same bytes as an explicit "".
+    assert cl.list_insert_value("x", after=None) == cl.list_insert_value("x", after="")
+
+
+def test_list_op_codec_valueerror_only_fuzz():
+    """ISSUE 14 satellite: field-level fuzz — anything malformed raises
+    ValueError and nothing else (the wire-decoder contract, so a
+    hostile peer's garbage is always classifiable and droppable)."""
+    rng = random.Random(14)
+    corpus = [
+        None, 5, 1.5, b"x", "", "{", "[]", '["x",1]', '["i"]', '["i",""]',
+        '["i","",1,2]', '["d"]', '["d","a","b"]', '["d",5]', '["i",5,"v"]',
+        '["i","",true]', '["i","",[1]]', '["i","",{"k":1}]', '["i",null,"v"]',
+        '["d",null]', '["i","' + "x" * 300 + '","v"]', '["d","' + "y" * 300 + '"]',
+    ]
+    corpus += ["".join(chr(rng.randrange(32, 127))
+                       for _ in range(rng.randrange(0, 60)))
+               for _ in range(300)]
+    for c in corpus:
+        try:
+            cl.decode_list_op(c)
+        except ValueError:
+            pass  # the ONLY permitted error type
+    with pytest.raises(ValueError):
+        cl.list_insert_value(object())
+    with pytest.raises(ValueError):
+        cl.list_insert_value("v", after=5)
+    with pytest.raises(ValueError):
+        cl.list_delete_value(None)
+    # Valid ops survive the same decoder.
+    ins, dels, bad = cl.decode_list_batch([
+        CrdtMessage("t1", "doc", "r", "body", cl.list_insert_value("a")),
+        CrdtMessage("t2", "doc", "r", "body", "garbage"),
+        CrdtMessage("t3", "doc", "r", "body", cl.list_delete_value("t1")),
+    ])
+    assert len(ins) == 1 and len(dels) == 1 and bad == 1
+
+
+def test_column_spec_accepts_list():
+    assert ct.parse_column_spec("body:list") == ("body", "list")
+    with pytest.raises(ValueError):
+        ct.parse_column_spec("body:rga")
+
+
+# --- 2. the oracle vs an independent literal replay model ---
+
+
+def _literal_replay(inserts):
+    """The INDEPENDENT reference model: replay inserts in ascending
+    raw-string timestamp order, each placed immediately after its
+    origin (or at the head when the origin is absent/not yet placed) —
+    O(n²), written the naive way on purpose."""
+    order = []
+    for tag, origin in sorted(inserts):
+        at = order.index(origin) + 1 if origin in order else 0
+        order.insert(at, tag)
+    return order
+
+
+@pytest.mark.parametrize("seed", [0, 7, 101, 2024])
+def test_linearize_matches_literal_replay(seed):
+    rng = random.Random(seed)
+    n = rng.randrange(1, 120)
+    tags = sorted({f"t{rng.randrange(10**9):010d}" for _ in range(n)})
+    inserts = []
+    for i, t in enumerate(tags):
+        roll = rng.random()
+        if roll < 0.25 or i == 0:
+            o = ""
+        elif roll < 0.85:
+            o = tags[rng.randrange(i)]  # an already-delivered element
+        elif roll < 0.95:
+            o = "zzzz-dangling"  # never an element
+        else:
+            o = tags[rng.randrange(i, len(tags))]  # origin AFTER self (hostile)
+        inserts.append((t, o))
+    expect = _literal_replay(inserts)
+    pos = cl.linearize([t for t, _ in inserts], [o for _, o in inserts])
+    got = [t for _, t in sorted(zip(pos, [t for t, _ in inserts]))]
+    assert got == expect
+    # Permutation invariance: linearize is a function of the SET.
+    perm = list(range(len(inserts)))
+    rng.shuffle(perm)
+    pos_p = cl.linearize([inserts[i][0] for i in perm],
+                         [inserts[i][1] for i in perm])
+    assert [pos_p[perm.index(i)] for i in range(len(inserts))] == pos
+
+
+def test_linearize_rejects_duplicate_tags():
+    with pytest.raises(ValueError):
+        cl.linearize(["a", "a"], ["", ""])
+
+
+# --- 3. golden fixtures (hand model; never update) ---
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("section", ["list", "same_anchor", "delete_before_insert"])
+@pytest.mark.parametrize("seed", [0, 7, 23])
+def test_golden_any_order_any_partition(backend, section, seed):
+    g = GOLDEN[section]
+    row = g["cell"][1]
+    msgs = _golden_msgs(g)
+    msgs += [msgs[i] for i in g["redeliver"]]
+    rng = random.Random(seed)
+    rng.shuffle(msgs)
+    db = _mk_db(backend)
+    tree = create_initial_merkle_tree()
+    i = 0
+    while i < len(msgs):  # random partition into batches
+        j = i + rng.randrange(1, len(msgs) - i + 1)
+        tree = apply_messages(db, tree, msgs[i:j])
+        i = j
+    assert _app_value(db, "body", row) == g["expected_value"]
+    # Stored document order (tombstones included) matches the hand model.
+    rows = db.exec_sql_query(
+        'SELECT "tag", "origin", "alive" FROM "__crdt_list" WHERE "row" = ?',
+        (row,))
+    pos = cl.linearize([r["tag"] for r in rows], [r["origin"] for r in rows])
+    ordered = [r["tag"] for _, r in sorted(zip(pos, rows), key=lambda x: x[0])]
+    assert ordered == g["expected_order_tags"]
+    dead = {r["tag"] for r in rows if not r["alive"]}
+    assert dead == set(g["expected_dead_tags"])
+    # Redelivering EVERYTHING changes nothing (op-set semantics).
+    apply_messages(db, tree, msgs)
+    assert _app_value(db, "body", row) == g["expected_value"]
+
+
+# --- 4. device twin: bit-identical to the oracle ---
+
+
+def _random_forest(rng, n_cells, max_elems):
+    """(cell_id, parent_ix, alive, spans, tags, origins) in the device
+    layout: ascending (cell, tag), parents resolved per the oracle's
+    rule (dangling/hostile origins → −1)."""
+    cell_id, parent, alive, tags, origins, spans = [], [], [], [], [], []
+    base = 0
+    for c in range(n_cells):
+        n = rng.randrange(1, max_elems)
+        ctags = sorted({f"c{c}-{rng.randrange(10**9):010d}" for _ in range(n)})
+        for j, t in enumerate(ctags):
+            roll = rng.random()
+            if roll < 0.3 or j == 0:
+                o = ""
+            elif roll < 0.9:
+                o = ctags[rng.randrange(j)]
+            else:
+                o = "zzzz-dangling"
+            p = base + ctags.index(o) if (o in ctags and o < t) else -1
+            cell_id.append(c)
+            parent.append(p)
+            alive.append(rng.randrange(2))
+            tags.append(t)
+            origins.append(o)
+        spans.append((base, len(ctags)))
+        base += len(ctags)
+    return (np.array(cell_id, np.int32), np.array(parent, np.int32),
+            np.array(alive, np.int32), spans, tags, origins)
+
+
+@pytest.mark.parametrize("seed", [3, 31, 555])
+def test_rga_order_kernel_matches_oracle(seed):
+    from evolu_tpu.ops.crdt_list_merge import rga_order
+
+    rng = random.Random(seed)
+    cell_id, parent, alive, spans, tags, origins = _random_forest(
+        rng, rng.randrange(1, 8), 80)
+    pos_d, slot_d = rga_order(cell_id, parent, alive)
+    for b, n in spans:
+        pos_h = cl.linearize(tags[b:b + n], origins[b:b + n])
+        assert list(pos_d[b:b + n]) == pos_h
+        # Alive slots are the alive-prefix in document order; dead = −1.
+        expect_slot = {}
+        s = 0
+        for i in sorted(range(n), key=lambda i: pos_h[i]):
+            expect_slot[i] = s if alive[b + i] else -1
+            s += int(alive[b + i])
+        assert [int(slot_d[b + i]) for i in range(n)] \
+            == [expect_slot[i] for i in range(n)]
+
+
+def test_rga_order_pallas_interpret_bit_identical():
+    """The acceptance-criteria route: the alive-slot scan through the
+    single-pass Pallas kernel (interpret mode) returns bit-identical
+    (pos, slot) to the XLA-routed production path."""
+    from evolu_tpu.ops.crdt_list_merge import rga_order
+
+    rng = random.Random(8)
+    cell_id, parent, alive, _spans, _t, _o = _random_forest(rng, 3, 60)
+    a = rga_order(cell_id, parent, alive)
+    b = rga_order(cell_id, parent, alive, interpret_pallas=True)
+    assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+
+
+def test_rga_order_deep_chain_and_bounds():
+    from evolu_tpu.ops.crdt_list_merge import rga_order
+
+    # A pure chain (every element inserted after the previous one — the
+    # worst case for the pointer-jumping depth) linearizes exactly.
+    n = 1000
+    cell = np.zeros(n, np.int32)
+    parent = np.arange(-1, n - 1, dtype=np.int32)
+    alive = np.ones(n, np.int32)
+    pos, slot = rga_order(cell, parent, alive)
+    assert np.array_equal(pos, np.arange(n)) and np.array_equal(slot, np.arange(n))
+    # Oversized batches refuse (the wrapper contract; the materializer
+    # routes them to the host oracle instead of calling in).
+    with pytest.raises(ValueError):
+        rga_order(np.zeros(cl.DEVICE_MAX_ELEMS + 1, np.int32),
+                  np.full(cl.DEVICE_MAX_ELEMS + 1, -1, np.int32),
+                  np.ones(cl.DEVICE_MAX_ELEMS + 1, np.int32))
+
+
+def test_list_shard_order_core_groups_by_owner_cell():
+    """The reconcile-shaped shard kernel: (owner, cell) grouping via
+    the SHARED pack_owner_cell_key layout — per-group positions equal
+    the per-group oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    from evolu_tpu.ops.crdt_list_merge import list_shard_order_core
+
+    rng = np.random.default_rng(12)
+    n = 600
+    owner = np.sort(rng.integers(0, 5, n)).astype(np.int64)
+    cells = rng.integers(0, 7, n).astype(np.int32)
+    parent = np.full(n, -1, np.int32)
+    alive = rng.integers(0, 2, n).astype(np.int32)
+    groups = {}
+    for i in range(n):
+        lst = groups.setdefault((int(owner[i]), int(cells[i])), [])
+        if lst and rng.random() < 0.7:
+            parent[i] = lst[int(rng.integers(0, len(lst)))]
+        lst.append(i)
+    with jax.enable_x64(True):
+        pos, slot = jax.jit(list_shard_order_core)(
+            jnp.asarray(owner), jnp.asarray(cells), jnp.asarray(parent),
+            jnp.asarray(alive))
+    pos, slot = np.asarray(pos), np.asarray(slot)
+    for g, members in groups.items():
+        tags = [f"{i:06d}" for i in members]
+        origins = ["" if parent[i] < 0 else f"{parent[i]:06d}" for i in members]
+        assert [int(pos[i]) for i in members] == cl.linearize(tags, origins), g
+        alive_sorted = [i for i in sorted(members, key=lambda i: pos[i])
+                        if alive[i]]
+        assert [int(slot[i]) for i in alive_sorted] == list(range(len(alive_sorted)))
+
+
+def test_device_routed_materialization_equals_host(monkeypatch):
+    """Force the device route at a tiny threshold: the materialized
+    app bytes and every state row must equal the host-routed twin."""
+    msgs = _random_list_log(99, n=500)
+    db_host, db_dev = _mk_db(), _mk_db()
+    apply_messages(db_host, create_initial_merkle_tree(), msgs)
+    monkeypatch.setattr(ct, "DEVICE_FOLD_MIN", 1)
+    before = metrics.get_counter("evolu_crdt_list_linearize_total", path="device")
+    apply_messages(db_dev, create_initial_merkle_tree(), msgs)
+    assert metrics.get_counter(
+        "evolu_crdt_list_linearize_total", path="device") > before
+    assert _dump_all(db_host) == _dump_all(db_dev)
+
+
+# --- 5. apply routing ---
+
+
+def _random_list_log(seed, n=300, table="doc", column="body"):
+    """A hostile mixed log: inserts (incl. same-anchor races and
+    dangling origins), deletes (incl. delete-before-insert), malformed
+    ops, LWW traffic on a sibling column, and redelivery."""
+    rng = random.Random(seed)
+    nodes = ["aaaaaaaaaaaaaaa1", "bbbbbbbbbbbbbbb2", "ccccccccccccccc3"]
+    msgs, tag_pool = [], []
+    for i in range(n):
+        ts = timestamp_to_string(
+            Timestamp(1_700_000_000_000 + i * 977, i % 3, rng.choice(nodes)))
+        roll = rng.random()
+        row = f"r{rng.randrange(5)}"
+        if roll < 0.45:
+            after = rng.choice(tag_pool) if tag_pool and rng.random() < 0.7 else None
+            if rng.random() < 0.05:
+                after = "2099-dangling-origin"
+            msgs.append(CrdtMessage(ts, table, row, column,
+                                    cl.list_insert_value(f"v{i}", after=after)))
+            tag_pool.append(ts)
+        elif roll < 0.60 and tag_pool:
+            # Delete a random tag — sometimes one whose insert sits
+            # LATER in the shuffled delivery (delete-before-insert).
+            msgs.append(CrdtMessage(ts, table, row, column,
+                                    cl.list_delete_value(rng.choice(tag_pool))))
+        elif roll < 0.70:
+            msgs.append(CrdtMessage(ts, table, row, column, rng.choice(
+                ["not json", 5, '["x"]', '["i"]', '["d",7]'])))
+        else:
+            msgs.append(CrdtMessage(ts, table, row, "title", f"t{i}"))
+    msgs += rng.sample(msgs, min(len(msgs), 40))
+    return msgs
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+@pytest.mark.parametrize("seed", [5, 42])
+def test_batched_equals_sequential_oracle(backend, seed):
+    msgs = _random_list_log(seed)
+    db_a, db_b = _mk_db(backend), _mk_db(backend)
+    with db_a.transaction():
+        apply_messages_sequential(db_a, create_initial_merkle_tree(), msgs)
+    apply_messages(db_b, create_initial_merkle_tree(), msgs)
+    assert _dump_all(db_a) == _dump_all(db_b)
+
+
+@pytest.mark.parametrize("seed", [11, 77])
+def test_convergence_under_arbitrary_schedules(seed):
+    """Two replicas, the same op set in UNRELATED orders/partitions →
+    byte-identical state, and the materialized value equals the pure
+    host-oracle replay of the log (the model-check invariant)."""
+    msgs = _random_list_log(seed, n=200)
+    rng = random.Random(seed + 1)
+    dbs = []
+    for _rep in range(2):
+        sh = msgs[:]
+        rng.shuffle(sh)
+        db = _mk_db()
+        tree = create_initial_merkle_tree()
+        i = 0
+        while i < len(sh):
+            j = i + rng.randrange(1, len(sh) - i + 1)
+            tree = apply_messages(db, tree, sh[i:j])
+            i = j
+        dbs.append(db)
+    assert _dump_all(dbs[0]) == _dump_all(dbs[1])
+    expected = cl.replay_log(
+        [m for m in msgs if m.column == "body"])
+    for (t, row, _c), val in expected.items():
+        assert _app_value(dbs[0], "body", row) == val
+
+
+def test_list_cells_never_lww_upsert():
+    """The largest-timestamp op here is a DELETE; the cell must read
+    the materialized array, never the raw op JSON."""
+    base = 1_700_000_000_000
+    mk = lambda i, v: CrdtMessage(  # noqa: E731
+        timestamp_to_string(Timestamp(base + i * 1000, 0, "aaaaaaaaaaaaaaa1")),
+        "doc", "r1", "body", v)
+    t0 = timestamp_to_string(Timestamp(base, 0, "aaaaaaaaaaaaaaa1"))
+    t1 = timestamp_to_string(Timestamp(base + 1000, 0, "aaaaaaaaaaaaaaa1"))
+    msgs = [mk(0, cl.list_insert_value("a")), mk(1, cl.list_insert_value("b", after=t0)),
+            mk(2, cl.list_delete_value(t1))]
+    db = _mk_db()
+    apply_messages(db, create_initial_merkle_tree(), msgs)
+    assert _app_value(db, "body") == '["a"]'
+
+
+def test_malformed_ops_counted_and_ignored():
+    metrics.reset()
+    base = 1_700_000_000_000
+    mk = lambda i, v: CrdtMessage(  # noqa: E731
+        timestamp_to_string(Timestamp(base + i * 1000, 0, "aaaaaaaaaaaaaaa1")),
+        "doc", "r1", "body", v)
+    msgs = [mk(0, cl.list_insert_value("x")), mk(1, "not-json"), mk(2, 5)]
+    db = _mk_db()
+    apply_messages(db, create_initial_merkle_tree(), msgs)
+    assert _app_value(db, "body") == '["x"]'
+    assert metrics.get_counter("evolu_crdt_malformed_ops_total", type="list") == 2
+    assert len(db.exec_sql_query('SELECT * FROM "__message"')) == 3
+
+
+def test_late_declaration_folds_predeclaration_ops():
+    """Rolling upgrade: list ops that reached __message while the
+    column was still LWW fold at declaration time — both replicas
+    materialize identically regardless of declaration timing."""
+    base = 1_700_000_000_000
+    t0 = timestamp_to_string(Timestamp(base, 0, "aaaaaaaaaaaaaaa1"))
+    mk = lambda i, v: CrdtMessage(  # noqa: E731
+        timestamp_to_string(Timestamp(base + i * 1000, 0, "aaaaaaaaaaaaaaa1")),
+        "doc", "r1", "body", v)
+    ops = [mk(0, cl.list_insert_value("a")),
+           mk(1, cl.list_insert_value("b", after=t0))]
+
+    late = open_database(":memory:", "python")
+    init_db_model(late, MN)
+    update_db_schema(late, [TableDefinition.of("doc", ("title", "body"))])
+    apply_messages(late, create_initial_merkle_tree(), ops)
+    assert _app_value(late, "body") == ops[1].value  # LWW winner, pre-upgrade
+    update_db_schema(late, [SCHEMA_DEF])  # the upgrade declares the type
+
+    early = _mk_db()
+    apply_messages(early, create_initial_merkle_tree(), ops)
+    for db in (late, early):
+        assert _app_value(db, "body") == '["a","b"]'
+    assert _dump_all(late)[2:] == _dump_all(early)[2:]
+    # Later ops keep folding incrementally on both.
+    more = [mk(10, cl.list_delete_value(t0))]
+    for db in (late, early):
+        apply_messages(db, create_initial_merkle_tree(), more)
+        assert _app_value(db, "body") == '["b"]'
+
+
+def test_rebuild_state_matches_incremental():
+    msgs = _random_list_log(123, n=200)
+    db = _mk_db()
+    apply_messages(db, create_initial_merkle_tree(), msgs)
+    before = _dump_all(db)
+    ct.rebuild_state(db, ct.load_schema(db))
+    assert _dump_all(db) == before
+
+
+def test_reset_owner_drops_list_state():
+    from evolu_tpu.runtime.client import create_evolu
+
+    e = create_evolu({"doc": ("body:list",)}, config=Config(backend="cpu"))
+    try:
+        row = e.create("doc", {})
+        e.list_append("doc", row, "body", "x")
+        e.worker.flush()
+        assert e.db.exec_sql_query('SELECT * FROM "__crdt_list"')
+        e.reset_owner()
+        e.worker.flush()
+        e.update_db_schema({"doc": ("body:list",)})
+        e.worker.flush()
+        assert ct.load_schema(e.db).column_type("doc", "body") == "list"
+        assert e.db.exec_sql_query('SELECT * FROM "__crdt_list"') == []
+    finally:
+        e.dispose()
+
+
+# --- 6. client API: drain-before-observe ---
+
+
+def test_client_api_interleaved_inserts_and_deletes():
+    from evolu_tpu.runtime.client import create_evolu
+
+    e = create_evolu({"doc": ("body:list",)}, config=Config(backend="cpu"))
+    try:
+        row = e.create("doc", {})
+        # Two appends with NO flush between them: the drain inside
+        # list_append must observe the first before anchoring the
+        # second (the set_remove lesson — without it they'd reverse).
+        e.list_append("doc", row, "body", "a")
+        e.list_append("doc", row, "body", "b")
+        elems = e.list_elements("doc", row, "body")
+        assert [v for _t, v in elems] == ["a", "b"]
+        e.list_insert("doc", row, "body", "mid", after=elems[0][0])
+        e.list_insert("doc", row, "body", "head")  # after=None = head
+        e.list_delete("doc", row, "body", elems[1][0])
+        got = e.list_elements("doc", row, "body")
+        assert [v for _t, v in got] == ["head", "a", "mid"]
+        assert _app_value(e.db, "body", row) == '["head","a","mid"]'
+    finally:
+        e.dispose()
+
+
+def test_winner_cache_contract_list_cells():
+    """List cells keep slot == MAX(timestamp) (the xor gate) while the
+    app value is the linearized materialization."""
+    from evolu_tpu.runtime.client import create_evolu
+
+    e = create_evolu({"doc": ("body:list",)},
+                     config=Config(backend="tpu", min_device_batch=1))
+    try:
+        e.worker._planner.cache.adaptive = False
+        row = e.create("doc", {})
+        for v in ("x", "y"):
+            e.list_append("doc", row, "body", v)
+        e.worker.flush()
+        cache = e.worker._planner.cache
+        assert cache is not None and cache._slots
+        w1 = np.asarray(cache._w1)
+        w2 = np.asarray(cache._w2)
+        checked_list = 0
+        schema = ct.load_schema(e.db)
+        for (table, r, col), slot in cache._slots.items():
+            got = e.db.exec_sql_query(
+                'SELECT MAX("timestamp") AS m FROM "__message" '
+                'WHERE "table" = ? AND "row" = ? AND "column" = ?',
+                (table, r, col))[0]["m"]
+            k1, k2 = int(w1[slot]), int(w2[slot])
+            cached_ts = timestamp_to_string(
+                Timestamp(k1 >> 16, k1 & 0xFFFF, f"{k2:016x}"))
+            assert cached_ts == got, (table, r, col)
+            if schema.column_type(table, col) == "list":
+                checked_list += 1
+        assert checked_list >= 1
+        assert _app_value(e.db, "body", row) == '["x","y"]'
+    finally:
+        e.dispose()
+
+
+# --- 7. end-to-end: mixed 3-type log through relay + snapshot ---
+
+
+def _converge(replicas, deadline_s=30.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        for r in replicas:
+            r.sync()
+            r.worker.flush()
+        dumps = [r.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"')
+                 for r in replicas]
+        if all(d == dumps[0] for d in dumps):
+            return
+        time.sleep(0.05)
+    raise AssertionError("replicas did not converge in time")
+
+
+def test_mixed_typed_log_relay_replication_snapshot_crc(tmp_path):
+    """ISSUE 14 satellite: counter/awset/list ops in ONE batch ride
+    relay, replication, and snapshot unchanged — relay B converges
+    byte-identically through Merkle anti-entropy, a checkpoint of A
+    restores crc-identically, fresh clients on every relay materialize
+    the same three typed values, and `crdt-list-v1` is negotiated."""
+    from evolu_tpu.runtime.client import create_evolu
+    from evolu_tpu.server import snapshot
+    from evolu_tpu.server.relay import RelayServer, RelayStore
+    from evolu_tpu.sync import protocol
+    from evolu_tpu.sync.client import connect
+
+    schema = {"doc": ("title", "clicks:counter", "tags:awset", "body:list")}
+    a = RelayServer(RelayStore(), peers=[]).start()
+    b = c = None
+    e1 = e2 = e3 = None
+    try:
+        e1 = create_evolu(schema, config=Config(sync_url=a.url))
+        connect(e1)
+        row = e1.create("doc", {"title": "page"})
+        e1.list_append("doc", row, "body", "H")
+        # ONE Send carrying all three op kinds (the mixed batch). The
+        # list op anchors at the head (in-batch elements are unstamped,
+        # so there is nothing to observe — documented contract).
+        with e1.batching():
+            e1.increment("doc", row, "clicks", 5)
+            e1.set_add("doc", row, "tags", "red")
+            e1.list_insert("doc", row, "body", "i")
+        e1.worker.flush()
+        e1.list_append("doc", row, "body", "!")
+        # Document order is now [i, H, !]; delete the H in the middle.
+        elems = e1.list_elements("doc", row, "body")
+        assert [v for _t, v in elems] == ["i", "H", "!"]
+        e1.list_delete("doc", row, "body", elems[1][0])
+        e1.worker.flush()
+        e1.sync()
+        e1.worker.flush()
+        e1._transport.flush()
+        caps = e1._transport.negotiated_capabilities
+        assert any(protocol.CAP_CRDT_LIST in v for v in caps.values()), caps
+
+        owner = e1.owner.id
+        state = lambda store: (  # noqa: E731
+            store.get_merkle_tree_string(owner),
+            store.replica_messages(owner, ""),
+        )
+        b = RelayServer(RelayStore(), peers=[a.url],
+                        replication_interval_s=0.1).start()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if state(b.store) == state(a.store) and state(a.store)[1]:
+                break
+            time.sleep(0.05)
+        assert state(b.store) == state(a.store)
+
+        path = str(tmp_path / "a.checkpoint")
+        snapshot.write_checkpoint(a.store, path)
+        fresh = RelayStore()
+        snapshot.restore_checkpoint(fresh, path)
+        crc = lambda store: zlib.crc32(repr(state(store)).encode())  # noqa: E731
+        assert crc(fresh) == crc(a.store)
+        c = RelayServer(fresh).start()
+
+        e2 = create_evolu(schema, config=Config(sync_url=b.url),
+                          mnemonic=e1.owner.mnemonic)
+        e3 = create_evolu(schema, config=Config(sync_url=c.url),
+                          mnemonic=e1.owner.mnemonic)
+        connect(e2)
+        connect(e3)
+        _converge([e1, e2])
+        _converge([e1, e3])
+        for e in (e1, e2, e3):
+            r = e.db.exec_sql_query(
+                'SELECT "clicks", "tags", "body" FROM "doc"')[0]
+            assert (r["clicks"], r["tags"], r["body"]) \
+                == (5, '["red"]', '["i","!"]')
+        dumps = [e.db.exec_sql_query('SELECT * FROM "__crdt_list" ORDER BY "tag"')
+                 for e in (e1, e2, e3)]
+        assert dumps[0] == dumps[1] == dumps[2]
+    finally:
+        for e in (e1, e2, e3):
+            if e is not None:
+                e.dispose()
+        for s in (a, b, c):
+            if s is not None:
+                s.stop()

@@ -37,7 +37,8 @@ from evolu_tpu.utils.config import Config
 MN = "legal winner thank year wave sausage worth useful legal winner thank yellow"
 GOLDEN = json.loads((Path(__file__).parent / "fixtures" / "crdt_golden.json").read_text())
 
-SCHEMA_DEF = TableDefinition.of("metrics", ("name", "clicks:counter", "tags:awset"))
+SCHEMA_DEF = TableDefinition.of(
+    "metrics", ("name", "clicks:counter", "tags:awset", "items:list"))
 
 
 def _mk_db(backend="python"):
@@ -296,31 +297,44 @@ def test_counter_shard_sums_core_groups_by_owner_cell():
 
 
 def _random_mixed_log(seed, n=300):
+    from evolu_tpu.core import crdt_list as cl
+
     rng = random.Random(seed)
     nodes = ["aaaaaaaaaaaaaaa1", "bbbbbbbbbbbbbbb2"]
     msgs = []
     tag_pool = []
+    elem_pool = []  # list element tags (insert op timestamps)
     for i in range(n):
         ts = timestamp_to_string(
             Timestamp(1_700_000_000_000 + i * 977, i % 3, rng.choice(nodes))
         )
         roll = rng.random()
         row = f"r{rng.randrange(6)}"
-        if roll < 0.3:
+        if roll < 0.25:
             msgs.append(CrdtMessage(ts, "metrics", row, "clicks",
                                     rng.randrange(-50, 50)))
-        elif roll < 0.45:
+        elif roll < 0.38:
             msgs.append(CrdtMessage(ts, "metrics", row, "tags",
                                     ct.set_add_value(rng.choice("abcde"))))
             tag_pool.append(ts)
-        elif roll < 0.55 and tag_pool:
+        elif roll < 0.46 and tag_pool:
             observed = rng.sample(tag_pool, min(len(tag_pool), rng.randrange(0, 4)))
             msgs.append(CrdtMessage(ts, "metrics", row, "tags",
                                     ct.set_remove_value(rng.choice("abcde"), observed)))
-        elif roll < 0.62:
+        elif roll < 0.58:
+            after = rng.choice(elem_pool) if elem_pool and rng.random() < 0.7 \
+                else None
+            msgs.append(CrdtMessage(ts, "metrics", row, "items",
+                                    cl.list_insert_value(f"e{i}", after=after)))
+            elem_pool.append(ts)
+        elif roll < 0.64 and elem_pool:
+            msgs.append(CrdtMessage(ts, "metrics", row, "items",
+                                    cl.list_delete_value(rng.choice(elem_pool))))
+        elif roll < 0.72:
             # Malformed typed ops: must be ignored identically everywhere.
             col, val = rng.choice([("clicks", "oops"), ("clicks", 2**40),
-                                   ("tags", "{not json"), ("tags", 5)])
+                                   ("tags", "{not json"), ("tags", 5),
+                                   ("items", "nope"), ("items", '["i"]')])
             msgs.append(CrdtMessage(ts, "metrics", row, col, val))
         else:
             msgs.append(CrdtMessage(ts, "metrics", row, "name", f"n{i}"))
@@ -336,6 +350,8 @@ def _dump_all(db):
         db.exec_sql_query('SELECT * FROM "__crdt_counter" ORDER BY "table", "row", "column"'),
         db.exec_sql_query('SELECT * FROM "__crdt_set" ORDER BY "tag"'),
         db.exec_sql_query('SELECT * FROM "__crdt_kill" ORDER BY "tag"'),
+        db.exec_sql_query('SELECT * FROM "__crdt_list" ORDER BY "tag"'),
+        db.exec_sql_query('SELECT * FROM "__crdt_list_kill" ORDER BY "tag"'),
     )
 
 

@@ -595,6 +595,195 @@ def _run_mixed_crdt_episode():
         b.stop()
 
 
+def test_list_crdt_partition_heal_adversarial_clocks_episode():
+    """ISSUE 14 satellite (ROADMAP #5 dose): the RGA list type through
+    a 2-relay FLEET under regressing/stuttering HLC clocks, a PARTITION
+    stretch (both replicas mutate offline, with concurrent interleaved
+    inserts at the SAME anchor and a delete racing an insert anchored
+    on the deleted element), a NON-CANONICAL batch bouncing to the host
+    oracle mid-partition, then heal. Asserts byte-identical convergence
+    of app + __crdt_list state, winner-cache == MAX(timestamp) on the
+    device replica, and list materialization == the pure host-oracle
+    replay of the merged op log."""
+    with _evidence("model-check-list-crdt", 20260805):
+        _run_list_crdt_episode()
+
+
+def _run_list_crdt_episode():
+    import numpy as np
+
+    from evolu_tpu.core import crdt_list as cl
+    from evolu_tpu.core.merkle import create_initial_merkle_tree
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.core.types import CrdtMessage
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.utils.config import FleetConfig
+
+    seed = 20260805
+    rng = random.Random(seed)
+    base = int(time.time() * 1000)
+
+    def adversarial_now(sub_seed):
+        r = random.Random(sub_seed)
+        state = {"t": base}
+
+        def now():
+            roll = r.random()
+            if roll < 0.4:
+                pass  # stutter: frozen clock
+            elif roll < 0.6:
+                state["t"] = max(base - 20_000,
+                                 state["t"] - r.randrange(0, 10_000))
+            else:
+                state["t"] += r.randrange(1, 400)
+            return state["t"]
+
+        return now
+
+    schema = {"doc": ("title", "body:list")}
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    b = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    fleet_cfg = FleetConfig(relays=(a.url, b.url), replication_factor=1,
+                            version=1)
+    a.enable_fleet(fleet_cfg)
+    b.enable_fleet(fleet_cfg)
+    replicas = []
+    errors = []
+    try:
+        r1 = create_evolu(schema, config=Config(sync_url=a.url, backend="tpu"))
+        r2 = create_evolu(schema, config=Config(sync_url=b.url, backend="cpu"),
+                          mnemonic=r1.owner.mnemonic)
+        replicas = [r1, r2]
+        for i, r in enumerate(replicas):
+            r.worker.now = adversarial_now(seed + i)
+            r.subscribe_error(errors.append)
+            connect(r)
+
+        # Phase 1 (online): seed a shared document so both sides know
+        # the same anchors, and keep syncing.
+        row = r1.create("doc", {"title": "shared"})
+        for v in ("a", "b", "c", "d"):
+            r1.list_append("doc", row, "body", v)
+        r1.worker.flush()
+        _converge(replicas)
+        elems = r1.list_elements("doc", row, "body")
+        assert [v for _t, v in elems] == ["a", "b", "c", "d"]
+        anchor = elems[1][0]        # "b" — the contested anchor
+        victim = elems[2][0]        # "c" — deleted on one side, anchored on the other
+
+        # Phase 2 (PARTITION): no sync rounds. Both replicas interleave
+        # inserts at the SAME anchor; r2 deletes the element r1 keeps
+        # anchoring on (tombstone-position semantics under fire).
+        r2.list_delete("doc", row, "body", victim)
+        for step in range(24):
+            r = replicas[step % 2]
+            roll = rng.random()
+            if roll < 0.55:
+                r.list_insert("doc", row, "body",
+                              f"p{(step % 2) + 1}-{step}", after=anchor)
+            elif roll < 0.75:
+                r.list_insert("doc", row, "body",
+                              f"v{(step % 2) + 1}-{step}", after=victim)
+            else:
+                r.list_append("doc", row, "body", f"t{(step % 2) + 1}-{step}")
+            r.worker.flush()
+
+        # Mid-partition hostile case: a NON-CANONICAL (uppercase node
+        # hex) remote batch — LWW cells bounce the device planner to
+        # the host oracle (winner-cache invalidation included on the
+        # tpu replica) and a list op proves the fold is case-blind
+        # (dedup is by raw string). Injected into BOTH replicas so the
+        # merged histories stay identical.
+        bounces_before = metrics.get_counter("evolu_merge_host_fallbacks_total")
+        empty_tree = merkle_tree_to_string(create_initial_merkle_tree())
+
+        def nc_ts(i):
+            s = timestamp_to_string(
+                Timestamp(base + 5000 + i, i, "00000000000000ab"))
+            return s[:30] + s[30:].upper()
+
+        hostile = tuple(
+            [CrdtMessage(nc_ts(j), "doc", "remrow", "title", f"h{j}")
+             for j in range(3)]
+            + [CrdtMessage(nc_ts(7), "doc", "remrow", "body",
+                           cl.list_insert_value("ghostwrite"))])
+        for r in replicas:
+            r.receive(hostile, empty_tree)
+            r.worker.flush()
+        assert metrics.get_counter(
+            "evolu_merge_host_fallbacks_total") > bounces_before
+
+        # Phase 3 (HEAL): sync rounds resume; fleet routing (R=1, one
+        # authoritative relay) carries both sides to one history.
+        _converge(replicas)
+        for r in replicas:
+            r._transport.flush()
+            r.worker.flush()
+
+        from evolu_tpu.core.types import SyncError
+        real = [e for e in errors if not isinstance(e, SyncError)]
+        assert not real, real
+
+        dumps = []
+        for r in replicas:
+            dumps.append((
+                r.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"'),
+                r.db.exec('SELECT * FROM "doc" ORDER BY "id"'),
+                r.db.exec('SELECT * FROM "__crdt_list" ORDER BY "tag"'),
+                r.db.exec('SELECT * FROM "__crdt_list_kill" ORDER BY "tag"'),
+            ))
+        assert dumps[0] == dumps[1], "list state diverged after partition/heal"
+
+        # List materialization == the pure host-oracle replay of the
+        # merged log (the fold is a function of the op SET alone).
+        body_rows = r1.db.exec_sql_query(
+            'SELECT "timestamp", "table", "row", "column", "value" '
+            'FROM "__message" WHERE "table" = ? AND "column" = ?',
+            ("doc", "body"))
+        replayed = cl.replay_log([
+            CrdtMessage(r["timestamp"], r["table"], r["row"], r["column"],
+                        r["value"]) for r in body_rows])
+        assert replayed, "episode produced no list traffic"
+        for (_t, rid, _c), val in replayed.items():
+            got = r1.db.exec_sql_query(
+                'SELECT "body" FROM "doc" WHERE "id" = ?', (rid,))[0]["body"]
+            assert got == val, (rid, got, val)
+
+        # Both partition sides' same-anchor inserts survived, and the
+        # deleted anchor's tombstone still anchored its children.
+        final = [v for _t, v in r1.list_elements("doc", row, "body")]
+        assert any(v.startswith("p1-") for v in final)
+        assert any(v.startswith("p2-") for v in final)
+        assert any(v.startswith("v") for v in final)
+        assert "c" not in final  # the victim stayed deleted
+        # The non-canonical list op folded into its own row's cell.
+        assert r1.db.exec_sql_query(
+            'SELECT "body" FROM "doc" WHERE "id" = ?',
+            ("remrow",))[0]["body"] == '["ghostwrite"]'
+
+        # Winner-cache == MAX(timestamp) on the device replica.
+        cache = r1.worker._planner.cache
+        w1 = np.asarray(cache._w1)
+        w2 = np.asarray(cache._w2)
+        for (table, rr, col), slot in cache._slots.items():
+            got = r1.db.exec_sql_query(
+                'SELECT MAX("timestamp") AS m FROM "__message" '
+                'WHERE "table" = ? AND "row" = ? AND "column" = ?',
+                (table, rr, col))[0]["m"]
+            k1, k2 = int(w1[slot]), int(w2[slot])
+            if k1 == 0 and k2 == 0:
+                assert got is None, (table, rr, col)
+                continue
+            cached_ts = timestamp_to_string(
+                Timestamp(k1 >> 16, k1 & 0xFFFF, f"{k2:016x}"))
+            assert cached_ts == got, (table, rr, col)
+    finally:
+        for r in replicas:
+            r.dispose()
+        a.stop()
+        b.stop()
+
+
 def test_no_stale_query_results_adversarial_clocks_host_bounce():
     """ISSUE 9 satellite (ROADMAP #5 small dose): one seeded adversarial
     episode through the changed-set-gated query invalidation layer —

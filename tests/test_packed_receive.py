@@ -373,6 +373,7 @@ def test_packed_typed_cells_bounce_before_side_effects():
     would LWW-upsert raw op values, and the typed fold needs message
     objects. Pinned: plan_packed is NEVER consulted, the bounce
     counter moves, and the end state equals the pure object path."""
+    from evolu_tpu.core import crdt_list as cl
     from evolu_tpu.core import crdt_types as ct
     from evolu_tpu.obs import metrics
     from evolu_tpu.runtime.worker import select_planner
@@ -382,17 +383,27 @@ def test_packed_typed_cells_bounce_before_side_effects():
     rng = random.Random(21)
     base = 1_700_000_000_000
     msgs = []
+    elem_pool = []
     for i in range(300):
         ts = timestamp_to_string(
             Timestamp(base + i * 977, i % 3, "a1b2c3d4e5f60718"))
         roll = rng.random()
         row = f"row{rng.randrange(20)}"
-        if roll < 0.4:
+        if roll < 0.3:
             msgs.append(CrdtMessage(ts, "todo", row, "votes",
                                     rng.randrange(-9, 10)))
-        elif roll < 0.6:
+        elif roll < 0.5:
             msgs.append(CrdtMessage(ts, "todo", row, "labels",
                                     ct.set_add_value(rng.choice("xyz"))))
+        elif roll < 0.65:
+            after = rng.choice(elem_pool) if elem_pool and rng.random() < 0.7 \
+                else None
+            msgs.append(CrdtMessage(ts, "todo", row, "notes",
+                                    cl.list_insert_value(f"n{i}", after=after)))
+            elem_pool.append(ts)
+        elif roll < 0.72 and elem_pool:
+            msgs.append(CrdtMessage(ts, "todo", row, "notes",
+                                    cl.list_delete_value(rng.choice(elem_pool))))
         else:
             msgs.append(CrdtMessage(ts, "todo", row, "title", f"t{i}"))
     resp = _response_bytes(msgs)
@@ -403,7 +414,7 @@ def test_packed_typed_cells_bounce_before_side_effects():
         db = open_database(backend="auto")
         init_db_model(db, mnemonic=None)
         update_db_schema(db, [TableDefinition.of(
-            "todo", ("title", "votes:counter", "labels:awset"))])
+            "todo", ("title", "votes:counter", "labels:awset", "notes:list"))])
         return db
 
     def dump(db):
@@ -415,6 +426,8 @@ def test_packed_typed_cells_bounce_before_side_effects():
             db.exec_sql_query('SELECT * FROM "todo" ORDER BY "id"', ()),
             db.exec_sql_query('SELECT * FROM "__crdt_counter" ORDER BY "row","column"', ()),
             db.exec_sql_query('SELECT * FROM "__crdt_set" ORDER BY "tag"', ()),
+            db.exec_sql_query('SELECT * FROM "__crdt_list" ORDER BY "tag"', ()),
+            db.exec_sql_query('SELECT * FROM "__crdt_list_kill" ORDER BY "tag"', ()),
         )
 
     results = {}
