@@ -32,11 +32,15 @@ import jax
 import numpy as np
 
 import bench
-from evolu_tpu.obs import flight, metrics
+from evolu_tpu.obs import flight, ledger, metrics
 from evolu_tpu.utils.log import logger
 
 REPS_LO, REPS_HI = 200, 2000
 ITERS_LO, ITERS_HI = 2, 10
+
+# Conservation-ledger + sentinel gate (ISSUE 15): their combined
+# per-batch cost must stay <= 0.1% of the config-2 reconcile leg.
+LEDGER_GATE_FRACTION = 0.001
 
 
 def instrumentation_sequence():
@@ -62,19 +66,54 @@ def instrumentation_sequence():
     metrics.set_gauge("evolu_winner_cache_streaming", 0)
 
 
-def measure_instrumentation_ms():
-    """Slope between two repetition counts of the per-batch sequence."""
+_OWNERS = [f"owner{i:04d}" for i in range(32)]
+
+
+def ledger_sentinel_sequence():
+    """The ledger + sentinel work ONE config-2 engine pass performs
+    (32 requests / 32 owners / 1M rows): per-request relay ingress
+    counts, the pass's pending-entry terminal classification, the
+    recompile-sentinel gauge refresh, and the tunnel-pull wave
+    instrumentation. Deliberately a superset (real passes skip
+    zero-count stations for free)."""
+    for o in _OWNERS:
+        ledger.count(ledger.INGRESS_SYNC, 31250, owner=o)
+    entry = ledger.pending()
+    for o in _OWNERS:
+        entry.count(ledger.STORE_INSERTED, 31250, owner=o)
+        entry.count(ledger.STORE_DUPLICATE, 0, owner=o)
+    entry.commit()
+    # Recompile sentinel: two cache gauges + the flat-diff bookkeeping.
+    metrics.set_gauge("evolu_jit_cache_size", 7, cache="merkle")
+    metrics.set_gauge("evolu_jit_cache_size", 0, cache="mesh")
+    # Tunnel-bandwidth plane: one output wave of the merkle kernel.
+    metrics.inc("evolu_pull_bytes_total", 48_000_000)
+    metrics.inc("evolu_pull_seconds_total", 3.0)
+    metrics.observe("evolu_pull_wave_bytes", 48_000_000,
+                    buckets=metrics.SIZE_BUCKETS)
+
+
+def _slope_ms(fn):
+    """Slope between two repetition counts of a per-batch sequence."""
     def timed(reps):
         runs = []
         for _ in range(7):
             t0 = time.perf_counter()
             for _ in range(reps):
-                instrumentation_sequence()
+                fn()
             runs.append(time.perf_counter() - t0)
         return statistics.median(runs)
 
     t_lo, t_hi = timed(REPS_LO), timed(REPS_HI)
     return (t_hi - t_lo) / (REPS_HI - REPS_LO) * 1e3  # ms per batch
+
+
+def measure_instrumentation_ms():
+    return _slope_ms(instrumentation_sequence)
+
+
+def measure_ledger_sentinel_ms():
+    return _slope_ms(ledger_sentinel_sequence)
 
 
 def measure_reconcile_batch_ms():
@@ -107,14 +146,19 @@ def measure_reconcile_batch_ms():
 def main():
     logger.clear()
     instr_ms = measure_instrumentation_ms()
+    ledger_ms = measure_ledger_sentinel_ms()
     batch_ms = measure_reconcile_batch_ms()
     print(json.dumps({
         "metric": "obs_instrumentation_overhead_on_1m_reconcile",
         "instrumentation_ms_per_batch": round(instr_ms, 5),
+        "ledger_sentinel_ms_per_batch": round(ledger_ms, 5),
         "reconcile_ms_per_batch": round(batch_ms, 3),
         "overhead_fraction": round(instr_ms / batch_ms, 6),
         "overhead_pct": round(100 * instr_ms / batch_ms, 4),
         "pass_1pct_gate": instr_ms / batch_ms <= 0.01,
+        "ledger_overhead_fraction": round(ledger_ms / batch_ms, 6),
+        "ledger_overhead_pct": round(100 * ledger_ms / batch_ms, 4),
+        "pass_ledger_0p1pct_gate": ledger_ms / batch_ms <= LEDGER_GATE_FRACTION,
         "device_graph_untouched": "pinned by tests/test_bench_liveness.py",
         "platform": jax.devices()[0].platform,
         "method": "two-point slope on both legs (fixed overhead cancelled)",

@@ -9,6 +9,13 @@ GET /stats (server/relay.py).
 `obs.flight` — bounded structured-event ring whose dump is attached to
 exceptions crossing the worker/relay boundary.
 
+`obs.ledger` — the conservation-ledger accounting plane (ISSUE 15):
+typed flow stations with registered conservation equations, an
+`audit()` that returns violated equations with per-station deltas
+(empty == conserved), owner-scoped sub-ledgers behind the cardinality
+cap, served by the relay at GET /ledger and asserted at the end of
+every model-check episode.
+
 `obs.trace` — W3C-traceparent-style distributed tracing: a bounded
 per-process span ring, deterministic hash-based sampling, fan-in span
 links, `GET /trace/<id>` span trees, and a Chrome-trace export
@@ -24,8 +31,9 @@ benchmarks/trace_overhead.py) and mechanically enforced by
 tests/test_import_hygiene.py and tests/test_bench_liveness.py.
 """
 
-from evolu_tpu.obs import flight, metrics, trace
+from evolu_tpu.obs import flight, ledger, metrics, trace
 from evolu_tpu.obs.flight import recorder
 from evolu_tpu.obs.metrics import registry, set_enabled
 
-__all__ = ["flight", "metrics", "trace", "recorder", "registry", "set_enabled"]
+__all__ = ["flight", "ledger", "metrics", "trace", "recorder", "registry",
+           "set_enabled"]

@@ -232,35 +232,49 @@ class MetricsRegistry:
 
     def quantile(self, name: str, q: float, **labels) -> Optional[float]:
         """Estimate the q-quantile (0..1) from a histogram's log-spaced
-        buckets by linear interpolation inside the bucket. The +Inf
-        bucket clamps to the top finite edge — an estimate, not exact."""
+        buckets by linear interpolation inside the bucket. Mass in the
+        overflow bucket clamps to the TOP FINITE bucket edge — never
+        +Inf, even when a caller registered an explicit inf edge or all
+        mass sits past the last finite bound (an estimate, not exact;
+        dashboards need a plottable number)."""
         got = self.get_histogram(name, **labels)
         if got is None:
             return None
         edges, cum, _s, count = got
         if count == 0:
             return None
+        import math
+
+        finite = [e for e in edges if math.isfinite(e)]
+        top = float(finite[-1]) if finite else 0.0
         target = q * count
         lo_edge = 0.0
         for i, hi_cum in enumerate(cum):
             if hi_cum >= target:
-                if i >= len(edges):  # +Inf bucket
-                    return float(edges[-1])
+                if i >= len(edges) or not math.isfinite(edges[i]):
+                    return top  # overflow mass (implicit or explicit inf)
                 lo_cum = cum[i - 1] if i else 0
                 width = hi_cum - lo_cum
                 frac = (target - lo_cum) / width if width else 1.0
                 return lo_edge + frac * (edges[i] - lo_edge)
-            if i < len(edges):
+            if i < len(edges) and math.isfinite(edges[i]):
                 lo_edge = edges[i]
-        return float(edges[-1])
+        return top
 
     def reset(self) -> None:
         with self._lock:
-            self._counters.clear()
-            self._gauges.clear()
-            self._hists.clear()
-            # _buckets/_help persist: family shape is configuration,
-            # not data — a post-reset observe keeps identical buckets.
+            self._clear_locked()
+
+    def _clear_locked(self) -> None:
+        """Clear every family. Caller holds the lock — reset must be
+        atomic against concurrent inc/observe, or a racing writer could
+        see one family cleared and another not (half-cleared snapshots;
+        hammer-tested in tests/test_obs.py)."""
+        self._counters.clear()
+        self._gauges.clear()
+        self._hists.clear()
+        # _buckets/_help persist: family shape is configuration,
+        # not data — a post-reset observe keeps identical buckets.
 
     # -- exposition --
 
@@ -307,9 +321,14 @@ class MetricsRegistry:
             lines.append(f"# HELP {name} {_escape(help_)}")
         lines.append(f"# TYPE {name} {typ}")
 
-    def snapshot(self) -> dict:
+    def snapshot(self, reset: bool = False) -> dict:
         """JSON-ready snapshot of every metric (same data as the text
-        exposition, structured)."""
+        exposition, structured). With `reset=True`, the snapshot and
+        the clear happen under ONE lock acquisition: an event can land
+        either wholly before (in the snapshot) or wholly after (in the
+        next window) — never be lost between a separate snapshot() and
+        reset() pair (the drain-window contract the baseline-drift and
+        ledger tooling rely on; hammer-tested)."""
         with self._lock:
             out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
             for name, fam in self._counters.items():
@@ -334,10 +353,69 @@ class MetricsRegistry:
                     }
                     for k, h in sorted(fam.items())
                 ]
+            if reset:
+                self._clear_locked()
             return out
 
     def snapshot_json(self) -> str:
         return json.dumps(self.snapshot())
+
+
+# -- build info + process gauges (ISSUE 15 satellite) --
+
+_PROCESS_START = time.time()
+
+
+def set_build_info(**labels) -> None:
+    """Publish `evolu_build_info` — the constant-1 gauge whose LABELS
+    carry the facts (version, backend, mesh device count, the
+    write-behind/mesh/conn-tier flags): fleet dashboards tell a
+    mesh-sharded event-loop relay from a default one by scraping, not
+    SSH. Call once per process at server start; last call wins (one
+    series — the relay re-publishes on reconfigure)."""
+    registry.describe(
+        "evolu_build_info",
+        "constant 1; labels identify this process's build and topology",
+    )
+    registry.set_gauge(
+        "evolu_build_info", 1, **{k: str(v) for k, v in labels.items()}
+    )
+
+
+def _read_rss_bytes() -> Optional[float]:
+    """Current RSS. /proc (exact, Linux) with a getrusage fallback
+    (ru_maxrss = peak, close enough where /proc is absent). Never
+    raises — a gauge is not worth a failed scrape."""
+    try:
+        with open("/proc/self/statm", "r") as f:
+            fields = f.read().split()
+        import os as _os
+
+        return float(fields[1]) * _os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001 - non-Linux / masked procfs
+        try:
+            import resource
+            import sys as _sys
+
+            peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            # ru_maxrss units differ by platform: KiB on Linux, BYTES
+            # on macOS/BSD — exactly where this fallback actually runs.
+            if _sys.platform != "darwin":
+                peak *= 1024.0
+            return float(peak)
+        except Exception:  # noqa: BLE001
+            return None
+
+
+def update_process_gauges() -> None:
+    """Refresh `evolu_process_uptime_seconds` / `evolu_process_rss_bytes`
+    — called by the relay right before rendering /metrics or /stats so
+    scrapes always carry current values without a background thread."""
+    registry.set_gauge("evolu_process_uptime_seconds",
+                       time.time() - _PROCESS_START)
+    rss = _read_rss_bytes()
+    if rss is not None:
+        registry.set_gauge("evolu_process_rss_bytes", rss)
 
 
 def _bisect(edges: Sequence[float], value: float) -> int:

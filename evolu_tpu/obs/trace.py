@@ -551,7 +551,7 @@ def write_evidence(label: str, seed=None, extra: Optional[dict] = None) -> str:
     try:
         import tempfile
 
-        from evolu_tpu.obs import flight, metrics
+        from evolu_tpu.obs import flight, ledger, metrics
 
         payload = {
             "label": label,
@@ -565,6 +565,10 @@ def write_evidence(label: str, seed=None, extra: Optional[dict] = None) -> str:
             ],
             "trace": export_chrome(),
             "metrics": metrics.snapshot(),
+            # The conservation proof state at failure time: station
+            # totals + per-owner sub-ledgers + the audit verdict — a
+            # failed episode arrives knowing where every message went.
+            "ledger": ledger.snapshot(),
         }
         if extra:
             payload["extra"] = extra

@@ -570,12 +570,18 @@ def _host_fallback(messages, existing_winners, n, with_deltas=False):
     persisting a non-canonical winner into a hot cell) is visible in
     the kernel logs. `with_deltas` keeps plan_batch_device_full's
     3-tuple contract (host fold with verbatim node case)."""
-    from evolu_tpu.obs import metrics
+    from evolu_tpu.obs import ledger, metrics
     from evolu_tpu.storage.apply import plan_batch
     from evolu_tpu.utils.log import log
 
     metrics.inc("evolu_merge_host_fallbacks_total")
     metrics.inc("evolu_merge_host_fallback_messages_total", n)
+    # Ledger TALLY stations (outside the flow equations — the batch's
+    # flow still terminates through whichever apply route consumes this
+    # plan): how many messages were planned by the host oracle, and the
+    # canonicality bounce that sent them here.
+    ledger.count(ledger.ROUTE_HOST_FALLBACK, n)
+    ledger.count(ledger.BOUNCE_NON_CANONICAL, n)
     log("kernel:merge", "non-canonical hex case: host-planner fallback", n=n)
     xor_mask, upserts = plan_batch(messages, existing_winners)
     if not with_deltas:

@@ -45,7 +45,7 @@ from evolu_tpu.parallel.mesh import (
     require_single_process,
     sharding,
 )
-from evolu_tpu.obs import metrics
+from evolu_tpu.obs import flight, ledger, metrics
 from evolu_tpu.parallel.reconcile import xor_allreduce
 from evolu_tpu.server.relay import RelayStore
 from evolu_tpu.utils.log import log, span
@@ -63,6 +63,43 @@ def merkle_jit_cache_size() -> int:
     liveness fence uses); if a jax upgrade drops it, degrade to 0 so
     only the fence test fails loudly, not production callers."""
     return sum(getattr(k, "_cache_size", lambda: 0)() for k in _JIT_KERNELS)
+
+
+# Recompile sentinel (ISSUE 15 satellite): last-observed cache sizes,
+# diffed after each scheduler batch. Single-writer by construction —
+# only the scheduler's dispatcher thread calls observe_jit_caches.
+_JIT_SENTINEL_SIZES: Dict[str, int] = {}
+
+
+def observe_jit_caches(batch_rows: int = 0) -> Dict[str, int]:
+    """The CLAUDE.md recompile fence, observable in production: export
+    `evolu_jit_cache_size{cache}` gauges and grow
+    `evolu_jit_recompiles_total{cache}` by the diff of
+    `merkle_jit_cache_size()` / `mesh_jit_cache_size()` since the last
+    batch. Growth also drops a flight-recorder event naming the batch
+    bucket shape that triggered it — the post-mortem answer to "which
+    shape broke bucket stability". The FIRST observation is the
+    baseline (warm-up compiles between observation 1 and 2 count;
+    steady-state traffic within a bucket must then stay flat —
+    test-pinned). Returns {cache: size}."""
+    from evolu_tpu.ops.winner_cache import mesh_jit_cache_size
+
+    sizes = {"merkle": merkle_jit_cache_size(),
+             "mesh": mesh_jit_cache_size()}
+    for cache, size in sizes.items():
+        metrics.set_gauge("evolu_jit_cache_size", size, cache=cache)
+        prev = _JIT_SENTINEL_SIZES.get(cache)
+        if prev is not None and size > prev:
+            metrics.inc("evolu_jit_recompiles_total", size - prev,
+                        cache=cache)
+            flight.record(
+                "kernel:jit", "jit cache grew", cache=cache,
+                new_entries=size - prev, total_entries=size,
+                batch_rows=batch_rows,
+                bucket_rows=bucket_size(max(1, batch_rows)),
+            )
+        _JIT_SENTINEL_SIZES[cache] = size
+    return sizes
 
 
 def _merkle_shard_kernel(millis, counter, node, valid, owner_ix):
@@ -473,6 +510,25 @@ def deltas_finish(state) -> Tuple[Dict[str, Dict[str, int]], int]:
     return deltas, digest ^ int(dev_digest)
 
 
+def _ledger_count_pass(requests, inserted_by_owner) -> None:
+    """Conservation-ledger terminal classification for ONE committed
+    engine pass: per owner, `inserted` rows were new (was-new flags /
+    set-diff), and everything else the owner submitted this pass —
+    including rows the in-batch dedup dropped before they reached a
+    shard buffer — terminates at store.duplicate. Call ONLY after the
+    shard transactions committed: a poisoned (rolled-back) pass must
+    post nothing, or the scheduler's singleton retry would
+    double-count."""
+    totals: Dict[str, int] = {}
+    for r in requests:
+        if r.messages:
+            totals[r.user_id] = totals.get(r.user_id, 0) + len(r.messages)
+    for o, total in totals.items():
+        ins = int(inserted_by_owner.get(o, 0))
+        ledger.count(ledger.STORE_INSERTED, ins, owner=o)
+        ledger.count(ledger.STORE_DUPLICATE, total - ins, owner=o)
+
+
 def _pack_rows(ts_list, contents):
     """Pack one shard's rows into flat buffers. Per-string width check
     BEFORE packing: a total-length check alone would accept
@@ -747,6 +803,7 @@ class BatchReconciler:
                 u: (v[0] if len(v) == 1 else np.concatenate(v))
                 for u, v in owner_index.items()
             }
+            inserted_by_owner.update((u, len(v)) for u, v in merged.items())
             all_m, all_c, all_n, case_ok = (
                 (p[0] if len(p) == 1 else np.concatenate(p)) for p in col_parts
             )
@@ -773,6 +830,7 @@ class BatchReconciler:
                         tree_rows[si],
                     )
 
+        inserted_by_owner: Dict[str, int] = {}
         with span("kernel:merkle", "reconcile_ingest",
                   owners=len({r.user_id for r in requests}), n=n_total,
                   shards=len(live)):
@@ -780,6 +838,7 @@ class BatchReconciler:
             # trees commit atomically.
             with self._shard_transactions(stores, live):
                 ingest_all()
+        _ledger_count_pass(requests, inserted_by_owner)
         return trees
 
     # -- pipelined streaming reconcile (VERDICT r2 #1) --
@@ -931,6 +990,21 @@ class BatchReconciler:
                             "VALUES (?, ?)",
                             tree_rows[si],
                         )
+        # Ledger terminals AFTER the per-shard commits: per-owner
+        # was-new sums classify inserted; the per-owner request totals
+        # in _ledger_count_pass fold the in-batch-deduped rows into
+        # store.duplicate automatically.
+        ins_by_owner: Dict[str, int] = {}
+        for si in live:
+            gu, gc, _tsp, _cp, _lens = shard_data[si]
+            was_new = was_new_by_shard[si]
+            pos = 0
+            for u, k in zip(gu, gc):
+                ins_by_owner[u] = ins_by_owner.get(u, 0) + int(
+                    np.asarray(was_new[pos : pos + k]).sum()
+                )
+                pos += k
+        _ledger_count_pass(st["requests"], ins_by_owner)
         return respond(st["requests"], trees, strings)
 
     def _recompute_duplicate_owners(self, st, was_new_by_shard, deltas_by_owner) -> None:
@@ -1048,6 +1122,9 @@ class BatchReconciler:
                     'INSERT OR REPLACE INTO "merkleTree" ("userId", "merkleTree") VALUES (?, ?)',
                     (o, s),
                 )
+        _ledger_count_pass(
+            requests, {o: len(ms) for o, ms in new_by_owner.items()}
+        )
         return trees
 
     def _resolve_tree(self, user_id: str, trees, tree_strings):
@@ -1189,6 +1266,25 @@ class BatchReconciler:
             wb.append_batch(
                 records, {o: (trees[o], strings[o]) for o in strings}
             )
+            # Ledger: append_batch counted wb.queued for every row that
+            # entered the log (the ACK); rows the in-batch dedup
+            # dropped never reach the queue and terminate HERE as
+            # store.duplicate. The queued rows' inserted/duplicate
+            # split is classified exactly at drain time
+            # (write_behind._materialize) — nothing is posted if the
+            # append raised (backpressure = no state anywhere).
+            kept: Dict[str, int] = {}
+            for si in live:
+                gu, gc, _tsp, _cp, _lens = shard_data[si]
+                for u, k in zip(gu, gc):
+                    kept[u] = kept.get(u, 0) + k
+            totals: Dict[str, int] = {}
+            for r in requests:
+                if r.messages:
+                    totals[r.user_id] = totals.get(r.user_id, 0) + len(r.messages)
+            for o, total in totals.items():
+                ledger.count(ledger.STORE_DUPLICATE, total - kept.get(o, 0),
+                             owner=o)
         return self._respond_deferred(requests, trees, strings)
 
     def _resolve_tree_deferred(self, user_id: str, trees, tree_strings):
@@ -1505,6 +1601,7 @@ def reconcile_pod(
             ], bool)
         return si, gu, gc, was_new
 
+    pod_ins: Dict[str, int] = {}
     with span("kernel:merkle", "reconcile_pod",
               owners=len(owners), local_owners=len(local),
               n=len(flat_ts), nproc=nproc):
@@ -1516,6 +1613,7 @@ def reconcile_pod(
                 for o, k in zip(gu, gc):
                     flags = was_new[pos : pos + k]
                     pos += k
+                    pod_ins[o] = pod_ins.get(o, 0) + int(np.asarray(flags).sum())
                     if o in good_ix and bool(flags.all()):
                         deltas = by_ix.get(good_ix[o], {})
                     else:
@@ -1538,6 +1636,18 @@ def reconcile_pod(
                         (o, s),
                     )
     eng.close()
+    # Ledger, per process: the broadcast batch ingresses HERE only for
+    # rows this process stores (my owners); terminals classify from
+    # the was-new flags, in-batch-dedup dropped rows fold into
+    # store.duplicate via the request totals.
+    local_requests = [
+        r for r in requests if owner_process(r.user_id, nproc) == pid
+    ]
+    ledger.count(
+        ledger.INGRESS_SYNC,
+        sum(len(r.messages) for r in local_requests),
+    )
+    _ledger_count_pass(local_requests, pod_ins)
 
     # 5) Respond for MY requests (message-less cold-sync requests route
     # by the same stable owner hash).

@@ -77,7 +77,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from evolu_tpu.obs import metrics
+from evolu_tpu.obs import ledger, metrics
 from evolu_tpu.sync import protocol
 from evolu_tpu.utils.config import FleetConfig
 from evolu_tpu.utils.log import log
@@ -567,6 +567,10 @@ class FleetManager:
                     shipped_trees[rec[1]] = rec[2]
             for uid, msgs in by_owner.items():
                 self.store.add_messages(uid, msgs)
+                # Ledger ingress: rebalance-installed rows arrive as
+                # snapshot chunks; add_messages above posted their
+                # store terminals through its changes==1 gate.
+                ledger.count(ledger.INGRESS_SNAPSHOT, len(msgs), owner=uid)
                 installed += len(msgs)
         return installed, shipped_trees
 

@@ -30,7 +30,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from evolu_tpu.obs import flight, metrics, trace
+from evolu_tpu.obs import flight, ledger, metrics, trace
 from evolu_tpu.utils.log import log
 
 from evolu_tpu.core.merkle import (
@@ -71,6 +71,41 @@ def _count_ingest_mix(messages) -> None:
                     len(messages) - n_v2)
 
 
+# Per-thread serve scope (see serve_single_request): one pending entry
+# + a first-wins classification latch per request, so (a) a serve that
+# commits the store but fails BEFORE answering posts NOTHING — the
+# relay's reject.invalid stays the request's single terminal — and
+# (b) the NonCanonicalStoreError object-path fallback, which re-runs
+# add_messages idempotently, cannot classify the same messages twice.
+_SERVE_SCOPE = threading.local()
+
+
+def _ledger_store_apply(user_id, new_flags) -> None:
+    """Conservation-ledger terminal classification for the OBJECT store
+    path (`RelayStore.add_messages`): per-row was-new flags are the
+    changes==1 truth — new rows terminate at store.inserted, the rest
+    at store.duplicate. Inside a serve scope the counts ride the
+    scope's pending entry (committed only when the serve answers,
+    first classification wins); outside one (engine sharded-python
+    fallback, fleet rebalance install, direct embedder calls) they
+    post immediately. ONE seam on purpose: the ledger's negative test
+    (tests/test_ledger.py) mis-wires exactly this function to prove the
+    audit catches a route that forgets to count."""
+    n_new = ledger.flag_sum(new_flags)
+    scope = getattr(_SERVE_SCOPE, "scope", None)
+    if scope is not None:
+        if scope["classified"]:
+            return  # fallback re-insert re-classifies; first wins
+        scope["classified"] = True
+        scope["entry"].count(ledger.STORE_INSERTED, n_new, owner=user_id)
+        scope["entry"].count(ledger.STORE_DUPLICATE,
+                             len(new_flags) - n_new, owner=user_id)
+        return
+    ledger.count(ledger.STORE_INSERTED, n_new, owner=user_id)
+    ledger.count(ledger.STORE_DUPLICATE, len(new_flags) - n_new,
+                 owner=user_id)
+
+
 def fetch_response_stream(db, user_id, node_id, server_tree, client_tree) -> bytes:
     """The C-served SyncResponse `messages` stream for one request:
     tree diff → since timestamp → `eh_get_messages_wire`. b"" when the
@@ -93,10 +128,26 @@ def serve_single_request(store, request: "protocol.SyncRequest") -> bytes:
     oracle before any side effect). Shared by the non-batching do_POST
     branch and the scheduler's non-batchable/poison-retry fallbacks —
     the recipes must never drift (the scheduler's responses are pinned
-    byte-identical to this path)."""
-    out = store.sync_wire(request) if hasattr(store, "sync_wire") else None
-    if out is None:
-        out = protocol.encode_sync_response(store.sync(request))
+    byte-identical to this path).
+
+    Ledger: the whole serve runs under one scope (see _SERVE_SCOPE) so
+    store terminals post exactly once per ANSWERED request — a serve
+    that commits add_messages and then fails (e.g. a garbage client
+    tree string) aborts the entry and the caller's reject.invalid is
+    the single terminal; the NonCanonicalStoreError fallback's second
+    add_messages run never double-classifies."""
+    scope = {"entry": ledger.pending(), "classified": False}
+    _SERVE_SCOPE.scope = scope
+    try:
+        out = store.sync_wire(request) if hasattr(store, "sync_wire") else None
+        if out is None:
+            out = protocol.encode_sync_response(store.sync(request))
+    except BaseException:
+        scope["entry"].abort()
+        raise
+    finally:
+        _SERVE_SCOPE.scope = None
+    scope["entry"].commit()
     return out
 
 
@@ -184,6 +235,9 @@ class RelayStore:
                 'INSERT OR REPLACE INTO "merkleTree" ("userId", "merkleTree") VALUES (?, ?)',
                 (user_id, merkle_tree_to_string(tree)),
             )
+        # After the transaction committed — a rolled-back batch must
+        # post nothing (the scheduler's retry posts once instead).
+        _ledger_store_apply(user_id, new_flags)
         return tree
 
     def get_messages(
@@ -423,6 +477,13 @@ def relay_stats_payload(store, replication=None, fleet=None,
             "p99": metrics.quantile("evolu_relay_request_ms", 0.99),
         },
     }
+    # The conservation ledger's station totals + the in-stream-safe
+    # audit (barrier-only equations skipped: /stats must not force a
+    # drain barrier; GET /ledger runs the full audit).
+    payload["ledger"] = {
+        "stations": ledger.totals(),
+        "violations": ledger.audit(at_barrier=False),
+    }
     if replication is not None:
         payload["replication"] = replication.stats_payload()
     if fleet is not None:
@@ -536,8 +597,12 @@ class _Handler(BaseHTTPRequestHandler):
                 # Backpressure is flow control, not a pipeline error
                 # (errors_total stays an error-rate): tell the client
                 # when to come back instead of letting handler threads
-                # pile up unboundedly.
+                # pile up unboundedly. The shed IS these messages'
+                # terminal station — nothing was stored (the engine
+                # raises before any ACK/commit on this path).
                 metrics.inc("evolu_relay_backpressure_total")
+                ledger.count(ledger.SHED_BACKPRESSURE,
+                             len(request.messages), owner=request.user_id)
                 self._respond_retry_after(e.retry_after)
                 return None
         return serve_single_request(self.store, request)
@@ -605,12 +670,38 @@ class _Handler(BaseHTTPRequestHandler):
             if not self._obs_authorized():
                 return
             try:
+                # Refresh the process gauges at scrape time (uptime,
+                # RSS) — no background sampler thread needed.
+                metrics.update_process_gauges()
                 body = metrics.render_prometheus().encode("utf-8")
             except Exception as e:  # noqa: BLE001 - scraper gets a clean 500
                 metrics.inc("evolu_relay_errors_total")
                 self.send_error(500, str(e))
                 return
             self._respond(200, body, metrics.PROMETHEUS_CONTENT_TYPE)
+        elif self.path == "/ledger" or self.path.startswith("/ledger?"):
+            # The conservation-ledger read surface (obs/ledger.py):
+            # station totals, owner sub-ledgers, and the audit verdict.
+            # With a write-behind queue the audit runs AT a drain
+            # barrier (wb.queued == wb.drained must hold there); either
+            # way, concurrently in-flight requests can show as
+            # transient deltas — the hard zero-violation gate is the
+            # model-check episodes' quiescent audit, not a live scrape.
+            metrics.inc("evolu_relay_requests_total", endpoint="/ledger")
+            if not self._obs_authorized():
+                return
+            try:
+                if self.write_behind is not None:
+                    with self.write_behind.drain_barrier():
+                        payload = ledger.snapshot(at_barrier=True)
+                else:
+                    payload = ledger.snapshot(at_barrier=True)
+                body = json.dumps(payload).encode("utf-8")
+            except Exception as e:  # noqa: BLE001 - reader gets a clean 500
+                metrics.inc("evolu_relay_errors_total")
+                self.send_error(500, str(e))
+                return
+            self._respond(200, body, "application/json")
         elif self.path == "/trace" or self.path.startswith("/trace/") \
                 or self.path.startswith("/trace?"):
             # One fixed endpoint label — raw paths must never mint
@@ -805,11 +896,21 @@ class _Handler(BaseHTTPRequestHandler):
         srv_span = trace.start_span("relay.sync", parent=tctx,
                                     attrs={"endpoint": "/"})
         _tok = trace.activate(srv_span.context)
+        request = None
+        served = False
         try:
             request = protocol.decode_sync_request(body)
             srv_span.set_attr("owner", request.user_id)
+            # Ledger ingress at the decode boundary (a body that never
+            # decoded never became messages): every message of this
+            # delivery attempt must reach exactly one terminal station
+            # — store classification, a shed/reject answer, or a fleet
+            # egress (obs/ledger.py `server-flow`).
+            ledger.count(ledger.INGRESS_SYNC, len(request.messages),
+                         owner=request.user_id)
             if self.fleet is not None:
                 if not self._route_fleet(request, body):
+                    served = True  # egress/shed terminal counted there
                     return  # answered: 307/forwarded/503-not-ready
             shard = (
                 self.store.shard_index(request.user_id)
@@ -817,6 +918,7 @@ class _Handler(BaseHTTPRequestHandler):
             )
             metrics.inc("evolu_relay_shard_requests_total", shard=str(shard))
             out = self._serve_request(request)
+            served = True  # terminals counted (store path or 503 shed)
             if out is None:
                 return  # 503 backpressure already answered
             # Ingest-mix counters AFTER routing AND a successful
@@ -840,6 +942,12 @@ class _Handler(BaseHTTPRequestHandler):
             flight.attach(e)
             srv_span.set_attr("error", repr(e))
             metrics.inc("evolu_relay_errors_total")
+            if request is not None and not served:
+                # Ingressed but never reached a store terminal: the 500
+                # answer IS the terminal (the client's retry is a fresh
+                # delivery attempt with its own ingress count).
+                ledger.count(ledger.REJECT_INVALID, len(request.messages),
+                             owner=request.user_id)
             log("dev", "relay sync request failed", error=repr(e))
             self.send_error(500, str(e))
             return
@@ -959,15 +1067,20 @@ class _Handler(BaseHTTPRequestHandler):
         briefly unreachable — the client's backoff retries)."""
         from evolu_tpu.server.fleet import FleetNotReady
 
+        n_msgs = len(request.messages)
         try:
             action, target = self.fleet.route(request.user_id)
         except FleetNotReady as e:
+            ledger.count(ledger.SHED_BACKPRESSURE, n_msgs,
+                         owner=request.user_id)
             self._respond_retry_after(e.retry_after)
             return False
         if action == "local":
             return True
         if action == "redirect":
             metrics.inc("evolu_fleet_redirects_total")
+            ledger.count(ledger.EGRESS_REDIRECT, n_msgs,
+                         owner=request.user_id)
             # Zero-duration event span: the trace shows WHERE the
             # client was bounced (its own sync.redirect span shows the
             # follow; this one shows the relay that answered 307).
@@ -1005,6 +1118,8 @@ class _Handler(BaseHTTPRequestHandler):
             if e.code in (429, 503):
                 # The peer is shedding load: flow control, relayed.
                 metrics.inc("evolu_fleet_forward_failures_total")
+                ledger.count(ledger.SHED_BACKPRESSURE, n_msgs,
+                             owner=request.user_id)
                 self._respond_retry_after(0.25)
                 return False
             # A DEFINITIVE answer (404 = peer not fleet-enabled, 400 =
@@ -1013,6 +1128,8 @@ class _Handler(BaseHTTPRequestHandler):
             # backoff forever while errors_total reads healthy. 502 it.
             metrics.inc("evolu_relay_errors_total")
             metrics.inc("evolu_fleet_forward_failures_total")
+            ledger.count(ledger.REJECT_INVALID, n_msgs,
+                         owner=request.user_id)
             log("dev", "fleet forward rejected by peer", peer=target,
                 code=e.code)
             self.send_error(502, f"fleet forward target answered {e.code}")
@@ -1021,9 +1138,15 @@ class _Handler(BaseHTTPRequestHandler):
             # flow control, not an error — the next route() re-probes
             # and fails over.
             metrics.inc("evolu_fleet_forward_failures_total")
+            ledger.count(ledger.SHED_BACKPRESSURE, n_msgs,
+                         owner=request.user_id)
             log("dev", "fleet forward failed", peer=target, error=repr(e))
             self._respond_retry_after(0.25)
             return False
+        # Forwarded and answered by the peer: these messages left this
+        # process — egress.forward is their terminal HERE; the peer's
+        # ingress.forward accounts them in ITS ledger.
+        ledger.count(ledger.EGRESS_FORWARD, n_msgs, owner=request.user_id)
         metrics.observe("evolu_relay_response_bytes", len(out),
                         buckets=metrics.SIZE_BUCKETS)
         self._respond(200, out, "application/octet-stream")
@@ -1050,6 +1173,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_error(413)
             return
         body = self.rfile.read(length)
+        request = None
+        served = False
         try:
             if self.path == "/fleet/forward":
                 env = protocol.decode_fleet_forward(body)
@@ -1068,6 +1193,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # it lands, even if the rings disagree mid-reload
                 # (scoped gossip drains any stray owner).
                 metrics.inc("evolu_fleet_forwarded_served_total")
+                # Ledger ingress: the forwarding hop counted
+                # egress.forward in ITS ledger; these messages enter
+                # THIS process here.
+                ledger.count(ledger.INGRESS_FORWARD, len(request.messages),
+                             owner=request.user_id)
                 # The forwarder's span context rode the traceparent
                 # header: the serve span here joins the same trace, so
                 # GET /trace/<id> on THIS relay shows the hop the
@@ -1082,6 +1212,7 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 with fspan, trace.use(fspan.context):
                     out = self._serve_request(request)
+                served = True  # terminals counted (store path or shed)
                 if out is None:
                     return  # 503 backpressure already answered
                 _count_ingest_mix(request.messages)
@@ -1130,10 +1261,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200, out, "application/json")
         except ValueError as e:
             metrics.inc("evolu_relay_errors_total")
+            if request is not None and not served:
+                ledger.count(ledger.REJECT_INVALID, len(request.messages),
+                             owner=request.user_id)
             self.send_error(400, str(e))
         except Exception as e:  # noqa: BLE001 - clean 500, like sync
             flight.attach(e)
             metrics.inc("evolu_relay_errors_total")
+            if request is not None and not served:
+                ledger.count(ledger.REJECT_INVALID, len(request.messages),
+                             owner=request.user_id)
             log("dev", "relay fleet request failed", error=repr(e))
             self.send_error(500, str(e))
 
@@ -1386,7 +1523,37 @@ class RelayServer:
         host, port = self._httpd.server_address[:2]
         return f"http://{host}:{port}"
 
+    def _publish_build_info(self) -> None:
+        """`evolu_build_info` (constant 1, facts in labels): which
+        build/topology THIS relay process runs — a fleet dashboard must
+        tell a mesh-sharded event-loop relay from a default one without
+        SSH. Never raises: identity labels are not worth a failed
+        start."""
+        try:
+            from evolu_tpu import __version__
+            from evolu_tpu.utils.config import default_config
+
+            shards = getattr(self.store, "shards", None)
+            db = getattr((shards[0] if shards else self.store), "db", None)
+            mesh_devices = default_config.mesh_devices
+            metrics.set_build_info(
+                version=__version__,
+                backend=("native" if hasattr(db, "relay_insert_packed")
+                         else "python"),
+                shards=(len(shards) if shards else 1),
+                batching=int(self.scheduler is not None),
+                write_behind=int(self.write_behind is not None),
+                mesh_engine=int(self.mesh_engine),
+                mesh_devices=("auto" if mesh_devices is None
+                              else mesh_devices),
+                connection_tier=self.connection_tier,
+                push=int(self.push_hub is not None),
+            )
+        except Exception:  # noqa: BLE001,S110 - see docstring
+            pass
+
     def start(self) -> "RelayServer":
+        self._publish_build_info()
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True, name="evolu-relay")
         self._thread.start()
         if self.replication is not None:

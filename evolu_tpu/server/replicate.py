@@ -66,7 +66,7 @@ from evolu_tpu.core.timestamp import (
     timestamp_to_string,
 )
 from evolu_tpu.core.types import TimestampParseError
-from evolu_tpu.obs import metrics, trace
+from evolu_tpu.obs import ledger, metrics, trace
 from evolu_tpu.sync import aead, protocol
 from evolu_tpu.sync.client import _accepts_headers
 from evolu_tpu.utils.log import log
@@ -926,6 +926,7 @@ class ReplicationManager:
             # when a batchmate failed (review finding — the raise used
             # to skip the notify for all of them).
             self._notify_push(served)
+            self._ledger_ingress(served)
             if first_err is not None:
                 raise first_err
             return
@@ -938,6 +939,19 @@ class ReplicationManager:
                 served.append(r)
         finally:
             self._notify_push(served)
+            self._ledger_ingress(served)
+
+    @staticmethod
+    def _ledger_ingress(served: List[protocol.SyncRequest]) -> None:
+        """Ledger ingress for pulled messages that the serve path
+        actually landed: the serve posted their store terminals (the
+        relay's own paths — changes==1 gate and all), so only
+        SUCCESSFULLY served requests ingress. A failed submit posted
+        neither side, and the next round's re-pull is a fresh delivery
+        attempt."""
+        for r in served:
+            ledger.count(ledger.INGRESS_REPLICATION, len(r.messages),
+                         owner=r.user_id)
 
     def _notify_push(self, requests: List[protocol.SyncRequest]) -> None:
         """Wake parked push subscriptions for rows replication just

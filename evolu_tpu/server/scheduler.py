@@ -60,7 +60,7 @@ import threading
 import time
 from typing import List, Optional
 
-from evolu_tpu.obs import metrics, trace
+from evolu_tpu.obs import ledger, metrics, trace
 from evolu_tpu.sync import aead, protocol
 from evolu_tpu.utils.log import log
 
@@ -316,6 +316,11 @@ class SyncScheduler:
         if batch[0].single:
             p = batch[0]
             metrics.inc("evolu_sched_fallback_total", reason="non_canonical")
+            # Ledger TALLY (outside the flow equations — the request's
+            # flow still terminates through the store path below): the
+            # server-side canonicality bounce.
+            ledger.count(ledger.BOUNCE_NON_CANONICAL, len(p.request.messages),
+                         owner=p.request.user_id)
             self._record_queue_waits(batch)
             sspan = trace.start_span("sched.single", parent=p.ctx,
                                      attrs={"owner": p.request.user_id})
@@ -386,10 +391,16 @@ class SyncScheduler:
                 try:
                     response = self._serve_single(p.request)
                 except Exception as pe:  # noqa: BLE001
+                    # No ledger terminal here: the relay's 500 answer
+                    # counts reject.invalid — the poisoned engine pass
+                    # posted nothing (rolled back), and the singleton
+                    # store path posts only on commit, so the retry can
+                    # never double-count.
                     p.fail(pe)
                 else:
                     metrics.inc("evolu_sched_fallback_total", reason="poison_retry")
                     p.resolve(response)
+            self._observe_jit_caches(batch)
             metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3,
                             exemplar=bspan.trace_id)
             return
@@ -404,8 +415,26 @@ class SyncScheduler:
             metrics.inc("evolu_crypto_v2_batched_messages_total", n_v2)
         for p, out in zip(batch, outs):
             p.resolve(out)
+        self._observe_jit_caches(batch)
         metrics.observe("evolu_sched_batch_ms", (time.perf_counter() - t0) * 1e3,
                         exemplar=bspan.trace_id)
+
+    def _observe_jit_caches(self, batch) -> None:
+        """Recompile sentinel, after each engine pass: diff the merkle/
+        mesh jit cache sizes into gauges + a recompiles counter, flight
+        event on growth (engine.observe_jit_caches). Skipped until an
+        engine exists — importing the engine module here would pull jax
+        onto relays that never ran a batch. Never raises."""
+        if self._engine is None:
+            return
+        try:
+            from evolu_tpu.server import engine as eng_mod
+
+            eng_mod.observe_jit_caches(
+                sum(len(p.request.messages) for p in batch)
+            )
+        except Exception:  # noqa: BLE001,S110 - sentinel must not fail a batch
+            pass
 
     def _ensure_engine(self):
         """The BatchReconciler, created lazily on the dispatcher thread

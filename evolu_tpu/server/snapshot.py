@@ -84,7 +84,7 @@ from evolu_tpu.core.merkle import (
     merkle_tree_to_string,
     minute_deltas_host,
 )
-from evolu_tpu.obs import metrics
+from evolu_tpu.obs import ledger, metrics
 from evolu_tpu.sync import protocol
 from evolu_tpu.utils.log import log
 
@@ -696,6 +696,14 @@ class SnapshotInstaller:
         rename commits is either in the snapshot or merged here —
         an acknowledged write can never vanish in the swap."""
         merged = 0
+        # Ledger: snapshot rows INGRESS this process when they become
+        # live (the swap commit), and the live-vs-snapshot overlap is
+        # the changes==1-gate classifier — a row the store already had
+        # terminates at store.duplicate, the rest at store.inserted.
+        # Accumulated into a pending entry posted only after every
+        # shard of THIS run swapped (a crash-resume run posts only the
+        # shards it swaps itself, so ingress == terminals always).
+        entry = ledger.pending()
         for shard in self.shards:
             db = shard.db
             with _exclusive_txn(db):
@@ -705,11 +713,24 @@ class SnapshotInstaller:
                 )
                 if not have:
                     continue  # this shard already swapped (resume)
+                snap_total = db.exec_sql_query(
+                    'SELECT COUNT(*) AS n FROM "messageBsnap"'
+                )[0]["n"]
+                overlap = db.exec_sql_query(
+                    'SELECT COUNT(*) AS n FROM "message" AS m '
+                    'WHERE EXISTS (SELECT 1 FROM "messageBsnap" AS b '
+                    'WHERE b."userId" = m."userId" '
+                    'AND b."timestamp" = m."timestamp")'
+                )[0]["n"]
+                entry.count(ledger.INGRESS_SNAPSHOT, snap_total)
+                entry.count(ledger.STORE_INSERTED, snap_total - overlap)
+                entry.count(ledger.STORE_DUPLICATE, overlap)
                 merged += self._merge_live_rows_locked(db)
                 db.run('DROP TABLE "message"')
                 db.run('ALTER TABLE "messageBsnap" RENAME TO "message"')
                 db.run('DROP TABLE "merkleTree"')
                 db.run('ALTER TABLE "merkleTreeBsnap" RENAME TO "merkleTree"')
+        entry.commit()
         if merged:
             metrics.inc("evolu_snap_local_rows_merged_total", merged)
         self._state_clear()

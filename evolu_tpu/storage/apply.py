@@ -32,7 +32,12 @@ from evolu_tpu.core.merkle import (
 )
 from evolu_tpu.core.timestamp import timestamp_from_string
 from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.obs import ledger
 from evolu_tpu.storage.sqlite import PySqliteDatabase, quote_ident
+
+
+# Mask counting shared with the relay's store seam (ONE copy).
+_mask_sum = ledger.flag_sum
 
 _SELECT_WINNER = (
     'SELECT "timestamp" FROM "__message" '
@@ -87,34 +92,63 @@ def apply_messages_sequential(
     # bind full bytes like the reference (the batched production path
     # is NUL-exact natively). Typed batches take the Python loop too:
     # the native loop would LWW-upsert raw op values into app tables.
-    if use_native:
-        xor_mask = db.apply_sequential(messages)
-        for m, flagged in zip(messages, xor_mask):
-            if flagged:
+    entry = ledger.pending()
+    entry.count(ledger.APPLY_INGRESS, len(messages))
+    entry.count(ledger.ROUTE_SEQUENTIAL, len(messages))
+    entry.count(ledger.ROUTE_TYPED, len(typed))
+    try:
+        if use_native:
+            xor_mask = db.apply_sequential(messages)
+            for m, flagged in zip(messages, xor_mask):
+                if flagged:
+                    merkle_tree = insert_into_merkle_tree(
+                        timestamp_from_string(m.timestamp), merkle_tree
+                    )
+            # The native loop reports xor flags only: a row that XORed
+            # but lost its cell is indistinguishable from a winner here,
+            # so the sequential-route split is coarser (inserted = XORed)
+            # than the batched routes'. The equation sums still balance.
+            n_xor = _mask_sum(xor_mask)
+            entry.count(ledger.APPLY_INSERTED, n_xor)
+            entry.count(ledger.APPLY_DUPLICATE, len(messages) - n_xor)
+            entry.commit()
+            return merkle_tree
+        if typed:
+            # Fold + materialize BEFORE the loop inserts any __message
+            # row: the dedup screen must observe pre-batch state (same
+            # contract as the batched path). xor/insert semantics below
+            # stay the reference's, timestamp-only.
+            record_typed_tables(changes)
+            apply_typed_ops(db, schema, typed)
+        for m in messages:
+            rows = db.exec_sql_query(_SELECT_WINNER, (m.table, m.row, m.column))
+            t = rows[0]["timestamp"] if rows else None
+            won = (t is None or t < m.timestamp)
+            if won and not (schema and schema.is_typed(m.table, m.column)):
+                db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
+            if t is None or t != m.timestamp:
+                db.run(_INSERT_MESSAGE,
+                       (m.timestamp, m.table, m.row, m.column, m.value))
                 merkle_tree = insert_into_merkle_tree(
                     timestamp_from_string(m.timestamp), merkle_tree
                 )
+                entry.count(
+                    ledger.APPLY_INSERTED if won else ledger.APPLY_LOSING
+                )
+            else:
+                entry.count(ledger.APPLY_DUPLICATE)
+        entry.commit()
         return merkle_tree
-    if typed:
-        # Fold + materialize BEFORE the loop inserts any __message row:
-        # the dedup screen must observe pre-batch state (same contract
-        # as the batched path). xor/insert semantics below stay the
-        # reference's, timestamp-only.
-        record_typed_tables(changes)
-        apply_typed_ops(db, schema, typed)
-    for m in messages:
-        rows = db.exec_sql_query(_SELECT_WINNER, (m.table, m.row, m.column))
-        t = rows[0]["timestamp"] if rows else None
-        if (t is None or t < m.timestamp) and not (
-            schema and schema.is_typed(m.table, m.column)
-        ):
-            db.run(_upsert_sql(m.table, m.column), (m.row, m.value, m.value))
-        if t is None or t != m.timestamp:
-            db.run(_INSERT_MESSAGE, (m.timestamp, m.table, m.row, m.column, m.value))
-            merkle_tree = insert_into_merkle_tree(
-                timestamp_from_string(m.timestamp), merkle_tree
-            )
-    return merkle_tree
+    except BaseException:
+        # The oracle runs statement-at-a-time (no outer transaction
+        # here): a mid-loop failure leaves the batch partially applied,
+        # and the ledger counts the whole batch as rejected — the
+        # conservative classification (route counted above never posts;
+        # the pending entry dies with the abort).
+        entry.abort()
+        ledger.count(ledger.APPLY_INGRESS, len(messages))
+        ledger.count(ledger.APPLY_REJECTED, len(messages))
+        raise
 
 
 def fetch_existing_winners(
@@ -200,13 +234,24 @@ def apply_messages(
     if not len(messages):
         return merkle_tree
     planner = planner or plan_batch
+    # Conservation ledger (obs/ledger.py): routing + terminal counts
+    # accumulate into a pending entry and post ONLY when the
+    # transaction commits — a rolled-back batch posts apply.rejected
+    # instead, so a retry can never double-count.
+    entry = ledger.pending()
     try:
         with db.transaction():  # whole-batch atomicity, like the reference's dbTransaction
-            return _apply_in_txn(db, merkle_tree, messages, planner, changes)
+            tree = _apply_in_txn(db, merkle_tree, messages, planner, changes,
+                                 entry)
+        entry.commit()
+        return tree
     except BaseException:
         # A planner that mutates its own state at plan time (the HBM
         # winner cache) is now ahead of the rolled-back SQLite; let it
         # resynchronize.
+        entry.abort()
+        ledger.count(ledger.APPLY_INGRESS, len(messages))
+        ledger.count(ledger.APPLY_REJECTED, len(messages))
         _notify_plan_failure(planner)
         raise
 
@@ -223,7 +268,8 @@ def _notify_plan_failure(planner) -> None:
         on_failed()
 
 
-def _apply_in_txn(db, merkle_tree, messages, planner, changes=None):
+def _apply_in_txn(db, merkle_tree, messages, planner, changes=None,
+                  entry=None):
     """Dispatch inside the transaction: a PackedReceive batch (the
     fused receive leg) takes the columnar plan+apply when both the
     planner and the backend support it; everything else — and every
@@ -236,6 +282,9 @@ def _apply_in_txn(db, merkle_tree, messages, planner, changes=None):
     from evolu_tpu.obs import metrics
     from evolu_tpu.storage.changes import record_batch
 
+    if entry is None:
+        entry = ledger.pending()  # discarded; direct callers are tests
+    entry.count(ledger.APPLY_INGRESS, len(messages))
     # Record BEFORE routing: the touched (table, row) set is the same
     # on every route, and recording first means a route that later
     # fails half-way still lands in a superset changed-set.
@@ -252,14 +301,24 @@ def _apply_in_txn(db, merkle_tree, messages, planner, changes=None):
             messages = messages.to_messages()
             metrics.inc("evolu_apply_batches_total", route="object")
             return _apply_messages_in_txn(db, merkle_tree, messages, planner,
-                                          changes)
+                                          changes, entry)
         plan_packed = getattr(planner, "plan_packed", None)
         if plan_packed is not None and hasattr(db, "apply_planned_cells"):
             plan = plan_packed(messages)
             if plan is not None:
                 metrics.inc("evolu_apply_batches_total", route="packed")
-                _xor_mask, upsert_mask, deltas = plan
+                xor_mask, upsert_mask, deltas = plan
                 db.apply_planned_cells(messages, upsert_mask)
+                # Packed terminals from the positional masks (already
+                # host numpy — the plan was just applied to SQLite, so
+                # no device pull happens here): winners are upserts,
+                # XORed non-winners lost, the rest are duplicates.
+                n, n_xor, n_win = (len(messages), _mask_sum(xor_mask),
+                                   _mask_sum(upsert_mask))
+                entry.count(ledger.ROUTE_PACKED, n)
+                entry.count(ledger.APPLY_INSERTED, n_win)
+                entry.count(ledger.APPLY_LOSING, n_xor - n_win)
+                entry.count(ledger.APPLY_DUPLICATE, n - n_xor)
                 return apply_prefix_xors(merkle_tree, deltas)
         # The packed batch bounced (non-canonical shape, small batch,
         # hot-owner route, or a backend without the cell apply):
@@ -267,10 +326,15 @@ def _apply_in_txn(db, merkle_tree, messages, planner, changes=None):
         metrics.inc("evolu_apply_packed_bounces_total")
         messages = messages.to_messages()
     metrics.inc("evolu_apply_batches_total", route="object")
-    return _apply_messages_in_txn(db, merkle_tree, messages, planner, changes)
+    return _apply_messages_in_txn(db, merkle_tree, messages, planner, changes,
+                                  entry)
 
 
-def _apply_messages_in_txn(db, merkle_tree, messages, planner, changes=None):
+def _apply_messages_in_txn(db, merkle_tree, messages, planner, changes=None,
+                           entry=None):
+    if entry is None:
+        entry = ledger.pending()  # discarded; direct callers are tests
+    entry.count(ledger.ROUTE_OBJECT, len(messages))
     # `fetches_winners` may sit on the planner function or, for bound
     # methods (DeviceWinnerCache.plan_batch), on the owning instance.
     owner = getattr(planner, "__self__", None)
@@ -300,6 +364,11 @@ def _apply_messages_in_txn(db, merkle_tree, messages, planner, changes=None):
         record_typed_tables(changes)
         apply_typed_ops(db, schema, typed)
         plan = strip_typed_upserts(plan, messages, schema)
+        # Tally station (outside the flow equations): typed messages
+        # still ride the object route's __message insert below; their
+        # LWW upserts were just stripped, so their terminal split leans
+        # on the XOR flag alone.
+        entry.count(ledger.ROUTE_TYPED, len(typed))
     if len(plan) == 3:
         # Device planner: masks AND per-minute Merkle deltas in one
         # dispatch (no per-message Python hashing).
@@ -334,6 +403,7 @@ def _apply_messages_in_txn(db, merkle_tree, messages, planner, changes=None):
                 mask.append(key in pending)
                 pending.discard(key)
         db.apply_planned(messages, mask)
+        n_win = _mask_sum(mask)
     else:
         # App tables: only the final winner per cell touches the row.
         for m in upserts:
@@ -344,6 +414,15 @@ def _apply_messages_in_txn(db, merkle_tree, messages, planner, changes=None):
             _INSERT_MESSAGE,
             [(m.timestamp, m.table, m.row, m.column, m.value) for m in messages],
         )
+        n_win = len(upserts)
+
+    # Terminal classification from masks already on host (never a
+    # device pull — device planners return pulled numpy): winners
+    # upserted, XORed non-winners lost LWW, the rest exact duplicates.
+    n_xor = _mask_sum(xor_mask)
+    entry.count(ledger.APPLY_INSERTED, n_win)
+    entry.count(ledger.APPLY_LOSING, n_xor - n_win)
+    entry.count(ledger.APPLY_DUPLICATE, len(messages) - n_xor)
 
     # One sparse-tree pass (pure, cannot fail after commit).
     return apply_prefix_xors(merkle_tree, deltas)

@@ -69,7 +69,7 @@ from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from evolu_tpu.obs import metrics, trace
+from evolu_tpu.obs import ledger, metrics, trace
 from evolu_tpu.utils.log import log
 
 LOG_MAGIC = b"EVOLUWB1\n"
@@ -285,6 +285,14 @@ class WriteBehindQueue:
             # returns.
             with self.db_lock:
                 self._materialize(records, exact=True)
+            # Ledger: in THIS process these rows never rode a sync POST
+            # — the log replay is their ingress, and _materialize just
+            # posted their inserted/duplicate terminals (a record whose
+            # rows pre-crash drains already committed reconciles as
+            # store.duplicate, never double-counts).
+            for r in records:
+                for o, k in zip(r.gu, r.gc):
+                    ledger.count(ledger.INGRESS_REPLAY, k, owner=o)
         self._log = open(path, "wb")
         self._log.write(LOG_MAGIC)
         self._log.flush()
@@ -416,6 +424,13 @@ class WriteBehindQueue:
             if trees:
                 self._trees.update(trees)
             metrics.inc("evolu_wb_enqueued_rows_total", n_rows)
+            # Ledger checkpoint pair, queued half: these rows are ACKed
+            # (fsynced) — `wb.queued == wb.drained + wb.dropped` must
+            # hold at every drain barrier. Per-owner so GET /ledger can
+            # show one owner's rows parked in the queue.
+            for r in records:
+                for o, k in zip(r.gu, r.gc):
+                    ledger.count(ledger.WB_QUEUED, k, owner=o)
             metrics.set_gauge("evolu_wb_queue_rows", self._pending_rows)
             metrics.set_gauge("evolu_wb_queue_records", len(self._pending))
             seq = self._last_seq
@@ -554,6 +569,9 @@ class WriteBehindQueue:
             metrics.set_gauge("evolu_wb_queue_records", 0)
             if dropped:
                 metrics.inc("evolu_wb_reset_dropped_rows_total", dropped)
+                # Dropped rows are a flow TERMINAL: they ingressed and
+                # were queued, and will never classify at a drain.
+                ledger.count(ledger.WB_DROPPED, dropped)
             self._cv.notify_all()
 
     def close(self, flush: bool = True) -> None:
@@ -641,6 +659,12 @@ class WriteBehindQueue:
                 metrics.set_gauge("evolu_wb_queue_records", len(self._pending))
                 self._cv.notify_all()
             metrics.inc("evolu_wb_drained_rows_total", rows)
+            # Drained half of the ledger checkpoint pair; the
+            # inserted/duplicate terminal split was posted per shard by
+            # _materialize as each transaction committed.
+            for p in batch:
+                for o, k in zip(p.record.gu, p.record.gc):
+                    ledger.count(ledger.WB_DRAINED, k, owner=o)
             metrics.observe("evolu_wb_drain_batch_rows", rows,
                             buckets=_ROW_BUCKETS, exemplar=dspan.trace_id)
             metrics.observe("evolu_wb_drain_ms",
@@ -714,6 +738,14 @@ class WriteBehindQueue:
             # path has not yet re-read: their precomputed trees are
             # stale up to the recorded seq bound.
             carry_taint = dict(self._needs_flush)
+        # Ledger terminals accumulate into ONE pending entry across all
+        # shards, committed only when EVERY shard transaction did: a
+        # drain batch that fails on shard k re-runs whole (shards that
+        # already committed re-classify their rows as duplicates on the
+        # retry), so posting per shard would double-count — posting
+        # once per fully-successful materialize keeps each queued row
+        # at exactly one terminal (obs/ledger.py).
+        entry = ledger.pending()
         for si, ops in per_shard.items():
             db = stores[si].db
             with db.transaction():
@@ -722,6 +754,9 @@ class WriteBehindQueue:
                     flags = np.asarray(
                         self._insert_rows(db, [u], [k], ts_b, content_b, lens)
                     )
+                    n_new = int(flags.sum())
+                    entry.count(ledger.STORE_INSERTED, n_new, owner=u)
+                    entry.count(ledger.STORE_DUPLICATE, k - n_new, owner=u)
                     clean = bool(flags.all())
                     if (not exact and clean and u not in tainted
                             and u not in carry_taint):
@@ -762,6 +797,7 @@ class WriteBehindQueue:
                         '("userId", "merkleTree") VALUES (?, ?)',
                         (u, s),
                     )
+        entry.commit()
         if tainted:
             metrics.inc("evolu_wb_corrected_owners_total", len(tainted))
         return tainted

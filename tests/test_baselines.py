@@ -1,0 +1,100 @@
+"""Bench-baseline drift gate (benchmarks/compare_baselines.py,
+ISSUE 15 satellite): normalization splits numerics from exact-match
+gates, relative drift flags beyond tolerance, `--smoke` keeps drift
+advisory while gates stay hard, and the checked-in baselines parse."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+import compare_baselines as cb  # noqa: E402
+
+
+RECORD = {
+    "metric": "demo_msgs_per_sec",
+    "value": 1000.0,
+    "pass_gate": True,
+    "detail": {
+        "digest": "0x4f3d0d7b",
+        "batch": 4096,
+        "method": "two-point slope",  # ignored identity text
+        "platform": "cpu",
+    },
+}
+
+
+def test_normalize_splits_values_gates_and_platform():
+    n = cb.normalize(RECORD, "demo")
+    assert n["platform"] == "cpu"
+    assert n["values"] == {"value": 1000.0, "detail.batch": 4096.0}
+    assert n["gates"] == {
+        "metric": "demo_msgs_per_sec",
+        "pass_gate": True,
+        "detail.digest": "0x4f3d0d7b",
+    }
+    # "detail." must NOT be swallowed by the "tail" ignore word (exact
+    # segment matching — the bug class the first draft had).
+    assert "detail.batch" in n["values"]
+
+
+def test_compare_flags_drift_and_gates():
+    base = cb.normalize(RECORD, "demo")
+    ok = dict(RECORD, value=1100.0)  # +10% < 25% tolerance
+    gates, drifts = cb.compare(base, cb.normalize(ok, "demo"))
+    assert gates == [] and drifts == []
+    slow = dict(RECORD, value=400.0)  # -60%
+    gates, drifts = cb.compare(base, cb.normalize(slow, "demo"))
+    assert gates == [] and len(drifts) == 1 and drifts[0][0] == "value"
+    broken = json.loads(json.dumps(RECORD))
+    broken["detail"]["digest"] = "0xdeadbeef"
+    gates, _ = cb.compare(base, cb.normalize(broken, "demo"))
+    assert gates and gates[0][0] == "detail.digest"
+
+
+def _run(args, stdin_text):
+    return subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "compare_baselines.py")] + args,
+        input=stdin_text, capture_output=True, text=True,
+    )
+
+
+def test_cli_update_check_smoke_roundtrip(tmp_path):
+    bdir = str(tmp_path / "baselines")
+    line = json.dumps(RECORD)
+    r = _run(["--update", "demo", "--baseline-dir", bdir], line)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(os.path.join(bdir, "demo.cpu.json"))
+    # Identical run: clean pass.
+    assert _run(["--check", "demo", "--baseline-dir", bdir],
+                line).returncode == 0
+    # 60% regression: hard fail without --smoke, advisory with it.
+    slow = json.dumps(dict(RECORD, value=400.0))
+    assert _run(["--check", "demo", "--baseline-dir", bdir],
+                slow).returncode == 1
+    assert _run(["--check", "demo", "--baseline-dir", bdir, "--smoke"],
+                slow).returncode == 0
+    # Gate (checksum) mismatch: hard fail EVEN under --smoke.
+    broken = json.loads(json.dumps(RECORD))
+    broken["detail"]["digest"] = "0xdeadbeef"
+    assert _run(["--check", "demo", "--baseline-dir", bdir, "--smoke"],
+                json.dumps(broken)).returncode == 1
+    # Unknown platform baseline: advisory pass (first run on new HW).
+    other = json.loads(json.dumps(RECORD))
+    other["detail"]["platform"] = "tpu"
+    assert _run(["--check", "demo", "--baseline-dir", bdir, "--smoke"],
+                json.dumps(other)).returncode == 0
+
+
+def test_checked_in_baselines_parse_and_roundtrip():
+    for name in os.listdir(cb.BASELINE_DIR):
+        with open(os.path.join(cb.BASELINE_DIR, name)) as f:
+            b = json.load(f)
+        assert b["bench"] and "values" in b and "gates" in b
+        # A baseline must be self-consistent: comparing it to itself
+        # yields no drift and no gate failures.
+        assert cb.compare(b, b) == ([], [])

@@ -147,6 +147,47 @@ def test_tracing_does_not_touch_the_bench_graph(tiny_setup):
     )
 
 
+def test_ledger_does_not_touch_the_bench_graph(tiny_setup):
+    """ISSUE 15's twin of the metrics/tracing fences: with the
+    conservation ledger HOT (enabled, counts posting around and
+    between loop invocations, a pending entry committing mid-flight),
+    the bench checksum must stay bit-identical and both the loop's jit
+    cache-miss count and the engine's `merkle_jit_cache_size()` flat.
+    The ledger is host-side dict arithmetic by contract — a recompile
+    here would mean a count leaked into a traced graph."""
+    from evolu_tpu.obs import ledger
+    from evolu_tpu.server import engine as eng_mod
+
+    mesh, args = tiny_setup
+    loop = bench.make_loop(mesh, 1)
+    with jax.enable_x64(True):
+        ledger.set_enabled(False)
+        try:
+            base = int(loop(*args))
+            cache_size = loop._cache_size()
+            engine_cache = eng_mod.merkle_jit_cache_size()
+            ledger.set_enabled(True)
+            ledger.count(ledger.INGRESS_SYNC, 512, owner="bench-owner")
+            entry = ledger.pending()
+            entry.count(ledger.STORE_INSERTED, 512, owner="bench-owner")
+            with_ledger = int(loop(*args))
+            entry.commit()
+            assert ledger.audit(at_barrier=True) == []
+            cache_size_after = loop._cache_size()
+            engine_cache_after = eng_mod.merkle_jit_cache_size()
+        finally:
+            ledger.set_enabled(True)
+            ledger.reset()
+    assert with_ledger == base, "the ledger changed the bench checksum"
+    assert cache_size_after == cache_size, (
+        "enabling the ledger added jit cache misses (recompiles) to the "
+        "timed pipeline"
+    )
+    assert engine_cache_after == engine_cache, (
+        "the ledger moved the engine's merkle jit cache"
+    )
+
+
 def test_checksum_depends_on_the_data():
     """Same loop, different input data → different checksum (guards a
     degenerate fold that collapses to a constant)."""

@@ -26,8 +26,20 @@ SCHEMA = {"todo": ("title", "isCompleted", "categoryId"), "todoCategory": ("name
 def _evidence(label, seed):
     """Seed-replay evidence (ROADMAP #5): on assertion failure the
     episode dumps seed + flight-recorder ring + span export + metrics
-    snapshot to a tmp artifact whose path rides the failure message —
-    a failed seed arrives with its causal history, not just a stack."""
+    snapshot + conservation-ledger snapshot to a tmp artifact whose
+    path rides the failure message — a failed seed arrives with its
+    causal history, not just a stack.
+
+    ISSUE 15: every episode is ALSO a conservation proof. The ledger
+    resets at entry and, after the episode body finished (teardown
+    included — quiescence), `ledger.audit()` must return ZERO violated
+    equations: every message that entered any ingress reached exactly
+    one terminal, on every route the episode exercised. Oracle-twin
+    phases (reference replays, not traffic) run under
+    `ledger.quarantine()`."""
+    from evolu_tpu.obs import ledger
+
+    ledger.reset()
     try:
         yield
     except AssertionError as e:
@@ -37,6 +49,15 @@ def _evidence(label, seed):
         raise AssertionError(
             f"{e}\nseed={seed}; replay evidence artifact: {path}"
         ) from e
+    violations = ledger.audit(at_barrier=True)
+    if violations:
+        from evolu_tpu.obs import trace
+
+        path = trace.write_evidence(label + "-ledger", seed=seed)
+        raise AssertionError(
+            f"conservation ledger violated at episode end: {violations}\n"
+            f"seed={seed}; replay evidence artifact: {path}"
+        )
 
 
 def _dump(evolu):
@@ -1047,15 +1068,161 @@ def _run_write_behind_torture(tmp_path, seed):
     # whole records here (single-shard store), never split.
     batches = seeded_batches(seed, n_batches)
     accepted = set()
-    for extra in (0, 1):
-        oracle = RelayStore()
-        eng = BatchReconciler(oracle)
-        for reqs in batches[: acked + 1 + extra]:
-            eng.run_batch_wire(reqs)
-        accepted.add(f"{state_crc(oracle):08x}")
-        eng.close()
-        oracle.close()
+    from evolu_tpu.obs import ledger as ledger_mod
+
+    with ledger_mod.quarantine():  # reference computation, not traffic
+        for extra in (0, 1):
+            oracle = RelayStore()
+            eng = BatchReconciler(oracle)
+            for reqs in batches[: acked + 1 + extra]:
+                eng.run_batch_wire(reqs)
+            accepted.add(f"{state_crc(oracle):08x}")
+            eng.close()
+            oracle.close()
     assert got_crc in accepted, (got_crc, accepted, acked)
+
+
+def test_mixed_traffic_ledger_conservation_episode(tmp_path):
+    """ISSUE 15's dedicated conservation episode: one relay process
+    sees EVERY hostile flow at once — a write-behind log inherited from
+    a SIGKILLed predecessor (restart replay), canonical pushes with
+    exact redeliveries, a non-canonical-width reject, a poisoned engine
+    pass retried as singletons, and a 503 backpressure shed — and the
+    ledger must still prove conservation: replayed records reconcile
+    (classify as duplicates where a pre-kill drain already committed
+    them) rather than double-count, every terminal fires exactly once
+    per delivery attempt, wb.queued == wb.drained at the barrier, and
+    `ledger.audit()` returns zero violated equations."""
+    with _evidence("ledger-mixed-traffic", 20260805):
+        _run_mixed_ledger_episode(tmp_path, 20260805)
+
+
+def _run_mixed_ledger_episode(tmp_path, seed):
+    import os
+    import subprocess
+    import sys
+    import urllib.error
+    import urllib.request
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from evolu_tpu.obs import ledger as ledger_mod
+    from evolu_tpu.server.engine import BatchReconciler
+    from evolu_tpu.sync import protocol
+
+    # --- phase 1: a write-behind relay worker dies by SIGKILL with
+    # ACKed-but-undrained records in its durable log. ---
+    db_path = str(tmp_path / "mixed.db")
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_write_behind_worker.py")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, worker, "ingest", db_path, str(seed), "6", "0.2"],
+        stdout=subprocess.PIPE, text=True, env=env,
+    )
+    acked = -1
+    try:
+        for line in proc.stdout:
+            if line.startswith("ACK "):
+                acked = int(line.split()[1])
+                if acked >= 2:
+                    time.sleep(0.15)  # land mid-drain
+                    proc.kill()
+                    break
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    assert acked >= 0, "worker never ACKed a batch"
+    log_bytes = os.path.getsize(db_path + ".wblog")
+    assert log_bytes > 16, "SIGKILL left no undrained log to replay"
+
+    ledger_mod.reset()  # the proof window starts at the restart
+
+    # --- phase 2: restart over the same store + log. The constructor
+    # replays the predecessor's records (ingress.replay), classifying
+    # rows a pre-kill drain already committed as store.duplicate —
+    # reconciled, never double-counted. ---
+    from evolu_tpu.server.relay import RelayServer, RelayStore
+
+    orig_rbw = BatchReconciler.run_batch_wire
+    poison = {"armed": False, "fired": 0}
+
+    def flaky(self, requests):
+        if poison["armed"] and not poison["fired"]:
+            poison["fired"] += 1
+            raise RuntimeError("injected poisoned batch")
+        return orig_rbw(self, requests)
+
+    BatchReconciler.run_batch_wire = flaky
+    server = RelayServer(RelayStore(db_path), write_behind=True).start()
+    try:
+        t = ledger_mod.totals()
+        replayed = t.get(ledger_mod.INGRESS_REPLAY, 0)
+        assert replayed > 0, "restart replayed nothing"
+        assert (t.get(ledger_mod.STORE_INSERTED, 0)
+                + t.get(ledger_mod.STORE_DUPLICATE, 0)) == replayed
+
+        def post(req, expect_error=None):
+            body = protocol.encode_sync_request(req)
+            try:
+                with urllib.request.urlopen(
+                    urllib.request.Request(server.url, data=body),
+                    timeout=30,
+                ) as r:
+                    return r.read()
+            except urllib.error.HTTPError as e:
+                assert expect_error == e.code, e
+                return None
+
+        def req(user, node, ts_list):
+            return protocol.SyncRequest(
+                tuple(protocol.EncryptedCrdtMessage(ts, b"ct") for ts in ts_list),
+                user, node, "{}",
+            )
+
+        ts = [timestamp_to_string_at(i) for i in range(4)]
+        # Canonical pushes + one exact redelivery (duplicates).
+        post(req("mixed-alice", "a" * 16, ts[:3]))
+        post(req("mixed-alice", "a" * 16, ts[:3]))
+        # Non-canonical width → singleton host-oracle reject (500).
+        post(req("mixed-nc", "b" * 16,
+                 ["1970-01-01T00:00:00.001Z-001-deadbeefdeadbeef"]),
+             expect_error=500)
+        # Poisoned engine pass → singleton retry serves it exactly once.
+        poison["armed"] = True
+        post(req("mixed-bob", "c" * 16, [ts[3]]))
+        poison["armed"] = False
+        assert poison["fired"] == 1, "poison injection never fired"
+        # 503 backpressure shed.
+        real_max = server.scheduler.max_queue
+        server.scheduler.max_queue = 0
+        post(req("mixed-shed", "d" * 16, ts[:2]), expect_error=503)
+        server.scheduler.max_queue = real_max
+
+        server.write_behind.flush()
+        t = ledger_mod.totals()
+        assert t[ledger_mod.WB_QUEUED] == t[ledger_mod.WB_DRAINED]
+        assert t[ledger_mod.SHED_BACKPRESSURE] == 2
+        assert t[ledger_mod.REJECT_INVALID] == 1
+        assert t[ledger_mod.BOUNCE_NON_CANONICAL] >= 1
+        # mixed-bob's row: exactly once despite the poisoned pass.
+        bob = ledger_mod.ledger.owner_totals("mixed-bob")
+        assert bob[ledger_mod.STORE_INSERTED] == 1
+        assert bob.get(ledger_mod.STORE_DUPLICATE, 0) == 0
+        violations = ledger_mod.audit(at_barrier=True)
+        assert violations == [], violations
+    finally:
+        BatchReconciler.run_batch_wire = orig_rbw
+        server.stop()
+
+
+def timestamp_to_string_at(i):
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+
+    return timestamp_to_string(
+        Timestamp(1700000000000 + i * 1000, 0, "1234567890abcdef")
+    )
 
 
 @pytest.mark.slow
@@ -1234,17 +1401,20 @@ def test_mesh_sharded_multi_relay_scheduler_episode(seed=90210):
             # Oracle twin: a SINGLE-DEVICE engine (1-device mesh, no
             # write-behind, per-batch LPT) replays the captured request
             # log one request per pass.
+            from evolu_tpu.obs import ledger as ledger_mod
+
             oracle = ShardedRelayStore(shards=4)
             oeng = BatchReconciler(oracle, mesh=create_mesh(1))
             try:
                 with log_lock:
                     replay = list(req_log)
                 assert len(replay) > 10, "episode produced no traffic"
-                for req in replay:
-                    try:
-                        oeng.run_batch_wire([req])
-                    except Exception:
-                        pass  # the width-reject raises here too
+                with ledger_mod.quarantine():  # reference replay, not traffic
+                    for req in replay:
+                        try:
+                            oeng.run_batch_wire([req])
+                        except Exception:
+                            pass  # the width-reject raises here too
                 assert dump(store) == dump(oracle), (
                     "sharded multi-relay end state diverged from the "
                     "single-device oracle twin"

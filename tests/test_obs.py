@@ -71,6 +71,96 @@ def test_reset_keeps_bucket_shape():
     assert edges == (1.0, 10.0) and count == 1
 
 
+def test_quantile_clamps_overflow_to_top_finite_edge():
+    """ISSUE 15 registry hardening: mass in the overflow bucket — via
+    the implicit +Inf bucket OR an explicitly registered inf edge —
+    must estimate to the TOP FINITE bucket edge, never inf (dashboards
+    need a plottable number)."""
+    import math
+
+    reg = metrics.MetricsRegistry()
+    reg.observe("imp_ms", 1e9)  # far past the latency family's top edge
+    q = reg.quantile("imp_ms", 0.99)
+    assert q is not None and math.isfinite(q)
+    assert q == metrics.LATENCY_MS_BUCKETS[-1]
+    reg.observe("exp_ms", 50.0, buckets=(1.0, 10.0, float("inf")))
+    reg.observe("exp_ms", 60.0)
+    q = reg.quantile("exp_ms", 0.5)
+    assert q == 10.0  # top FINITE edge, though mass sits in the inf bucket
+    # Interpolation inside finite buckets is unchanged.
+    reg.observe("mid_ms", 5.0, buckets=(1.0, 10.0, float("inf")))
+    assert 1.0 <= reg.quantile("mid_ms", 0.5) <= 10.0
+
+
+def test_snapshot_reset_hammer_loses_no_events():
+    """ISSUE 15 registry hardening: `snapshot(reset=True)` drains
+    atomically — with writer threads hammering inc()/observe(), the sum
+    across drained windows plus the final residue must equal exactly
+    what the writers recorded. A separate snapshot();reset() pair loses
+    whatever lands between the two calls; this pins the one-lock
+    contract."""
+    import threading
+
+    reg = metrics.MetricsRegistry()
+    N_THREADS, N_EVENTS = 4, 2000
+    stop = threading.Event()
+
+    def writer():
+        for _ in range(N_EVENTS):
+            reg.inc("h_total")
+            reg.observe("h_ms", 1.0, buckets=(10.0,))
+
+    threads = [threading.Thread(target=writer) for _ in range(N_THREADS)]
+    drained_counter = 0.0
+    drained_hist = 0
+    for t in threads:
+        t.start()
+    try:
+        while any(t.is_alive() for t in threads):
+            snap = reg.snapshot(reset=True)
+            for s in snap["counters"].get("h_total", []):
+                drained_counter += s["value"]
+            for s in snap["histograms"].get("h_ms", []):
+                drained_hist += s["count"]
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    final = reg.snapshot(reset=True)
+    for s in final["counters"].get("h_total", []):
+        drained_counter += s["value"]
+    for s in final["histograms"].get("h_ms", []):
+        drained_hist += s["count"]
+    assert drained_counter == N_THREADS * N_EVENTS
+    assert drained_hist == N_THREADS * N_EVENTS
+
+
+def test_build_info_and_process_gauges(tmp_path):
+    """ISSUE 15 satellite: `evolu_build_info` (facts in labels) +
+    uptime/RSS process gauges surface on /metrics so a fleet dashboard
+    can tell relay topologies apart without SSH."""
+    server = RelayServer(RelayStore()).start()
+    try:
+        text = _get(server.url + "/metrics")
+        m = re.search(r"^evolu_build_info\{([^}]*)\} 1$", text, re.M)
+        assert m, "evolu_build_info gauge missing from /metrics"
+        labels = dict(
+            kv.split("=", 1) for kv in re.findall(r'[a-z_]+="[^"]*"', m.group(1))
+        )
+        assert labels['version'].strip('"')
+        assert labels['backend'].strip('"') in ("native", "python")
+        assert labels['write_behind'].strip('"') == "0"
+        assert labels['connection_tier'].strip('"') in ("threaded", "eventloop")
+        assert "mesh_engine" in labels and "push" in labels
+        parsed = _parse_prometheus(text)
+        up = parsed[("evolu_process_uptime_seconds", frozenset())]
+        assert up >= 0
+        rss = parsed.get(("evolu_process_rss_bytes", frozenset()))
+        assert rss is None or rss > 1 << 20  # >1MB if the probe worked
+    finally:
+        server.stop()
+
+
 def test_prometheus_exposition_is_valid_and_escaped():
     metrics.inc("e_total", 2, path='we"ird\\x', note="a\nb")
     metrics.observe("e_ms", 3.0)
