@@ -32,7 +32,7 @@ import jax
 import numpy as np
 
 import bench
-from evolu_tpu.obs import flight, ledger, metrics
+from evolu_tpu.obs import anatomy, flight, ledger, metrics
 from evolu_tpu.utils.log import logger
 
 REPS_LO, REPS_HI = 200, 2000
@@ -93,6 +93,19 @@ def ledger_sentinel_sequence():
                     buckets=metrics.SIZE_BUCKETS)
 
 
+def anatomy_sequence():
+    """The stage-anatomy accounting ONE config-2 engine pass performs
+    (ISSUE 16): the three runtime seam records (device dispatch / pull
+    wave / host apply, each pricing a floor + feeding the decayed fit +
+    share gauges) plus the two kernel-span folds the pass's spans
+    trigger. Deliberately a superset of the steady state."""
+    anatomy.record_stage("device_dispatch", 0.115, rows=1_000_000)
+    anatomy.record_stage("pull_wave", 2.8, nbytes=48_000_000)
+    anatomy.record_stage("host_apply", 1.4, rows=1_000_000)
+    anatomy.record_span("kernel:reconcile", 115.0, rows=1_000_000)
+    anatomy.record_span("kernel:merkle", 9.5, rows=1_000_000)
+
+
 def _slope_ms(fn):
     """Slope between two repetition counts of a per-batch sequence."""
     def timed(reps):
@@ -114,6 +127,10 @@ def measure_instrumentation_ms():
 
 def measure_ledger_sentinel_ms():
     return _slope_ms(ledger_sentinel_sequence)
+
+
+def measure_anatomy_ms():
+    return _slope_ms(anatomy_sequence)
 
 
 def measure_reconcile_batch_ms():
@@ -147,6 +164,8 @@ def main():
     logger.clear()
     instr_ms = measure_instrumentation_ms()
     ledger_ms = measure_ledger_sentinel_ms()
+    anatomy.set_platform("tpu")  # priced floors = the expensive path
+    anatomy_ms = measure_anatomy_ms()
     batch_ms = measure_reconcile_batch_ms()
     print(json.dumps({
         "metric": "obs_instrumentation_overhead_on_1m_reconcile",
@@ -159,6 +178,10 @@ def main():
         "ledger_overhead_fraction": round(ledger_ms / batch_ms, 6),
         "ledger_overhead_pct": round(100 * ledger_ms / batch_ms, 4),
         "pass_ledger_0p1pct_gate": ledger_ms / batch_ms <= LEDGER_GATE_FRACTION,
+        "anatomy_ms_per_batch": round(anatomy_ms, 5),
+        "anatomy_overhead_fraction": round(anatomy_ms / batch_ms, 6),
+        "anatomy_overhead_pct": round(100 * anatomy_ms / batch_ms, 4),
+        "pass_anatomy_0p1pct_gate": anatomy_ms / batch_ms <= LEDGER_GATE_FRACTION,
         "device_graph_untouched": "pinned by tests/test_bench_liveness.py",
         "platform": jax.devices()[0].platform,
         "method": "two-point slope on both legs (fixed overhead cancelled)",

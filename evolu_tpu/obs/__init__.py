@@ -22,6 +22,13 @@ links, `GET /trace/<id>` span trees, and a Chrome-trace export
 (ISSUE 10 — one mutation followed client → relay → batch → engine →
 replica).
 
+`obs.anatomy` — the stage-anatomy plane (ISSUE 16): a declarative
+registry over the fused reconcile pipeline with machine-readable cost
+laws and roofline-priced floors, the `evolu_stage_*` metrics family
+(per-batch dispatch/pull/apply shares, fixed-RTT-vs-slope fits,
+over-floor flags), and the registry `benchmarks/stage_anatomy.py`
+builds its self-ablating timed variants from.
+
 This package MUST NOT import jax (directly or transitively): metrics
 and spans record host-side Python values the hot paths already hold —
 never an extra device pull, never an op inside the fused jit pipeline.
@@ -32,8 +39,9 @@ tests/test_import_hygiene.py and tests/test_bench_liveness.py.
 """
 
 from evolu_tpu.obs import flight, ledger, metrics, trace
+from evolu_tpu.obs import anatomy
 from evolu_tpu.obs.flight import recorder
 from evolu_tpu.obs.metrics import registry, set_enabled
 
-__all__ = ["flight", "ledger", "metrics", "trace", "recorder", "registry",
-           "set_enabled"]
+__all__ = ["anatomy", "flight", "ledger", "metrics", "trace", "recorder",
+           "registry", "set_enabled"]

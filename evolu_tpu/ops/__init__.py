@@ -127,17 +127,23 @@ def to_host_many(*xs):
     tests/test_bench_liveness.py)."""
     import time as _time
 
+    from evolu_tpu.obs import anatomy as _anatomy
     from evolu_tpu.obs import metrics as _metrics
 
     t0 = _time.perf_counter()
     out = tuple(to_host(x) for x in start_host_transfer(*xs))
     if _metrics.registry.enabled:
+        dt = _time.perf_counter() - t0
         wave_bytes = sum(int(getattr(a, "nbytes", 0)) for a in out)
         _metrics.inc("evolu_pull_bytes_total", wave_bytes)
-        _metrics.inc("evolu_pull_seconds_total",
-                     _time.perf_counter() - t0)
+        _metrics.inc("evolu_pull_seconds_total", dt)
         _metrics.observe("evolu_pull_wave_bytes", wave_bytes,
                          buckets=_metrics.SIZE_BUCKETS)
+        # Stage-anatomy fold (ISSUE 16): every wave is one pull_wave
+        # stage record, priced against the tunnel bandwidth law — the
+        # over-floor flag fires when a wave runs slower than
+        # FLOOR_FACTOR× the recorded MB/s for this platform.
+        _anatomy.record_stage("pull_wave", dt, nbytes=wave_bytes)
     return out
 
 

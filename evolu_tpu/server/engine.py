@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from contextlib import contextmanager
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -45,7 +46,7 @@ from evolu_tpu.parallel.mesh import (
     require_single_process,
     sharding,
 )
-from evolu_tpu.obs import flight, ledger, metrics
+from evolu_tpu.obs import anatomy, flight, ledger, metrics
 from evolu_tpu.parallel.reconcile import xor_allreduce
 from evolu_tpu.server.relay import RelayStore
 from evolu_tpu.utils.log import log, span
@@ -857,7 +858,12 @@ class BatchReconciler:
     def start_batch(self, requests: Sequence[protocol.SyncRequest]):
         """Stage batch k+1: pack per-shard buffers, parse natively,
         dispatch the device hash of ALL rows, START the async output
-        transfer. No database access happens here."""
+        transfer. No database access happens here. The whole seam is
+        one `device_dispatch` stage record (obs.anatomy): fixed tunnel
+        RTT separates from the per-row slope in the stage fit, and a
+        dispatch above FLOOR_FACTOR× its priced pipeline floor flags
+        evolu_stage_over_floor_total."""
+        t0_dispatch = time.perf_counter()
         stores, shard_index = self._shards()
         per_shard: List[List[protocol.SyncRequest]] = [[] for _ in stores]
         for r in requests:
@@ -932,6 +938,8 @@ class BatchReconciler:
                 # device/host overlap for the pipelined path.
                 fut = self._pull_executor().submit(to_host_many, *dev_state[3])
                 dev_state = (*dev_state[:3], fut, dev_state[4])
+        anatomy.record_stage("device_dispatch",
+                             time.perf_counter() - t0_dispatch, rows=off)
         return {
             "requests": requests, "live": live, "shard_data": shard_data,
             "dev": dev_state, "packed": packed, "n_total": off,
@@ -961,6 +969,12 @@ class BatchReconciler:
                 gu, gc, ts_packed, content_packed, lens
             )
 
+        # host_apply stage record (obs.anatomy): the C inserts + delta
+        # decode + tree folds + commit block. The pull itself records
+        # under pull_wave from to_host_many (possibly on the pull
+        # thread) — shares are over summed stage walls, and the two
+        # legs can overlap (documented in docs/OBSERVABILITY.md).
+        t0_apply = time.perf_counter()
         with span("kernel:merkle", "reconcile_stream_finish",
                   owners=len({r.user_id for r in st["requests"]}),
                   n=st["n_total"], shards=len(live)):
@@ -990,6 +1004,8 @@ class BatchReconciler:
                             "VALUES (?, ?)",
                             tree_rows[si],
                         )
+        anatomy.record_stage("host_apply", time.perf_counter() - t0_apply,
+                             rows=st["n_total"])
         # Ledger terminals AFTER the per-shard commits: per-owner
         # was-new sums classify inserted; the per-owner request totals
         # in _ledger_count_pass fold the in-batch-deduped rows into

@@ -30,7 +30,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from evolu_tpu.obs import flight, ledger, metrics, trace
+from evolu_tpu.obs import anatomy, flight, ledger, metrics, trace
 from evolu_tpu.utils.log import log
 
 from evolu_tpu.core.merkle import (
@@ -496,7 +496,124 @@ def relay_stats_payload(store, replication=None, fleet=None,
         payload["push"] = push_hub.stats_payload()
     if conn_tier is not None:
         payload["conn"] = conn_tier.stats_payload()
+    # Stage-anatomy section (ISSUE 16): per-stage counts/EWMA/fit/
+    # floor/over-floor plus the dispatch/pull/apply runtime shares.
+    payload["stages"] = anatomy.stages_payload()
     return payload
+
+
+# GET /profile single-flight: jax.profiler supports one capture per
+# process; a second concurrent request answers 429 instead of racing
+# start_trace (which raises — or worse, interleaves captures).
+_PROFILE_LOCK = threading.Lock()
+
+
+def capture_live_profile(duration_ms: float) -> dict:
+    """Capture `duration_ms` of live traffic as one loadable
+    Chrome-trace JSON document (perfetto/chrome://tracing both open
+    it). Three lanes share the timebase:
+
+    - the jax.profiler device+runtime timeline, captured only when jax
+      is ALREADY loaded in this process (a relay that never touched
+      jax must stay jax-free — the obs import-hygiene contract; many
+      relays serve pure-host workloads). PR-4 trace annotations are
+      enabled for the window so `kernel:*` span names appear inside
+      the profiler timeline too, then restored.
+    - the logger span ring (`kernel:*` and sync spans always land
+      there), exported as host-lane complete events.
+    - sampled obs.trace spans in the window via the PR-10 chrome
+      export (same event shape, their own lanes).
+
+    Never raises on profiler trouble: a failed jax capture degrades to
+    the host lanes with the error string in metadata — an operator
+    profiling a live relay must get *a* trace, not a 500."""
+    import gzip
+    import shutil
+    import sys
+    import tempfile
+
+    from evolu_tpu.utils import log as log_mod
+
+    t_start = time.time()
+    pid = os.getpid()
+    events: List[dict] = []
+    meta: Dict[str, object] = {"requested_ms": duration_ms}
+    prof_dir = None
+    jax_on = False
+    annotations_were_on = log_mod._trace_annotation_cls is not None
+    if "jax" in sys.modules:
+        try:
+            import jax  # already in sys.modules — no fresh import
+
+            log_mod.enable_trace_annotations(True)
+            prof_dir = tempfile.mkdtemp(prefix="evolu-profile-")
+            jax.profiler.start_trace(prof_dir)
+            jax_on = True
+        except Exception as e:  # noqa: BLE001 - degrade to host lanes
+            meta["jax_error"] = f"{type(e).__name__}: {e}"
+    time.sleep(max(float(duration_ms), 0.0) / 1e3)
+    if jax_on:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            for root, _dirs, files in os.walk(prof_dir):
+                for fname in files:
+                    if not fname.endswith(".trace.json.gz"):
+                        continue
+                    with gzip.open(os.path.join(root, fname), "rt",
+                                   encoding="utf-8") as f:
+                        doc = json.load(f)
+                    for ev in doc.get("traceEvents", []):
+                        # Real profiler dumps end with a bare {} and may
+                        # omit pid on metadata rows — keep the merged
+                        # document uniformly loadable.
+                        if not isinstance(ev, dict) or not ev.get("ph"):
+                            continue
+                        ev.setdefault("pid", pid)
+                        events.append(ev)
+        except Exception as e:  # noqa: BLE001
+            meta["jax_error"] = f"{type(e).__name__}: {e}"
+            jax_on = False
+        finally:
+            if not annotations_were_on:
+                log_mod.enable_trace_annotations(False)
+    if prof_dir is not None:
+        shutil.rmtree(prof_dir, ignore_errors=True)
+    meta["jax_profiler"] = jax_on
+    t_end = time.time()
+
+    # Host lane 1: logger span ring events overlapping the window.
+    n_host = 0
+    for ev in log_mod.logger.recent_events():
+        if ev.duration_ms is None:
+            continue
+        s0 = ev.t - ev.duration_ms / 1e3
+        if ev.t < t_start or s0 > t_end:
+            continue
+        n_host += 1
+        events.append({
+            "name": f"{ev.target}|{ev.message}" if ev.message else ev.target,
+            "cat": "evolu-host",
+            "ph": "X",
+            "ts": s0 * 1e6,
+            "dur": ev.duration_ms * 1e3,
+            "pid": pid,
+            "tid": 0,
+            "args": {k: str(v) for k, v in ev.fields.items()},
+        })
+    # Host lane 2: sampled distributed-trace spans in the window (the
+    # PR-10 export keeps their per-thread lanes + trace/span ids).
+    win_spans = [
+        s for s in trace.recorder.dump()
+        if s.t_start <= t_end and s.t_start + s.duration_ms / 1e3 >= t_start
+    ]
+    events.extend(trace.export_chrome(win_spans)["traceEvents"])
+    meta.update(captured_at=t_start, wall_ms=(t_end - t_start) * 1e3,
+                host_span_events=n_host, trace_span_events=len(win_spans),
+                platform=anatomy.get_platform())
+    return {"displayTimeUnit": "ms", "traceEvents": events,
+            "metadata": meta}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -609,7 +726,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _obs_authorized(self) -> bool:
         """Optional token gate for the observability read surface
-        (`GET /metrics`, `/stats`, `/trace/*`): with EVOLU_OBS_TOKEN
+        (`GET /metrics`, `/stats`, `/trace/*`, `/profile`): with EVOLU_OBS_TOKEN
         set, demand the matching header (constant-time compare — the
         EVOLU_FLEET_RELOAD_TOKEN pattern from /fleet/reload). /stats
         and /trace enumerate owner ids, which the sync path treats as
@@ -799,6 +916,39 @@ class _Handler(BaseHTTPRequestHandler):
                 metrics.inc("evolu_relay_errors_total")
                 self.send_error(500, str(e))
                 return
+            self._respond(200, body, "application/json")
+        elif self.path == "/profile" or self.path.startswith("/profile?"):
+            # Live profiling (ISSUE 16): capture ?ms= of real traffic
+            # as a loadable chrome/perfetto trace. Token-gated like the
+            # rest of the obs surface (span names carry owner ids);
+            # single-flight because jax.profiler allows one capture
+            # per process.
+            metrics.inc("evolu_relay_requests_total", endpoint="/profile")
+            if not self._obs_authorized():
+                return
+            import urllib.parse
+
+            q = urllib.parse.parse_qs(urllib.parse.urlsplit(self.path).query)
+            try:
+                ms = float(q.get("ms", ["250"])[0])
+            except ValueError:
+                self.send_error(400, "ms must be a number")
+                return
+            # Clamp: long enough to catch a batch, short enough that a
+            # fat-fingered ms=3600000 cannot park a handler for an hour.
+            ms = min(max(ms, 10.0), 30_000.0)
+            if not _PROFILE_LOCK.acquire(blocking=False):
+                metrics.inc("evolu_relay_profile_busy_total")
+                self.send_error(429, "a profile capture is already running")
+                return
+            try:
+                body = json.dumps(capture_live_profile(ms)).encode("utf-8")
+            except Exception as e:  # noqa: BLE001 - reader gets a clean 500
+                metrics.inc("evolu_relay_errors_total")
+                self.send_error(500, str(e))
+                return
+            finally:
+                _PROFILE_LOCK.release()
             self._respond(200, body, "application/json")
         elif self.path.startswith("/push/poll"):
             self._do_push_poll()

@@ -73,15 +73,15 @@ class LogEvent:
 
 
 def _obs():
-    """Lazy (obs.flight, obs.metrics, obs.trace) triple — obs imports
-    LogEvent from this module, so the reverse edge must resolve at
-    call time. Cached after the first call; one tuple check per event
-    afterwards."""
+    """Lazy (obs.flight, obs.metrics, obs.trace, obs.anatomy) tuple —
+    obs imports LogEvent from this module, so the reverse edge must
+    resolve at call time. Cached after the first call; one tuple check
+    per event afterwards."""
     global _obs_pair
     if _obs_pair is None:
-        from evolu_tpu.obs import flight, metrics, trace
+        from evolu_tpu.obs import anatomy, flight, metrics, trace
 
-        _obs_pair = (flight, metrics, trace)
+        _obs_pair = (flight, metrics, trace, anatomy)
     return _obs_pair
 
 
@@ -180,8 +180,15 @@ class Logger:
             # also lands in the distributed trace under its kernel:*
             # name, so the chrome export interleaves host and kernel
             # spans on one timebase.
-            flight, metrics, trace = _obs()
+            flight, metrics, trace, anatomy = _obs()
             metrics.observe("evolu_kernel_span_ms", ms, target=target)
+            if target.startswith("kernel:"):
+                # Stage-anatomy fold (ISSUE 16): kernel spans become
+                # evolu_stage_* series keyed by their target, with the
+                # span's n= field as the row count so the per-stage fit
+                # separates fixed RTT from slope. Bounded label set —
+                # targets come from TARGETS, never request data.
+                anatomy.record_span(target, ms, rows=fields.get("n", 0))
             flight.recorder.record_event(ev)
             tctx = trace.current()
             if tctx is not None:
@@ -245,10 +252,11 @@ class Logger:
             self._ring.clear()
             self._durations.clear()
         if globals().get("logger") is self:
-            flight, metrics, trace = _obs()
+            flight, metrics, trace, anatomy = _obs()
             metrics.reset()
             flight.recorder.clear()
             trace.recorder.clear()
+            anatomy.reset()
 
 
 # Module-level default, mirroring the reference's module singleton. The

@@ -188,6 +188,81 @@ def test_ledger_does_not_touch_the_bench_graph(tiny_setup):
     )
 
 
+def _stage_anatomy():
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "benchmarks"))
+    import stage_anatomy as sa
+
+    return sa
+
+
+def _anatomy_device_stages():
+    return list(_stage_anatomy().DEVICE_STAGES)
+
+
+@pytest.mark.parametrize("stage", _anatomy_device_stages())
+def test_every_truncated_variant_output_is_live(tiny_setup, stage):
+    """ISSUE 16: the stage-anatomy harness times TRUNCATED pipeline
+    variants, so the DCE fence must hold per variant, not just for the
+    full kernel — for the variant ending at `stage`, perturbing each
+    output that stage ADDED must move the variant's checksum. (Earlier
+    stages' outputs are pinned by their own variant's case.)"""
+    sa = _stage_anatomy()
+    mesh, args = tiny_setup
+    kernel = sa.build_variant(stage)
+    arity = sa.variant_arity(stage)
+    with jax.enable_x64(True):
+        base = int(sa.make_variant_loop(mesh, 1, kernel)(*args))
+        dead = []
+        for j in sa.stage_output_indices(stage):
+            loop = sa.make_variant_loop(
+                mesh, 1, sa.perturbing_kernel(kernel, j, arity))
+            if int(loop(*args)) == base:
+                dead.append(j)
+    assert dead == [], (
+        f"[{stage}] outputs {dead} do not feed the variant checksum — the "
+        f"anatomy harness would time a DCE'd (smaller) pipeline"
+    )
+
+
+def test_anatomy_does_not_touch_the_bench_graph(tiny_setup):
+    """ISSUE 16's twin of the metrics/tracing/ledger fences: with the
+    stage-anatomy accountant HOT (platform set, stage records posting
+    around and between loop invocations — the engine seams call it per
+    batch), the bench checksum must stay bit-identical and the jit
+    cache-miss count flat. Stage accounting is host-side float/dict
+    arithmetic by contract."""
+    from evolu_tpu.obs import anatomy, metrics
+
+    mesh, args = tiny_setup
+    loop = bench.make_loop(mesh, 1)
+    prev_platform = anatomy.get_platform()
+    with jax.enable_x64(True):
+        metrics.set_enabled(False)
+        try:
+            base = int(loop(*args))
+            cache_size = loop._cache_size()
+            metrics.set_enabled(True)
+            anatomy.set_platform("tpu")
+            anatomy.record_stage("device_dispatch", 0.105, rows=512)
+            with_anatomy = int(loop(*args))
+            anatomy.record_stage("host_apply", 0.002, rows=512)
+            anatomy.record_stage("pull_wave", 0.001, nbytes=4096)
+            cache_size_after = loop._cache_size()
+        finally:
+            metrics.set_enabled(True)
+            anatomy.set_platform(prev_platform)
+            anatomy.reset()
+    assert with_anatomy == base, "stage accounting changed the bench checksum"
+    assert cache_size_after == cache_size, (
+        "stage accounting added jit cache misses (recompiles) to the "
+        "timed pipeline"
+    )
+
+
 def test_checksum_depends_on_the_data():
     """Same loop, different input data → different checksum (guards a
     degenerate fold that collapses to a constant)."""

@@ -76,6 +76,32 @@ def test_obs_package_never_imports_jax():
     assert "CLEAN" in proc.stdout, "evolu_tpu.obs transitively imported jax"
 
 
+def test_anatomy_module_never_imports_jax_and_prices_without_a_backend():
+    """ISSUE 16's explicit pin for the stage-anatomy module ALONE:
+    importing, setting the platform, pricing floors, recording stages,
+    fingerprinting the registry, and rendering the /stats payload must
+    never pull jax into the process — the plane runs on relays that
+    serve pure-host workloads and must stay jax-free (the platform is
+    PUSHED in from parallel/mesh.py on jax-side paths)."""
+    script = (
+        "import sys; from evolu_tpu.obs import anatomy; "
+        "anatomy.set_platform('tpu'); "
+        "assert anatomy.floor_ms('key_sort', rows=1_000_000) > 0; "
+        "anatomy.record_stage('host_apply', 0.01, rows=7200); "
+        "assert len(anatomy.registry_digest()) == 8; "
+        "p = anatomy.stages_payload(); "
+        "assert p['stages']['host_apply']['count'] == 1; "
+        "print('JAX_LOADED' if 'jax' in sys.modules else 'CLEAN')"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        env={**os.environ, "PYTHONPATH": _REPO},
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "CLEAN" in proc.stdout, "evolu_tpu.obs.anatomy transitively imported jax"
+
+
 def test_trace_module_never_imports_jax_and_never_touches_a_backend():
     """ISSUE 10's explicit pin for the tracing module ALONE (not just
     via the package import): importing, minting spans, parsing and

@@ -1,0 +1,265 @@
+"""Stage-anatomy plane (evolu_tpu/obs/anatomy.py + the ablation
+harness benchmarks/stage_anatomy.py) — registry shape and digest
+stability, roofline floor pricing against the recorded v5e laws,
+unknown-platform unpriced behavior, the evolu_stage_* metrics family
+(histograms/counters/gauges, over-floor flagging past warmup, the
+decayed slope/fixed fit recovering a synthetic cost law, runtime share
+gauges), kernel-span folding through utils.log.span, the /stats
+payload, and registry↔harness agreement (variant arity, device-stage
+order, truncated-variant structural containment)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+from evolu_tpu.obs import anatomy, metrics
+from evolu_tpu.utils.log import logger, span
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks"))
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    logger.clear()  # resets metrics registry + anatomy accumulators
+    prev = anatomy.get_platform()
+    yield
+    anatomy.set_platform(prev)
+    logger.configure(False)
+    logger.clear()
+
+
+# --- registry shape + digests ---
+
+
+def test_registry_shape():
+    names = [s.name for s in anatomy.STAGES]
+    assert names == [
+        "key_sort", "plan_compare", "hash_render", "minute_fold",
+        "delta_encode", "pull_wave", "device_dispatch", "host_apply",
+    ]
+    device = [s for s in anatomy.STAGES if s.kind == "device"]
+    assert len(device) == 5
+    # Device stages chain: each stage's inputs come from prior outputs
+    # or the kernel's own input columns.
+    produced = {"cell_id", "k1", "k2", "ex_k1", "ex_k2", "owner_ix"}
+    for s in device:
+        assert set(s.inputs) <= produced, (s.name, s.inputs)
+        produced |= set(s.outputs)
+    # Every price term names a real law key in EVERY platform's laws
+    # (cpu and tpu rows must stay key-compatible).
+    for s in anatomy.STAGES:
+        for law_key, unit in s.price:
+            if unit == "device_pipeline":
+                continue
+            for plat, laws in anatomy.COST_LAWS.items():
+                assert law_key in laws, (s.name, law_key, plat)
+
+
+def test_registry_digest_is_stable_and_law_sensitive():
+    d1 = anatomy.registry_digest()
+    assert d1 == anatomy.registry_digest()
+    assert len(d1) == 8 and int(d1, 16) >= 0
+    old = anatomy.COST_LAWS["tpu"]["sort_key_ms_per_1m"]
+    try:
+        anatomy.COST_LAWS["tpu"]["sort_key_ms_per_1m"] = old * 2
+        assert anatomy.registry_digest() != d1  # re-pricing moves the gate
+    finally:
+        anatomy.COST_LAWS["tpu"]["sort_key_ms_per_1m"] = old
+
+
+# --- floor pricing ---
+
+
+def test_floor_prices_v5e_laws_exactly():
+    # key_sort at 1M rows = 1.5 (key) + 2 × 0.75 (payloads) = 3.0 ms.
+    assert anatomy.floor_ms("key_sort", rows=1_000_000,
+                            platform="tpu") == pytest.approx(3.0)
+    # pull_wave is bandwidth-priced: 17 MB at 17 MB/s = 1000 ms.
+    assert anatomy.floor_ms("pull_wave", nbytes=17_000_000,
+                            platform="tpu") == pytest.approx(1000.0)
+    # host_apply is throughput-priced: 720k rows at 720k rows/s = 1 s.
+    assert anatomy.floor_ms("host_apply", rows=720_000,
+                            platform="tpu") == pytest.approx(1000.0)
+    # device_dispatch = fixed RTT + the whole device pipeline at size.
+    dev_sum = sum(
+        anatomy.floor_ms(s.name, rows=1_000_000, platform="tpu")
+        for s in anatomy.STAGES if s.kind == "device"
+    )
+    assert anatomy.floor_ms("device_dispatch", rows=1_000_000,
+                            platform="tpu") == pytest.approx(101.0 + dev_sum)
+    # Span targets price as the sum of their mapped stages.
+    merkle = sum(
+        anatomy.floor_ms(s, rows=1_000_000, platform="tpu")
+        for s in ("hash_render", "minute_fold", "delta_encode")
+    )
+    assert anatomy.floor_ms("kernel:merkle", rows=1_000_000,
+                            platform="tpu") == pytest.approx(merkle)
+
+
+def test_unknown_platform_and_stage_are_unpriced():
+    assert anatomy.floor_ms("key_sort", rows=1 << 20, platform="riscv") == 0.0
+    assert anatomy.floor_ms("no_such_stage", rows=1 << 20, platform="tpu") == 0.0
+    anatomy.set_platform("riscv")
+    assert anatomy.floor_ms("key_sort", rows=1 << 20) == 0.0
+
+
+# --- the evolu_stage_* family ---
+
+
+def test_record_stage_emits_family():
+    anatomy.set_platform("tpu")
+    anatomy.record_stage("host_apply", 0.010, rows=7200)  # floor = 10 ms
+    assert metrics.get_counter("evolu_stage_seconds_total",
+                               stage="host_apply") == pytest.approx(0.010)
+    assert metrics.get_counter("evolu_stage_rows_total",
+                               stage="host_apply") == 7200
+    _, _, _, count = metrics.registry.get_histogram("evolu_stage_ms",
+                                                    stage="host_apply")
+    assert count == 1
+    assert metrics.registry.get_gauge(
+        "evolu_stage_floor_ms", stage="host_apply") == pytest.approx(10.0)
+    assert metrics.registry.get_gauge(
+        "evolu_stage_over_floor_ratio", stage="host_apply"
+    ) == pytest.approx(1.0)
+
+
+def test_over_floor_flags_only_past_warmup():
+    anatomy.set_platform("tpu")
+    # floor = 10 ms; 100 ms is 10× over FLOOR_FACTOR=4.
+    for _ in range(2):  # warmup records never flag (compile time)
+        anatomy.record_stage("host_apply", 0.100, rows=7200)
+    assert metrics.get_counter("evolu_stage_over_floor_total",
+                               stage="host_apply") == 0
+    anatomy.record_stage("host_apply", 0.100, rows=7200)
+    assert metrics.get_counter("evolu_stage_over_floor_total",
+                               stage="host_apply") == 1
+    anatomy.record_stage("host_apply", 0.011, rows=7200)  # healthy: no flag
+    assert metrics.get_counter("evolu_stage_over_floor_total",
+                               stage="host_apply") == 1
+
+
+def test_slope_fit_recovers_synthetic_law():
+    # Synthetic stage law: 5 ms fixed + 2 µs/row. The decayed online
+    # fit must separate intercept from slope (the wall/count trap).
+    anatomy.set_platform("unknown-bench")
+    for rows in (1000, 4000, 16000, 2000, 8000, 32000):
+        anatomy.record_stage("device_dispatch", (5.0 + 0.002 * rows) / 1e3,
+                             rows=rows)
+    slope = metrics.registry.get_gauge("evolu_stage_slope_ns_per_row",
+                                       stage="device_dispatch")
+    fixed = metrics.registry.get_gauge("evolu_stage_fixed_ms",
+                                       stage="device_dispatch")
+    assert slope == pytest.approx(2000.0, rel=0.05)  # 2 µs = 2000 ns/row
+    assert fixed == pytest.approx(5.0, rel=0.05)
+
+
+def test_runtime_share_gauges():
+    anatomy.set_platform("unknown-bench")
+    anatomy.record_stage("device_dispatch", 0.030, rows=100)
+    anatomy.record_stage("pull_wave", 0.010, nbytes=1000)
+    anatomy.record_stage("host_apply", 0.060, rows=100)
+    total = 0.030 + 0.010 + 0.060
+    assert metrics.registry.get_gauge(
+        "evolu_stage_share", stage="host_apply"
+    ) == pytest.approx(0.060 / total)
+    assert metrics.registry.get_gauge(
+        "evolu_stage_share", stage="pull_wave"
+    ) == pytest.approx(0.010 / total)
+    payload = anatomy.stages_payload()
+    assert payload["stages"]["device_dispatch"]["share"] == pytest.approx(
+        0.030 / total)
+
+
+def test_disabled_registry_records_nothing():
+    metrics.set_enabled(False)
+    try:
+        anatomy.record_stage("host_apply", 0.5, rows=10_000)
+    finally:
+        metrics.set_enabled(True)
+    assert anatomy.stages_payload()["stages"] == {}
+
+
+def test_kernel_span_folds_into_family():
+    anatomy.set_platform("tpu")
+    with span("kernel:merkle", "t", n=1000):
+        pass
+    with span("host:apply", "t"):  # non-kernel spans stay out
+        pass
+    payload = anatomy.stages_payload()
+    assert payload["stages"]["kernel:merkle"]["count"] == 1
+    assert "host:apply" not in payload["stages"]
+    assert metrics.get_counter("evolu_stage_rows_total",
+                               stage="kernel:merkle") == 1000
+    # The span target priced via its mapped stages.
+    assert payload["stages"]["kernel:merkle"]["floor_ms"] == pytest.approx(
+        anatomy.floor_ms("kernel:merkle", rows=1000, platform="tpu"))
+
+
+def test_stages_payload_shape_and_reset():
+    anatomy.set_platform("tpu")
+    anatomy.record_stage("host_apply", 0.010, rows=7200)
+    p = anatomy.stages_payload()
+    assert p["platform"] == "tpu"
+    assert p["registry_digest"] == anatomy.registry_digest()
+    assert p["floor_factor"] == anatomy.FLOOR_FACTOR
+    st = p["stages"]["host_apply"]
+    assert st["count"] == 1
+    assert st["ewma_ms"] == pytest.approx(10.0)
+    json.dumps(p)  # must be JSON-clean for GET /stats
+    logger.clear()
+    assert anatomy.stages_payload()["stages"] == {}
+    assert anatomy.get_platform() == "tpu"  # platform survives clear
+
+
+# --- registry ↔ ablation-harness agreement ---
+
+
+def test_harness_matches_registry():
+    import stage_anatomy as sa
+
+    assert sa.DEVICE_STAGES == tuple(
+        s.name for s in anatomy.STAGES if s.kind == "device")
+    # Cumulative arity: key_sort 3, +3, +2, +5, +3 = 16.
+    assert [sa.variant_arity(s) for s in sa.DEVICE_STAGES] == [3, 6, 8, 13, 16]
+    assert list(sa.stage_output_indices("hash_render")) == [6, 7]
+    assert list(sa.stage_output_indices("key_sort")) == [0, 1, 2]
+
+
+def test_truncated_variants_nest_structurally():
+    """Each truncated variant's jaxpr primitive multiset must be a
+    sub-multiset of the next one's — ablation only ever REMOVES tail
+    work, so a stage can never change the upstream computation it
+    claims to be measuring."""
+    jax = pytest.importorskip("jax")
+    import numpy as np
+
+    import stage_anatomy as sa
+
+    n = 256
+    probe = (
+        np.full(n, 0x7FFFFFFF, np.int32),
+        np.zeros(n, np.uint64), np.zeros(n, np.uint64),
+        np.zeros(n, np.uint64), np.zeros(n, np.uint64),
+        np.zeros(n, np.int64),
+    )
+    from collections import Counter
+
+    from evolu_tpu.parallel.mesh import create_mesh
+
+    mesh = create_mesh()
+    multisets = []
+    with jax.enable_x64(True):
+        for name in sa.DEVICE_STAGES:
+            loop = sa.make_variant_loop(mesh, 1, sa.build_variant(name))
+            jaxpr = jax.make_jaxpr(loop)(*probe)
+            prims = []
+            sa._collect_prims(jaxpr.jaxpr, prims)
+            multisets.append(Counter(prims))
+    for prev, cur in zip(multisets, multisets[1:]):
+        assert not prev - cur, f"ablation removed upstream work: {prev - cur}"
+    # And each stage genuinely adds primitives.
+    for prev, cur in zip(multisets, multisets[1:]):
+        assert cur - prev

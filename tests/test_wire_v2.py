@@ -289,6 +289,85 @@ def test_mutation_fuzz_response_wire_never_diverges():
         assert fused == oracle, f"trial {trial}"
 
 
+def test_corrupt_length_prefix_fuzz_never_diverges():
+    """ISSUE 16 satellite audit of the PR-13 fused/pure contract: the
+    random mutation fuzz above rarely lands on the bytes that matter
+    most — the varint LENGTH PREFIXES that frame every length-delimited
+    field. A corrupted length re-frames everything after it (the exact
+    shape of the once-divergent fixture below), so this walks the real
+    encoded response, enumerates every length-prefix offset (top level
+    and nested), applies deterministic worst-case corruptions to each
+    (zero, max-7bit, continuation-bit flip, off-by-one both ways,
+    0xFF), and asserts the fused C walker's outcome still equals the
+    pure decode+decrypt oracle on every single one."""
+    if not native_crypto.native_available():
+        pytest.skip("libevolu_crypto unavailable")
+
+    def length_prefix_spans(data, base=0, depth=0, out=None):
+        out = [] if out is None else out
+        pos = 0
+        while pos < len(data):
+            try:
+                tag, p = protocol._read_varint(data, pos)
+            except ValueError:
+                break
+            wt = tag & 7
+            if wt == 2:
+                try:
+                    ln, q = protocol._read_varint(data, p)
+                except ValueError:
+                    break
+                if ln < 0 or q + ln > len(data):
+                    break
+                out.append((base + p, q - p))
+                if depth < 2:  # message → record → envelope fields
+                    length_prefix_spans(data[q:q + ln], base + q,
+                                        depth + 1, out)
+                pos = q + ln
+            elif wt == 0:
+                try:
+                    _, pos = protocol._read_varint(data, p)
+                except ValueError:
+                    break
+            elif wt == 5:
+                pos = p + 4
+            elif wt == 1:
+                pos = p + 8
+            else:
+                break
+        return out
+
+    enc = encrypt_messages_v2(_msgs(["a", 7, None]), MN)
+    base = protocol.encode_sync_response(protocol.SyncResponse(enc, '{"x":1}'))
+    spans = length_prefix_spans(base)
+    assert len(spans) >= 4, "walker found no nested length prefixes"
+    divergent = []
+    for off, width in spans:
+        orig = base[off]
+        corruptions = {0x00, 0x7F, 0xFF, orig ^ 0x80,
+                       (orig + 1) & 0xFF, (orig - 1) & 0xFF} - {orig}
+        for value in sorted(corruptions):
+            data = base[:off] + bytes([value]) + base[off + 1:]
+            try:
+                fused = native_crypto.decrypt_response(data, MN)
+            except (PgpError, ValueError) as e:
+                fused = type(e)
+            if fused is None:  # demoted: production runs the pure path
+                continue
+            try:
+                resp = protocol.decode_sync_response(data)
+                oracle = (decrypt_messages(resp.messages, MN),
+                          resp.merkle_tree)
+            except (PgpError, ValueError) as e:
+                oracle = type(e)
+            if fused != oracle:
+                divergent.append((off, width, value))
+    assert divergent == [], (
+        f"fused/pure outcomes diverged on corrupted length prefixes: "
+        f"{divergent[:10]}"
+    )
+
+
 def test_tampered_leg_is_one_error_never_partial():
     """Tamper ANYWHERE in a multi-record leg surfaces as ONE PgpError
     for the whole leg — the decrypt raises before anything is
