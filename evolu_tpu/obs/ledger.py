@@ -121,6 +121,22 @@ APPLY_LOSING = "apply.losing"
 APPLY_DUPLICATE = "apply.duplicate"
 APPLY_REJECTED = "apply.rejected"
 
+# Partial-replication tallies (ISSUE 18 — outside the flow equations
+# on purpose: a scoped serve classifies response EGRESS rows, it never
+# changes where an ingressed message terminates, so `server-flow`
+# stays balanced and `audit() == []` holds with scoping on).
+#   serve.scoped_rows      response rows served under a scope clause
+#   serve.scope_filtered   response rows withheld by the scope filter
+#                          (deferred — still fully stored and in the
+#                          full tree; the client's deferred frontier is
+#                          the mirror count, runtime/worker.py)
+#   apply.deferred_mat     (client plane tally) messages whose
+#                          app-table materialization the sync scope
+#                          deferred — log+tree applied, upsert skipped
+SERVE_SCOPED = "serve.scoped_rows"
+SERVE_SCOPE_FILTERED = "serve.scope_filtered"
+APPLY_DEFERRED_MAT = "apply.deferred_mat"
+
 # The ISSUE-10 cardinality bound, applied to owner sub-ledgers: past
 # the cap, new owners aggregate under this key.
 OWNER_OVERFLOW = "__overflow__"

@@ -93,6 +93,22 @@ class RestoreOwner:
     mnemonic: str
 
 
+@dataclass(frozen=True)
+class WidenSyncScope:
+    """Escalate the partial-replication scope (ISSUE 18,
+    sync/scope.py): lower the watermark and/or add tables to the
+    filter; `full=True` drops scoping entirely. The worker
+    re-materializes every newly-in-scope table from the local
+    `__message` log in LWW order and clears its deferred frontier;
+    the wider slice's MISSING history then arrives via ordinary
+    anti-entropy (the relay's scoped subtree widened with the same
+    clause). Narrowing raises — see SyncScope.widen()."""
+
+    watermark_millis: "int | None" = None
+    tables: tuple = ()
+    full: bool = False
+
+
 # --- outputs (types.ts:445-459) ---
 
 

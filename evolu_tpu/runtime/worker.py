@@ -382,6 +382,8 @@ class DbWorker:
                     self._reset_owner()
                 elif isinstance(command, msg.RestoreOwner):
                     self._restore_owner(command.mnemonic)
+                elif isinstance(command, msg.WidenSyncScope):
+                    self._widen_scope(command)
                 else:
                     raise ValueError(f"unknown command: {command!r}")
         except Exception as e:  # noqa: BLE001 - the Either-left channel
@@ -646,6 +648,23 @@ class DbWorker:
                         t, timestamp_from_string(s), now, self.config.max_drift
                     )
             messages = command.messages if packed else list(command.messages)
+            deferred: List[CrdtMessage] = []
+            scope = getattr(self.config, "sync_scope", None)
+            if scope is not None and scope.tables:
+                # Partial replication (ISSUE 18): only in-scope tables
+                # materialize; out-of-scope messages still land in the
+                # log and the Merkle tree (log-only apply below) so
+                # anti-entropy and the digest never see the difference.
+                # The packed slab cannot partition per-table — bounce
+                # to the object path BEFORE any side effect (the same
+                # stance as the r5 non-canonical bounce).
+                if packed:
+                    messages = list(messages.to_messages())
+                in_scope: List[CrdtMessage] = []
+                for m in messages:
+                    (in_scope if scope.table_in_scope(m.table)
+                     else deferred).append(m)
+                messages = in_scope
             chunk = self.config.receive_chunk_size
             if chunk and len(messages) > chunk:
                 # Huge history (e.g. initial sync of a restored device):
@@ -676,12 +695,17 @@ class DbWorker:
                 )
                 # persist() already wrote the final clock with this tree
                 # and staged the OnReceive.
+                if deferred:
+                    tree = self._apply_deferred(tree, deferred)
+                    update_clock(self.db, CrdtClock(t, tree))
                 clock = CrdtClock(t, tree)
             else:
                 tree = apply_messages(
                     self.db, clock.merkle_tree, messages,
                     planner=self._planner, changes=self._staged_changes_or_none(),
                 )
+                if deferred:
+                    tree = self._apply_deferred(tree, deferred)
                 clock = CrdtClock(t, tree)
                 update_clock(self.db, clock)
                 self._emit(msg.OnReceive())
@@ -714,6 +738,149 @@ class DbWorker:
                 previous_diff=diff,
             )
         )
+
+    # -- partial replication (ISSUE 18, sync/scope.py) --
+
+    _SCOPE_DEFERRED_DDL = (
+        'CREATE TABLE IF NOT EXISTS "__scope_deferred" '
+        '("table" TEXT PRIMARY KEY, "rows" INTEGER NOT NULL) WITHOUT ROWID'
+    )
+
+    def _apply_deferred(self, tree: dict, deferred: List[CrdtMessage]) -> dict:
+        """Out-of-scope leg of a scoped receive: log + Merkle tree only
+        (`apply_messages_log_only`), no app-table rows, with the skipped
+        materialization COUNTED in the `__scope_deferred` frontier so a
+        query against one of these tables can answer a typed deferral
+        instead of silently-empty rows."""
+        from evolu_tpu.storage.apply import apply_messages_log_only
+
+        # Frontier counts must be EXACT against the log: anti-entropy
+        # re-serves whole minutes, so a batch can redeliver rows the
+        # log already holds — screen them out before counting (the
+        # insert below is ON CONFLICT DO NOTHING, so the log agrees).
+        seen: set = set()
+        stamps = [m.timestamp for m in deferred]
+        for i in range(0, len(stamps), 500):
+            chunk = stamps[i:i + 500]
+            rows = self.db.exec_sql_query(
+                'SELECT "timestamp" FROM "__message" WHERE "timestamp" '
+                f'IN ({",".join("?" * len(chunk))})',
+                tuple(chunk),
+            )
+            seen.update(r["timestamp"] for r in rows)
+        tree = apply_messages_log_only(
+            self.db, tree, deferred, changes=self._staged_changes_or_none()
+        )
+        cache = getattr(self._planner, "cache", None)
+        if cache is not None:
+            # The log's MAX(timestamp) for these cells just moved via a
+            # plan the HBM cache never saw — the cache==SQLite invariant
+            # demands invalidation, exactly like the host-oracle route.
+            cache.invalidate({(m.table, m.row, m.column) for m in deferred})
+        counts: Dict[str, int] = {}
+        for m in deferred:
+            if m.timestamp in seen:
+                continue
+            counts[m.table] = counts.get(m.table, 0) + 1
+        self.db.exec(self._SCOPE_DEFERRED_DDL)
+        for tbl, n in counts.items():
+            self.db.run(
+                'INSERT INTO "__scope_deferred" ("table", "rows") '
+                'VALUES (?, ?) '
+                'ON CONFLICT("table") DO UPDATE SET "rows" = "rows" + ?',
+                (tbl, n, n),
+            )
+        n_new = sum(counts.values())
+        if n_new:
+            metrics.inc("evolu_scope_deferred_total", n_new)
+        return tree
+
+    def _deferred_frontier(self) -> Dict[str, int]:
+        """table → deferred-message count, {} when nothing is deferred
+        (including before the side table first exists)."""
+        try:
+            rows = self.db.exec_sql_query(
+                'SELECT "table", "rows" FROM "__scope_deferred" '
+                'WHERE "rows" > 0'
+            )
+        except Exception:  # noqa: BLE001 - no table yet = empty frontier
+            return {}
+        return {r["table"]: r["rows"] for r in rows}
+
+    def _widen_scope(self, command: "msg.WidenSyncScope") -> None:
+        """Escalation (widenSyncScope): relax the scope, re-materialize
+        every newly-in-scope table from the LOCAL log in LWW order, and
+        clear its frontier rows. History the relay withheld arrives via
+        the next ordinary anti-entropy round — the scoped server
+        subtree widens with the same clause, so the tree diff drives
+        catch-up with no special protocol."""
+        scope = getattr(self.config, "sync_scope", None)
+        if scope is None:
+            return  # already a full replica; nothing to widen
+        if command.full:
+            new = None
+        else:
+            new = scope.widen(command.watermark_millis,
+                              tuple(command.tables))
+            if new.is_noop:
+                new = None
+        n_remat = 0
+        for tbl in sorted(self._deferred_frontier()):
+            if new is None or new.table_in_scope(tbl):
+                n_remat += self._rematerialize_table(tbl)
+                self.db.run(
+                    'DELETE FROM "__scope_deferred" WHERE "table" = ?',
+                    (tbl,),
+                )
+        self.config.sync_scope = new
+        if n_remat:
+            # Whole tables appeared at once: unattributable to any
+            # message batch — the conservative invalidation arm.
+            self._staged_changes.mark_unknown()
+            metrics.inc("evolu_scope_widen_materialized_total", n_remat)
+        self._emit(msg.OnReceive())
+
+    def _rematerialize_table(self, table: str) -> int:
+        """Replay one table's app rows from the `__message` log: LWW
+        winner per (row, column) upserted (ascending timestamp order,
+        last write wins — byte-identical to having applied every batch
+        unscoped), typed cells rebuilt via the order-free full-state
+        fold. → messages replayed."""
+        from evolu_tpu.core.crdt_types import load_schema, rebuild_state
+        from evolu_tpu.storage.apply import _upsert_sql
+
+        rows = self.db.exec_sql_query(
+            'SELECT "timestamp", "row", "column", "value" FROM "__message" '
+            'WHERE "table" = ? ORDER BY "timestamp"',
+            (table,),
+        )
+        if not rows:
+            return 0
+        schema = load_schema(self.db)
+        winners: Dict[tuple, dict] = {}
+        has_typed = False
+        for r in rows:
+            if schema and schema.is_typed(table, r["column"]):
+                has_typed = True
+                continue
+            winners[(r["row"], r["column"])] = r
+        for r in winners.values():
+            self.db.run(
+                _upsert_sql(table, r["column"]),
+                (r["row"], r["value"], r["value"]),
+            )
+        if has_typed:
+            # Typed folds were skipped at defer time; the incremental
+            # path can't replay them (its dedup screen reads __message,
+            # where every one of these ops already lives) — the
+            # order-free full rebuild is the exact route.
+            rebuild_state(self.db, schema)
+        cache = getattr(self._planner, "cache", None)
+        if cache is not None:
+            cache.invalidate({
+                (table, r["row"], r["column"]) for r in rows
+            })
+        return len(rows)
 
     def _query(self, queries: Sequence[str], on_complete_ids: Sequence[str] = (),
                gated: bool = True) -> None:
@@ -751,6 +918,16 @@ class DbWorker:
         the subscriber also starts from [], which an evicted-but-live
         subscription does not."""
         patches = []
+        # Partial replication (ISSUE 18): a query that reads a table
+        # with deferred (log-only) rows must answer a TYPED deferral,
+        # never silently-empty rows. One frontier read per sweep; {}
+        # when no scope filter is active.
+        _scope = getattr(self.config, "sync_scope", None)
+        deferred_tables = (
+            self._deferred_frontier()
+            if _scope is not None and _scope.tables else {}
+        )
+        deferred_hits: set = set()
         raw_capable = hasattr(self.db, "exec_sql_query_packed_raw")
         if raw_capable:
             from evolu_tpu.storage.native import (
@@ -823,6 +1000,33 @@ class DbWorker:
             staged_seen_add(q)
             n_exec += 1
             sql, parameters = msg.deserialize_query(q)
+            if deferred_tables:
+                deps = deps_get(q)
+                if deps is None:
+                    # Built eagerly for the honesty check even when
+                    # invalidation gating is off; never raises (its own
+                    # failures degrade to unknown deps).
+                    deps = query_dependencies(self.db, sql, parameters)
+                    if build_deps:
+                        self._query_deps[q] = deps
+                read_tables = deps.tables
+                if read_tables is not None:
+                    hit = [t for t in read_tables if t in deferred_tables]
+                else:
+                    # EXPLAIN walk gave up: conservative text scan —
+                    # over-matching defers a query it needn't (honest,
+                    # recoverable by widening); under-matching would
+                    # answer rows a full replica wouldn't.
+                    import re as _re
+
+                    hit = [
+                        t for t in deferred_tables
+                        if _re.search(r"\b" + _re.escape(t) + r"\b", sql)
+                    ]
+                if hit:
+                    n_exec -= 1  # deferred, not executed
+                    deferred_hits.update(hit)
+                    continue
             if build_deps and q not in self._query_deps:
                 # First execution builds the dependency index entry;
                 # query_dependencies never raises (its own failures
@@ -886,6 +1090,15 @@ class DbWorker:
             metrics.inc("evolu_query_skipped_by_rows_total", n_rows)
         if n_cons:
             metrics.inc("evolu_query_conservative_total", n_cons)
+        if deferred_hits:
+            from evolu_tpu.sync.scope import ScopeDeferred
+
+            tables = tuple(sorted(deferred_hits))
+            self._emit(msg.OnError(ScopeDeferred(
+                tables, sum(deferred_tables[t] for t in tables)
+            )))
+            metrics.inc("evolu_scope_query_deferred_total",
+                        len(deferred_hits))
         if patches or on_complete_ids:
             self._emit(msg.OnQuery(tuple(patches), tuple(on_complete_ids)))
 

@@ -528,7 +528,8 @@ class EventLoopHTTPServer:
 
         sp = urlsplit(target)
         try:
-            owner, node, cursor, timeout = push_mod.parse_poll_query(sp.query)
+            owner, node, cursor, timeout, tags = \
+                push_mod.parse_poll_query(sp.query)
         except ValueError:
             return False  # pool → handler → 400, byte-identical
         metrics.inc("evolu_relay_requests_total", endpoint="/push/poll")
@@ -539,7 +540,8 @@ class EventLoopHTTPServer:
                 self._respond_inline(conn, resp)
                 return True
         try:
-            kind, val = hub.park(owner, node, cursor, timeout, token=conn)
+            kind, val = hub.park(owner, node, cursor, timeout, token=conn,
+                                 tags=tags)
         except push_mod.HubFull as e:
             # _fmt_retry, not str(): the threaded tier formats through
             # scheduler.format_retry_after ("1", not "1.0") and the

@@ -1179,6 +1179,15 @@ class BatchReconciler:
         responses = []
         tree_strings = dict(tree_strings or {})
         for r in requests:
+            if r.scope is not None:
+                # Scoped request: the batch ingest above already landed
+                # its rows in the FULL tree (scoping never touches
+                # ingest); only the respond is answered from the
+                # derived scoped subtree (server/scope.py).
+                from evolu_tpu.server import scope as scope_mod
+
+                responses.append(scope_mod.scoped_response(self.store, r))
+                continue
             tree, ts = self._resolve_tree(r.user_id, trees, tree_strings)
             client_tree = merkle_tree_from_string(r.merkle_tree)
             messages = self.store.get_messages(r.user_id, r.node_id, tree, client_tree)
@@ -1353,6 +1362,17 @@ class BatchReconciler:
         out: List[Optional[bytes]] = []
         fallback: List[Tuple[int, protocol.SyncRequest]] = []
         for i, r in enumerate(requests):
+            if r.scope is not None:
+                # A scoped respond reads stored rows + lanes: SQLite
+                # must be current for this owner first, and the serve
+                # runs under the drain lock against committed truth.
+                from evolu_tpu.server import scope as scope_mod
+
+                wb.flush_owner(r.user_id)
+                with wb.db_lock:
+                    out.append(protocol.encode_sync_response(
+                        scope_mod.scoped_response(self.store, r)))
+                continue
             tree, raw = self._resolve_tree_deferred(r.user_id, trees, strings)
             client_tree = merkle_tree_from_string(r.merkle_tree)
             if diff_merkle_trees(tree, client_tree) is None:
@@ -1414,6 +1434,15 @@ class BatchReconciler:
         out: List[Optional[bytes]] = []
         fallback: List[Tuple[int, protocol.SyncRequest]] = []
         for i, r in enumerate(requests):
+            if r.scope is not None:
+                # Scoped responds never ride the fused C stream —
+                # per-row lane filtering can't; object path + encode
+                # (server/scope.py), ingest already done by the batch.
+                from evolu_tpu.server import scope as scope_mod
+
+                out.append(protocol.encode_sync_response(
+                    scope_mod.scoped_response(self.store, r)))
+                continue
             tree, raw = self._resolve_tree(r.user_id, trees, tree_strings)
             # A generic store (no `.db` attribute at all) must degrade
             # to the object-respond fallback, not AttributeError.

@@ -73,8 +73,23 @@ def _author_nodes(timestamps: Sequence[str]) -> Optional[frozenset]:
     return frozenset(nodes)
 
 
+def _event_wakes(authors: Optional[frozenset], ev_tags: Optional[frozenset],
+                 node: str, tags: Optional[frozenset]) -> bool:
+    """Whether one ring event wakes one subscriber: a foreign-authored
+    row (own-write exclusion), AND — when BOTH the subscriber's scope
+    lanes and the event's lane tags are known — an overlapping lane.
+    Either side unknown → the lane gate passes (over-approximation
+    only, same stance as the author gate: a scoped subscriber may get a
+    spurious wake, never a missed one)."""
+    if authors is not None and not any(a != node for a in authors):
+        return False
+    if tags is not None and ev_tags is not None and not (tags & ev_tags):
+        return False
+    return True
+
+
 class _Channel:
-    """One owner's event sequence + bounded (seq, authors) ring."""
+    """One owner's event sequence + bounded (seq, authors, tags) ring."""
 
     __slots__ = ("seq", "ring")
 
@@ -86,8 +101,11 @@ class _Channel:
         """Oldest cursor the ring can still qualify exactly."""
         return self.ring[0][0] - 1 if self.ring else self.seq
 
-    def qualifies(self, cursor: int, node: str) -> Optional[bool]:
-        """Whether events past `cursor` include a foreign-authored row.
+    def qualifies(self, cursor: int, node: str,
+                  tags: Optional[frozenset] = None) -> Optional[bool]:
+        """Whether events past `cursor` include a row this subscriber
+        can see: foreign-authored AND in one of its scope lanes (when
+        both sides know their lanes — see `_event_wakes`).
         None = cursor predates the ring (can't know → caller wakes)."""
         if cursor > self.seq:
             # A cursor AHEAD of this channel was minted by another hub
@@ -101,10 +119,10 @@ class _Channel:
             return False
         if cursor < self.floor():
             return None
-        for seq, authors in self.ring:
+        for seq, authors, ev_tags in self.ring:
             if seq <= cursor:
                 continue
-            if authors is None or any(a != node for a in authors):
+            if _event_wakes(authors, ev_tags, node, tags):
                 return True
         return False
 
@@ -114,10 +132,11 @@ class _Waiter:
     token; the threaded tier parks its handler thread on the Event."""
 
     __slots__ = ("owner", "node", "cursor", "deadline", "event",
-                 "result", "token", "registered_at")
+                 "result", "token", "registered_at", "tags")
 
     def __init__(self, owner: str, node: str, cursor: int,
-                 deadline: float, token=None):
+                 deadline: float, token=None,
+                 tags: Optional[frozenset] = None):
         self.owner = owner
         self.node = node
         self.cursor = cursor
@@ -126,6 +145,7 @@ class _Waiter:
         self.event = threading.Event() if token is None else None
         self.result: Optional[bytes] = None
         self.registered_at = time.monotonic()
+        self.tags = tags  # scope lanes this subscriber can see; None = all
 
 
 def poll_body(wake: bool, cursor: int) -> bytes:
@@ -182,7 +202,8 @@ class PushHub:
         return max(0.0, min(t, MAX_POLL_TIMEOUT_S))
 
     def _admit(self, owner: str, node: str, cursor: int,
-               timeout: Optional[float], token=None):
+               timeout: Optional[float], token=None,
+               tags: Optional[frozenset] = None):
         """Shared admission: → ("now", body) for an immediately
         answerable poll, ("parked", waiter) otherwise. Caller holds no
         lock. Raises HubFull at the subscription bound."""
@@ -201,9 +222,9 @@ class PushHub:
                 # (once — the returned cursor parks the next one).
                 ch = self._channels[owner] = _Channel()
                 ch.seq = 1
-                ch.ring.append((1, None))
+                ch.ring.append((1, None, None))
             if ch is not None:
-                q = ch.qualifies(cursor, node)
+                q = ch.qualifies(cursor, node, tags)
                 if q is None:
                     # Cursor predates the bounded ring: can't prove the
                     # interim was self-only — wake conservatively.
@@ -218,7 +239,7 @@ class PushHub:
                 raise HubFull()
             w = _Waiter(owner, node, cursor,
                         time.monotonic() + self._clamp_timeout(timeout),
-                        token=token)
+                        token=token, tags=tags)
             if token is not None:
                 self._park_tiebreak += 1
                 heapq.heappush(self._park_heap,
@@ -230,10 +251,11 @@ class PushHub:
             return ("parked", w)
 
     def poll_blocking(self, owner: str, node: str, cursor: int,
-                      timeout: Optional[float] = None) -> bytes:
+                      timeout: Optional[float] = None,
+                      tags: Optional[frozenset] = None) -> bytes:
         """Threaded-tier long-poll: park THIS thread until wakeup or
         timeout. → response body bytes."""
-        kind, val = self._admit(owner, node, cursor, timeout)
+        kind, val = self._admit(owner, node, cursor, timeout, tags=tags)
         if kind == "now":
             return val
         w: _Waiter = val
@@ -247,11 +269,13 @@ class PushHub:
         return w.result
 
     def park(self, owner: str, node: str, cursor: int,
-             timeout: Optional[float], token):
+             timeout: Optional[float], token,
+             tags: Optional[frozenset] = None):
         """Event-tier long-poll: → ("now", body) or ("parked", waiter).
         A parked waiter resolves later via `on_wake(token, body)` —
         from notify, from `expire_due`, or from close()."""
-        return self._admit(owner, node, cursor, timeout, token=token)
+        return self._admit(owner, node, cursor, timeout, token=token,
+                           tags=tags)
 
     def cancel(self, token) -> None:
         """Drop a parked event-tier waiter whose connection died. O(1)
@@ -264,13 +288,18 @@ class PushHub:
     # -- wakeup sources --
 
     def notify(self, owner: str, timestamps: Optional[Sequence[str]] = None,
-               reason: str = "write") -> int:
+               reason: str = "write",
+               tags: Optional[frozenset] = None) -> int:
         """Rows for `owner` became newly visible. `timestamps` are the
         batch's plaintext timestamps (their node suffixes gate the
         own-write exclusion); None = authors unknown → wake everyone.
-        OVER-approximation is sound (a spurious wakeup costs one empty
-        sync round); UNDER-approximation is not — callers must notify
-        on every path that makes rows visible. → waiters woken."""
+        `tags` are the batch's scope-lane tags when the pushing client
+        assigned them; None = lanes unknown → every scoped waiter
+        qualifies. OVER-approximation is sound (a spurious wakeup costs
+        one empty sync round); UNDER-approximation is not — callers
+        must notify on every path that makes rows visible, and may pass
+        tags=None whenever lane attribution is uncertain. → waiters
+        woken."""
         authors = None if timestamps is None else _author_nodes(timestamps)
         woken: List[_Waiter] = []
         with self._lock:
@@ -278,12 +307,12 @@ class PushHub:
             if ch is None:
                 ch = self._channels[owner] = _Channel()
             ch.seq += 1
-            ch.ring.append((ch.seq, authors))
+            ch.ring.append((ch.seq, authors, tags))
             lst = self._waiters.get(owner)
             if lst:
                 keep = []
                 for w in lst:
-                    if authors is None or any(a != w.node for a in authors):
+                    if _event_wakes(authors, tags, w.node, w.tags):
                         w.result = poll_body(True, ch.seq)
                         woken.append(w)
                     else:
@@ -320,7 +349,7 @@ class PushHub:
                 del self._waiters[owner]
             for ch in self._channels.values():
                 ch.seq += 1
-                ch.ring.append((ch.seq, None))
+                ch.ring.append((ch.seq, None, None))
             for w in woken:
                 w.result = poll_body(True, self._channels[w.owner].seq)
             self._drop_tokens_locked(woken)
@@ -442,9 +471,15 @@ class HubFull(Exception):
     retry_after = 1.0
 
 
-def parse_poll_query(query: str) -> Tuple[str, str, int, Optional[float]]:
-    """Decode /push/poll query params → (owner, node, cursor, timeout).
-    Raises ValueError on malformed input (the relay answers 400 — the
+def parse_poll_query(
+    query: str,
+) -> Tuple[str, str, int, Optional[float], Optional[frozenset]]:
+    """Decode /push/poll query params → (owner, node, cursor, timeout,
+    tags). `tags` (optional, comma-separated opaque scope-lane tags —
+    sync/scope.py) scopes the subscription: the hub skips wakes whose
+    lane attribution provably misses every listed lane. None = wake on
+    everything (the unscoped subscription, unchanged). Raises
+    ValueError on malformed input (the relay answers 400 — the
     wire-decoder contract)."""
     from urllib.parse import parse_qs
 
@@ -469,4 +504,16 @@ def parse_poll_query(query: str) -> Tuple[str, str, int, Optional[float]]:
             raise ValueError("push poll timeout must be a number")
         if not timeout >= 0:  # also rejects NaN
             raise ValueError("push poll timeout must be >= 0")
-    return owner, node, cursor, timeout
+    tags: Optional[frozenset] = None
+    raw_tags = q.get("tags", [None])[0]
+    if raw_tags:
+        from evolu_tpu.sync.protocol import _MAX_SCOPE_TAGS, _MAX_SCOPE_TAG_LEN
+
+        parts = [t for t in raw_tags.split(",") if t]
+        if len(parts) > _MAX_SCOPE_TAGS:
+            raise ValueError(
+                f"push poll caps tags at {_MAX_SCOPE_TAGS}")
+        if any(len(t) > _MAX_SCOPE_TAG_LEN for t in parts):
+            raise ValueError("push poll tag too long")
+        tags = frozenset(parts) or None
+    return owner, node, cursor, timeout, tags

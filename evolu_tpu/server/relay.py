@@ -23,6 +23,7 @@ and hashes on device.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import threading
@@ -139,9 +140,19 @@ def serve_single_request(store, request: "protocol.SyncRequest") -> bytes:
     scope = {"entry": ledger.pending(), "classified": False}
     _SERVE_SCOPE.scope = scope
     try:
-        out = store.sync_wire(request) if hasattr(store, "sync_wire") else None
-        if out is None:
-            out = protocol.encode_sync_response(store.sync(request))
+        if getattr(request, "scope", None) is not None:
+            # Scoped serve (server/scope.py): ingest runs through the
+            # same add_messages path (the ledger seam above fires
+            # normally); only the RESPONSE is filtered. Never the fused
+            # C wire path — per-row lane filtering can't ride it.
+            from evolu_tpu.server import scope as scope_mod
+
+            out = scope_mod.serve_scoped(store, request)
+        else:
+            out = store.sync_wire(request) if hasattr(store, "sync_wire") \
+                else None
+            if out is None:
+                out = protocol.encode_sync_response(store.sync(request))
     except BaseException:
         scope["entry"].abort()
         raise
@@ -149,6 +160,19 @@ def serve_single_request(store, request: "protocol.SyncRequest") -> bytes:
         _SERVE_SCOPE.scope = None
     scope["entry"].commit()
     return out
+
+
+def _notify_tags(request: "protocol.SyncRequest"):
+    """Lane tags for a push wakeup: the scope clause's per-message lane
+    assignment, when the pushing client sent one. None (= wake every
+    waiter, the PR-13 over-approximation stance) whenever lanes are
+    unknown — v1 pushes, scoped pulls with no pushed rows, untagged
+    rows mixed in."""
+    s = getattr(request, "scope", None)
+    if s is None or not s.push_tags:
+        return None
+    tags = frozenset(s.push_tags)
+    return None if "" in tags else tags
 
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -705,6 +729,14 @@ class _Handler(BaseHTTPRequestHandler):
         POST handler and `/fleet/forward` (the recipes must never
         drift). → response bytes, or None after having answered 503
         backpressure itself."""
+        if request.scope is not None and \
+                protocol.CAP_SYNC_SCOPE not in (self.capabilities or ()):
+            # This relay doesn't serve scopes (capability off): strip
+            # the clause and answer the full serve — conservative
+            # over-approximation, never an error. A well-behaved client
+            # won't send one unnegotiated (emission gate); a hostile
+            # one gets exactly the unscoped behavior.
+            request = dataclasses.replace(request, scope=None)
         if self.scheduler is not None:
             from evolu_tpu.server.scheduler import SchedulerQueueFull
 
@@ -975,7 +1007,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         parts = urllib.parse.urlsplit(self.path)
         try:
-            owner, node, cursor, timeout = push_mod.parse_poll_query(
+            owner, node, cursor, timeout, tags = push_mod.parse_poll_query(
                 parts.query)
         except ValueError as e:
             metrics.inc("evolu_relay_errors_total")
@@ -1001,7 +1033,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 return
         try:
-            body = self.push_hub.poll_blocking(owner, node, cursor, timeout)
+            body = self.push_hub.poll_blocking(owner, node, cursor, timeout,
+                                               tags=tags)
         except push_mod.HubFull as e:
             self._respond_retry_after(e.retry_after)
             return
@@ -1085,7 +1118,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # hub's own-write exclusion gates on (server/push.py).
                 self.push_hub.notify(
                     request.user_id,
-                    [m.timestamp for m in request.messages])
+                    [m.timestamp for m in request.messages],
+                    tags=_notify_tags(request))
         except Exception as e:  # noqa: BLE001 - index.ts:231-233
             # The flight dump rides the exception (server-side only —
             # the wire response stays a bare 500, no event leakage).
@@ -1373,7 +1407,8 @@ class _Handler(BaseHTTPRequestHandler):
                     # the forwarding hop.
                     self.push_hub.notify(
                         request.user_id,
-                        [m.timestamp for m in request.messages])
+                        [m.timestamp for m in request.messages],
+                        tags=_notify_tags(request))
                 if self.replication is not None and request.messages:
                     self.replication.hint(origin=fspan.context)
                 out = self._negotiate_caps(request, out)

@@ -1,0 +1,363 @@
+"""Partial replication, relay side: scoped Merkle subtrees + lane
+tracking (ISSUE 18).
+
+A scoped SyncRequest (sync/protocol.py `ScopeClause`, negotiated via
+`sync-scope-v1`) is answered from a **scoped Merkle subtree**: a
+masked minute-fold over exactly the row set the filter matches. The
+FULL per-owner tree stays the single source of truth — ingest is
+completely unchanged, scoped trees are derived on demand and cached
+against the full tree's serialized text (any ingest changes that text,
+so a scoped cache entry can never serve stale state; no invalidation
+hooks anywhere).
+
+Membership rule for the scoped row set (the convergence contract —
+sync/scope.py module doc):
+
+    row in slice  iff  (timestamp >= watermark AND lane served)
+                       OR author(row) == requesting node
+
+where "lane served" means the row's lane tag is requested, is the
+conservative overflow lane, or is UNKNOWN (rows pushed by v1/full
+clients carry no tag) — over-approximation only: the relay may serve
+more than the slice, never less. Own-node rows are in the TREE
+regardless of filter (they XOR-cancel against the client's local
+copies; responses exclude them anyway), which keeps a scoped client
+whose own writes fall outside its scope from livelocking on a
+permanent tree diff.
+
+The fold runs on device for large canonical batches (the existing
+`ops.merkle_ops.merkle_minute_deltas` masked segmented fold — the
+watermark/lane mask IS the kernel's xor_mask) and through the shared
+host oracle `core.merkle.minute_deltas_host` otherwise — non-canonical
+shapes route to the host fold before anything else, per the r5
+contract (the fold is side-effect free either way).
+
+Lane state: a relay-local side table `scopeLane(userId, timestamp,
+tag)` written only when a scoped push assigns tags. Per-owner distinct
+lanes are capped (satellite: lane-cardinality hardening): past
+`MAX_OWNER_LANES`, new tags collapse into the `~overflow` lane —
+conservatively served to every scope — and `evolu_scope_overflow_total`
+counts the fold. A hostile client can therefore never mint unbounded
+per-scope state here.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from evolu_tpu.core.merkle import (
+    apply_prefix_xors,
+    diff_merkle_trees,
+    merkle_tree_from_string,
+    merkle_tree_to_string,
+    minute_deltas_host,
+)
+from evolu_tpu.core.timestamp import create_sync_timestamp, timestamp_to_string
+from evolu_tpu.obs import ledger, metrics
+from evolu_tpu.sync import protocol
+
+# The conservative overflow lane. Not a valid client tag shape (tags
+# from sync/scope.py are hex); a hostile client sending this literal
+# string only lands its rows in the always-served lane — sound.
+OVERFLOW_TAG = "~overflow"
+# Per-owner distinct-lane cap (the PR-10 label-bound pattern).
+MAX_OWNER_LANES = 64
+# Below this row count the host fold wins (device dispatch overhead);
+# module-level so tests can drive the device route with small batches.
+SCOPE_DEVICE_FOLD_MIN = 1024
+# Derived-tree cache entries (global). Each entry pins the owner's
+# full-tree text for exact-match validation.
+TREE_CACHE_CAP = 256
+
+_LANE_TABLE_SQL = (
+    'CREATE TABLE IF NOT EXISTS "scopeLane" ('
+    '"userId" TEXT, "timestamp" TEXT, "tag" TEXT, '
+    'PRIMARY KEY ("userId", "timestamp")) WITHOUT ROWID'
+)
+
+
+def _ensure_lane_table(db) -> None:
+    db.exec(_LANE_TABLE_SQL)
+
+
+def record_push_lanes(db, user_id: str, timestamps: Sequence[str],
+                      push_tags: Sequence[str],
+                      node_id: Optional[str] = None) -> None:
+    """Record this push's lane assignment (timestamp → tag), folding
+    tags beyond the per-owner lane cap into the overflow lane. No-op
+    without assignments. INSERT OR IGNORE: a redelivered row keeps its
+    first lane (lanes are advisory bandwidth hints, re-tagging is not a
+    correctness event).
+
+    `node_id` set = AUTHOR-ONLY: only rows the pushing node itself
+    authored get a lane. A resend relays foreign rows too, and tagging
+    those retroactively would (a) let one device censor another's rows
+    out of scoped views, and (b) open a livelock window — a row served
+    while its lane was unknown, then excluded from the scoped tree by a
+    later non-author tag, diverges the client's tree permanently. The
+    author's first push races nothing (the lane lands in the same
+    request that delivers the row)."""
+    pairs = [(t, tag) for t, tag in zip(timestamps, push_tags)
+             if tag and (node_id is None or t.endswith(node_id))]
+    if not pairs:
+        return
+    _ensure_lane_table(db)
+    rows = db.exec_sql_query(
+        'SELECT DISTINCT "tag" FROM "scopeLane" WHERE "userId" = ?',
+        (user_id,),
+    )
+    lanes: Set[str] = {r["tag"] for r in rows}
+    overflowed = 0
+    out = []
+    for ts, tag in pairs:
+        if tag not in lanes:
+            if len(lanes) >= MAX_OWNER_LANES:
+                overflowed += 1
+                tag = OVERFLOW_TAG
+                if tag not in lanes and len(lanes) < MAX_OWNER_LANES + 1:
+                    lanes.add(tag)
+            else:
+                lanes.add(tag)
+        out.append((user_id, ts, tag))
+    with db.transaction():
+        for uid, ts, tag in out:
+            db.run(
+                'INSERT OR IGNORE INTO "scopeLane" '
+                '("userId", "timestamp", "tag") VALUES (?, ?, ?)',
+                (uid, ts, tag),
+            )
+    if overflowed:
+        metrics.inc("evolu_scope_overflow_total", overflowed)
+    metrics.observe("evolu_scope_owner_lanes", len(lanes),
+                    buckets=metrics.COUNT_BUCKETS)
+
+
+def excluded_timestamps(db, user_id: str,
+                        tags: FrozenSet[str]) -> Set[str]:
+    """Timestamps whose lane is KNOWN and not requested — the only rows
+    a tag filter may withhold (unknown/overflow lanes serve
+    conservatively). Empty without a tag filter."""
+    if not tags:
+        return set()
+    _ensure_lane_table(db)
+    served = tuple(tags) + (OVERFLOW_TAG,)
+    ph = ",".join("?" * len(served))
+    rows = db.exec_sql_query(
+        f'SELECT "timestamp" FROM "scopeLane" '
+        f'WHERE "userId" = ? AND "tag" NOT IN ({ph})',
+        (user_id, *served),
+    )
+    return {r["timestamp"] for r in rows}
+
+
+def scoped_minute_deltas(timestamps: Sequence[str],
+                         xor_mask) -> Dict[str, int]:
+    """The masked minute-fold: per-minute XOR deltas over the rows the
+    mask keeps. Large canonical batches run the existing device
+    segmented fold (`ops.merkle_ops.merkle_minute_deltas` — the mask is
+    consumed ON DEVICE as the kernel's xor_mask); everything else —
+    small batches, non-canonical hex case, parse bounces, no usable
+    backend — takes the shared host oracle, which is the r5 contract's
+    required route for non-canonical shapes."""
+    n = len(timestamps)
+    if n >= SCOPE_DEVICE_FOLD_MIN:
+        try:
+            from evolu_tpu.ops.host_parse import parse_timestamp_strings
+
+            millis, counter, node, case_ok = parse_timestamp_strings(
+                timestamps, with_case=True
+            )
+            if bool(np.asarray(case_ok).all()):
+                from evolu_tpu.ops.merkle_ops import (
+                    merkle_minute_deltas,
+                    minute_deltas_to_dict,
+                )
+
+                mask = np.asarray(xor_mask, dtype=bool)
+                outs = merkle_minute_deltas(millis, counter, node, mask)
+                metrics.inc("evolu_scope_fold_total", route="device")
+                return minute_deltas_to_dict(*outs)
+        except Exception:  # noqa: BLE001 - the host oracle is always right
+            pass
+    metrics.inc("evolu_scope_fold_total", route="host")
+    deltas, _digest = minute_deltas_host(
+        t for t, keep in zip(timestamps, xor_mask) if keep
+    )
+    return deltas
+
+
+def _watermark_string(watermark_millis: int) -> str:
+    """The raw-string lower bound for a watermark: the sync timestamp
+    of that millis (counter 0000, node all-zeros) sorts at-or-before
+    every real timestamp of the same millis, and raw-string order is
+    the reference's timestamp order."""
+    if not watermark_millis:
+        return ""
+    return timestamp_to_string(create_sync_timestamp(watermark_millis))
+
+
+class _ScopedTreeCache:
+    """Derived scoped trees keyed by (owner, watermark, tags, node),
+    validated by EXACT match on the owner's current full-tree text —
+    coherent by construction (every ingest rewrites that text). LRU
+    past TREE_CACHE_CAP."""
+
+    def __init__(self, cap: int = TREE_CACHE_CAP):
+        self._lock = threading.Lock()
+        self._cap = cap
+        self._entries: "OrderedDict[tuple, Tuple[str, dict, str]]" = OrderedDict()
+
+    def get(self, key: tuple, full_raw: str) -> Optional[Tuple[dict, str]]:
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None and hit[0] == full_raw:
+                self._entries.move_to_end(key)
+                metrics.inc("evolu_scope_tree_cache_hits_total")
+                return hit[1], hit[2]
+        metrics.inc("evolu_scope_tree_cache_misses_total")
+        return None
+
+    def put(self, key: tuple, full_raw: str, tree: dict, raw: str) -> None:
+        with self._lock:
+            self._entries[key] = (full_raw, tree, raw)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+                metrics.inc("evolu_scope_tree_cache_evictions_total")
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+tree_cache = _ScopedTreeCache()
+
+
+def scoped_tree_for(shard, user_id: str, node_id: str,
+                    scope: "protocol.ScopeClause",
+                    full_raw: str) -> Tuple[dict, str]:
+    """The scoped Merkle subtree for one (owner, scope, node) — from
+    the cache when the full tree hasn't moved, else recomputed by the
+    masked minute-fold over the candidate rows. `shard` is a RelayStore
+    (or anything with `.db`)."""
+    tags = frozenset(scope.tags)
+    key = (user_id, scope.watermark_millis, tags, node_id)
+    hit = tree_cache.get(key, full_raw)
+    if hit is not None:
+        return hit
+    db = shard.db
+    wm = _watermark_string(scope.watermark_millis)
+    # Candidates: rows past the watermark, PLUS the requester's own
+    # rows regardless of watermark (tree membership rule, module doc).
+    # The LIKE arm matches author-node suffixes — the same screen the
+    # serve paths use (relay.get_messages).
+    rows = db.exec_sql_query(
+        'SELECT "timestamp" FROM "message" WHERE "userId" = ? AND '
+        '("timestamp" >= ? OR "timestamp" LIKE \'%\' || ?) '
+        'ORDER BY "timestamp"',
+        (user_id, wm, node_id),
+    )
+    candidates = [r["timestamp"] for r in rows]
+    excluded = excluded_timestamps(db, user_id, tags)
+    mask = [
+        ts.endswith(node_id)
+        or (ts >= wm and ts not in excluded)
+        for ts in candidates
+    ]
+    deltas = scoped_minute_deltas(candidates, mask)
+    tree = apply_prefix_xors({}, deltas)
+    raw = merkle_tree_to_string(tree)
+    tree_cache.put(key, full_raw, tree, raw)
+    return tree, raw
+
+
+def _shard_of(store, user_id: str):
+    return store.shard_of(user_id) if hasattr(store, "shard_of") else store
+
+
+def scoped_response(store, request: "protocol.SyncRequest"
+                    ) -> "protocol.SyncResponse":
+    """Answer one scoped request — RESPOND ONLY (the caller has already
+    ingested `request.messages` through its normal path; the full tree
+    and every flow-equation terminal are untouched by scoping). Records
+    the push's lane assignment, derives the scoped subtree, diffs it
+    against the client tree, and serves exactly the in-slice rows after
+    the diff minute, counting what the filter withheld (ledger tallies
+    `serve.scoped_rows` / `serve.scope_filtered` — egress
+    classification, not flow)."""
+    scope = request.scope
+    assert scope is not None
+    user_id, node_id = request.user_id, request.node_id
+    shard = _shard_of(store, user_id)
+    if scope.push_tags:
+        record_push_lanes(
+            shard.db, user_id,
+            [m.timestamp for m in request.messages], scope.push_tags,
+            node_id=node_id,
+        )
+    metrics.inc("evolu_scope_serves_total")
+    full_raw = shard.get_merkle_tree_string(user_id)
+    tree, raw = scoped_tree_for(shard, user_id, node_id, scope, full_raw)
+    client_tree = merkle_tree_from_string(request.merkle_tree)
+    diff = diff_merkle_trees(tree, client_tree)
+    if diff is None:
+        return protocol.SyncResponse((), raw)
+    since = timestamp_to_string(create_sync_timestamp(diff))
+    rows = shard.db.exec_sql_query(
+        'SELECT "timestamp", "content" FROM "message" '
+        'WHERE "userId" = ? AND "timestamp" > ? AND '
+        '"timestamp" NOT LIKE \'%\' || ? ORDER BY "timestamp"',
+        (user_id, since, node_id),
+    )
+    wm = _watermark_string(scope.watermark_millis)
+    excluded = excluded_timestamps(shard.db, user_id, frozenset(scope.tags))
+    kept: List[protocol.EncryptedCrdtMessage] = []
+    n_filtered = 0
+    for r in rows:
+        ts = r["timestamp"]
+        if ts >= wm and ts not in excluded:
+            kept.append(protocol.EncryptedCrdtMessage(ts, r["content"]))
+        else:
+            n_filtered += 1
+    ledger.count(ledger.SERVE_SCOPED, len(kept), owner=user_id)
+    ledger.count(ledger.SERVE_SCOPE_FILTERED, n_filtered, owner=user_id)
+    metrics.inc("evolu_scope_served_rows_total", len(kept))
+    metrics.inc("evolu_scope_filtered_rows_total", n_filtered)
+    return protocol.SyncResponse(tuple(kept), raw)
+
+
+def serve_scoped(store, request: "protocol.SyncRequest") -> bytes:
+    """The full scoped serve recipe for the per-request path
+    (relay.serve_single_request): normal ingest through
+    `store.add_messages` (the ledger store seam fires exactly as on the
+    unscoped path), then the scoped respond. The batched engine paths
+    call `scoped_response` directly — their ingest already ran."""
+    store.add_messages(request.user_id, request.messages)
+    return protocol.encode_sync_response(scoped_response(store, request))
+
+
+def scoped_snapshot_filter(db, owners: Optional[Sequence[str]],
+                           watermark_millis: int,
+                           tags: Sequence[str]):
+    """Record filter for a SCOPED snapshot capture (server/snapshot.py):
+    keep a (timestamp, userId) row iff it is in the slice — past the
+    watermark and not in an excluded lane. Returns a predicate; lane
+    exclusion sets are loaded once per owner, lazily."""
+    wm = _watermark_string(watermark_millis)
+    tag_set = frozenset(tags)
+    cache: Dict[str, Set[str]] = {}
+
+    def keep(user_id: str, ts: str) -> bool:
+        if ts < wm:
+            return False
+        if not tag_set:
+            return True
+        if user_id not in cache:
+            cache[user_id] = excluded_timestamps(db, user_id, tag_set)
+        return ts not in cache[user_id]
+
+    return keep

@@ -278,17 +278,68 @@ def _filter_stream(stream: bytes, owners) -> bytes:
     return b"".join(out)
 
 
+def _scope_stream(store, stream: bytes, watermark_millis: int,
+                  tags: Tuple[str, ...]) -> bytes:
+    """Re-frame a captured stream down to a SLICE (scoped bootstrap —
+    SnapshotRequest watermark/tags): keep the message rows the scope
+    filter matches (server/scope.py membership: past the watermark,
+    lane not provably excluded) and REGENERATE every shipped owner's
+    tree record from exactly the kept rows, so the installer's
+    golden-parity verify (recomputed-from-rows == shipped text) passes
+    unchanged. A scoped snapshot is a thin-client bootstrap — its
+    installed trees describe the slice, NOT the owner's full history —
+    and must never seed a full replica (docs/PARTIAL_SYNC.md)."""
+    from evolu_tpu.server import scope as scope_mod
+
+    wm = scope_mod._watermark_string(watermark_millis)
+    tag_set = frozenset(tags)
+    excluded_by_owner: Dict[str, set] = {}
+
+    def _excluded(uid: str) -> set:
+        if uid not in excluded_by_owner:
+            shard = (store.shard_of(uid) if hasattr(store, "shard_of")
+                     else _shards_of(store)[0])
+            excluded_by_owner[uid] = scope_mod.excluded_timestamps(
+                shard.db, uid, tag_set
+            )
+        return excluded_by_owner[uid]
+
+    out: List[bytes] = []
+    kept_ts: Dict[str, List[str]] = {}
+    pos, end = 0, len(stream)
+    while pos < end:
+        rec, nxt = _next_record(stream, pos)
+        if rec[0] == "M":
+            _kind, ts, uid, _content = rec
+            if ts >= wm and (not tag_set or ts not in _excluded(uid)):
+                out.append(stream[pos:nxt])
+                kept_ts.setdefault(uid, []).append(ts)
+        # "T" records are dropped: regenerated from the kept rows below.
+        pos = nxt
+    for uid in sorted(kept_ts):
+        deltas, _digest = minute_deltas_host(kept_ts[uid])
+        out.append(_frame_tree(
+            uid, merkle_tree_to_string(apply_prefix_xors({}, deltas))
+        ))
+    return b"".join(out)
+
+
 def capture_snapshot(
     store, chunk_bytes: int = SNAPSHOT_CHUNK_BYTES,
     snapshot_id: Optional[str] = None,
     owners=None,
+    watermark_millis: int = 0,
+    tags: Tuple[str, ...] = (),
 ) -> Tuple[protocol.SnapshotManifest, List[bytes]]:
     """→ (manifest, chunks). Consistency is per shard (one read
     transaction each) — the store's own consistency unit: an owner
     lives wholly inside one shard, so every owner's rows and tree are
     mutually consistent, which is exactly what install verification
     re-derives. `owners` (an iterable) scopes the snapshot to those
-    owners only (fleet rebalance); None = the whole store."""
+    owners only (fleet rebalance); None = the whole store.
+    `watermark_millis`/`tags` scope it to a SLICE (thin-client
+    bootstrap, `_scope_stream`) — trees ship recomputed over the
+    slice."""
     parts: List[bytes] = []
     for shard in _shards_of(store):
         db = shard.db
@@ -297,6 +348,9 @@ def capture_snapshot(
     stream = b"".join(parts)
     if owners is not None:
         stream = _filter_stream(stream, owners)
+    if watermark_millis or tags:
+        stream = _scope_stream(store, stream, watermark_millis, tuple(tags))
+        metrics.inc("evolu_snap_scoped_captures_total")
     chunks, message_count, tree_recs = _scan_stream(stream, chunk_bytes)
     # NB `owner_digests`, not `owners` — that name is the scoping
     # parameter above and must stay readable through the whole body.
@@ -339,7 +393,8 @@ class SnapshotCache:
         self._max_entries = int(max_entries)
         self._clock = clock
         self._lock = threading.Lock()
-        # id -> (expires_at, chunk_bytes, owners_key, manifest, chunks)
+        # id -> (expires_at, chunk_bytes, owners_key, scope_key,
+        #        manifest, chunks)
         self._entries: Dict[str, tuple] = {}
 
     def _clamp(self, requested: int) -> int:
@@ -347,20 +402,25 @@ class SnapshotCache:
         return max(SNAPSHOT_MIN_CHUNK_BYTES, min(int(cb), SNAPSHOT_MAX_CHUNK_BYTES))
 
     def manifest(self, requested_chunk_bytes: int = 0,
-                 owners=None) -> protocol.SnapshotManifest:
-        """`owners` scopes the capture (fleet rebalance — the entry is
-        keyed by the owner set, so scoped and full snapshots never
-        serve each other's chunks)."""
+                 owners=None, watermark_millis: int = 0,
+                 tags: Tuple[str, ...] = ()) -> protocol.SnapshotManifest:
+        """`owners` scopes the capture (fleet rebalance),
+        `watermark_millis`/`tags` scope it to a slice (thin-client
+        bootstrap) — entries are keyed by owner set AND scope, so
+        differently-scoped snapshots never serve each other's
+        chunks."""
         cb = self._clamp(requested_chunk_bytes)
         owners_key = None if owners is None else frozenset(owners)
+        scope_key = (int(watermark_millis), frozenset(tags))
         with self._lock:
             now = self._clock()
             self._entries = {
                 k: v for k, v in self._entries.items() if v[0] > now
             }
-            for _sid, (_exp, entry_cb, entry_ok, manifest,
+            for _sid, (_exp, entry_cb, entry_ok, entry_sk, manifest,
                        _chunks) in self._entries.items():
-                if entry_cb == cb and entry_ok == owners_key:
+                if entry_cb == cb and entry_ok == owners_key \
+                        and entry_sk == scope_key:
                     return manifest
         # Capture OUTSIDE the cache lock: chunk() must stay servable
         # while a full-store capture runs, or one peer's manifest miss
@@ -368,13 +428,17 @@ class SnapshotCache:
         # whole capture (long enough at scale to trip their snapshot
         # TTLs). Two racing first-misses may both capture — rare and
         # merely wasteful; both snapshots get registered and served.
-        manifest, chunks = capture_snapshot(self._store, cb, owners=owners)
+        manifest, chunks = capture_snapshot(
+            self._store, cb, owners=owners,
+            watermark_millis=watermark_millis, tags=tags,
+        )
         with self._lock:
             while len(self._entries) >= self._max_entries:
                 oldest = min(self._entries, key=lambda k: self._entries[k][0])
                 del self._entries[oldest]
             self._entries[manifest.snapshot_id] = (
-                self._clock() + self._ttl_s, cb, owners_key, manifest, chunks,
+                self._clock() + self._ttl_s, cb, owners_key, scope_key,
+                manifest, chunks,
             )
         return manifest
 
@@ -389,7 +453,7 @@ class SnapshotCache:
                 # a 400 on the chunk leg as "snapshot gone", drops its
                 # stale install state and restarts fresh.
                 raise ValueError(f"unknown or expired snapshot {snapshot_id!r}")
-            _exp, _cb, _ok, manifest, chunks = entry
+            _exp, _cb, _ok, _sk, manifest, chunks = entry
         if not 0 <= index < len(chunks):
             raise ValueError(
                 f"snapshot chunk index {index} out of range 0..{len(chunks) - 1}"
@@ -406,7 +470,8 @@ def serve_snapshot(store, body: bytes, manager) -> bytes:
     malformed input (wire-decoder contract → 400)."""
     req = protocol.decode_snapshot_request(body)
     manifest = manager.snapshot_cache.manifest(
-        req.chunk_bytes, owners=req.owners or None
+        req.chunk_bytes, owners=req.owners or None,
+        watermark_millis=req.watermark_millis, tags=req.tags,
     )
     metrics.inc("evolu_snap_manifests_served_total")
     return protocol.encode_snapshot_manifest(manifest)

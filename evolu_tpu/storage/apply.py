@@ -428,6 +428,64 @@ def _apply_messages_in_txn(db, merkle_tree, messages, planner, changes=None,
     return apply_prefix_xors(merkle_tree, deltas)
 
 
+def apply_messages_log_only(
+    db: PySqliteDatabase,
+    merkle_tree: dict,
+    messages: Sequence[CrdtMessage],
+    changes=None,
+) -> dict:
+    """Partial replication (ISSUE 18, sync/scope.py): land a batch in
+    the __message log and the Merkle tree WITHOUT materializing
+    app-table rows — the apply route for out-of-scope tables on a
+    scoped client. The log rows and tree deltas are byte-identical to
+    a full apply's (convergence and anti-entropy never see the
+    difference); only the upsert step is skipped, with every skipped
+    message tallied at `apply.deferred_mat` so the deferred frontier is
+    counted, never silent. A later `widen()` re-materializes these
+    rows from the log in LWW order (runtime/worker.py). Same pending-
+    entry/transaction discipline as `apply_messages` — a rolled-back
+    batch posts apply.rejected."""
+    if not len(messages):
+        return merkle_tree
+    from evolu_tpu.storage.changes import record_batch
+
+    entry = ledger.pending()
+    try:
+        with db.transaction():
+            entry.count(ledger.APPLY_INGRESS, len(messages))
+            entry.count(ledger.ROUTE_OBJECT, len(messages))
+            # Recorded even though nothing materializes: invalidation
+            # must stay conservative for queries that (wrongly) read a
+            # deferred table — they re-run and hit the typed deferral.
+            record_batch(changes, messages)
+            cells = {(m.table, m.row, m.column) for m in messages}
+            existing = fetch_existing_winners(db, cells)
+            xor_mask, upserts = plan_batch(messages, existing)
+            # Host fold only: deferred batches are out-of-scope tables
+            # — rare relative to the hot path, never worth a dispatch.
+            deltas, _ = minute_deltas_host(
+                m.timestamp for i, m in enumerate(messages) if xor_mask[i]
+            )
+            db.run_many(
+                _INSERT_MESSAGE,
+                [(m.timestamp, m.table, m.row, m.column, m.value)
+                 for m in messages],
+            )
+            n_xor = _mask_sum(xor_mask)
+            entry.count(ledger.APPLY_INSERTED, len(upserts))
+            entry.count(ledger.APPLY_LOSING, n_xor - len(upserts))
+            entry.count(ledger.APPLY_DUPLICATE, len(messages) - n_xor)
+            entry.count(ledger.APPLY_DEFERRED_MAT, len(messages))
+            tree = apply_prefix_xors(merkle_tree, deltas)
+        entry.commit()
+        return tree
+    except BaseException:
+        entry.abort()
+        ledger.count(ledger.APPLY_INGRESS, len(messages))
+        ledger.count(ledger.APPLY_REJECTED, len(messages))
+        raise
+
+
 class ChunkedApplyError(Exception):
     """A chunk failed after earlier chunks committed. `partial_tree`
     reflects every committed chunk and `applied` counts committed

@@ -335,6 +335,18 @@ class SyncTransport:
             and protocol.CAP_AEAD_BATCH in self.negotiated_capabilities.get(url, ())
         )
 
+    def _scope_negotiated(self, url: str, caps) -> bool:
+        """Scope-clause emission gate (the aead gate's twin): we
+        advertise sync-scope-v1 AND the last response from `url` echoed
+        it. A non-advertising relay never receives a scope clause — it
+        gets the full serve instead (over-approximation; the worker's
+        materialization filter still applies client-side)."""
+        return (
+            protocol.CAP_SYNC_SCOPE in caps
+            and protocol.CAP_SYNC_SCOPE
+            in self.negotiated_capabilities.get(url, ())
+        )
+
     def _drop_negotiated(self, url: str) -> None:
         """Invalidate the cached capability set alongside a route
         invalidation: the relay at `url` is gone/stale, and a failover
@@ -345,13 +357,16 @@ class SyncTransport:
             metrics.inc("evolu_crypto_capability_invalidations_total")
 
     def _encode_push(self, request: SyncRequestInput, node_id: str,
-                     caps, use_v2: bool) -> bytes:
+                     caps, use_v2: bool,
+                     scope_clause=None) -> bytes:
         """One request body. v1: the fused C wire path (byte-identical
         to the pre-v2 encoder — pinned), pure per-message OpenPGP
         behind it. v2 (negotiated only): ONE session key schedule +
         one GCM record per message (`encode_push_request_aead`), pure
         aead loop behind it. Capabilities append identically on every
-        path; absent caps = the v1 wire byte-for-byte."""
+        path; absent caps = the v1 wire byte-for-byte. `scope_clause`
+        (negotiated only — sync-scope-v1) appends as field 6 the same
+        way; None = byte-identical to the unscoped wire."""
         from evolu_tpu.sync import native_crypto
 
         body = None
@@ -389,6 +404,8 @@ class SyncTransport:
             # fused C and pure encode paths, absent (v1 wire,
             # byte-identical) when the config advertises nothing.
             body = body + protocol.encode_request_capabilities(caps)
+        if scope_clause is not None:
+            body = body + protocol.encode_request_scope(scope_clause)
         return body
 
     def _post_traced(self, url: str, body: bytes) -> bytes:
@@ -426,9 +443,22 @@ class SyncTransport:
         base = self.config.sync_url
         url = self._routes.get(owner_id, base)
         use_v2 = self._aead_negotiated(url, caps)
+        scope = getattr(self.config, "sync_scope", None)
+        clause = None
+        if scope is not None and not scope.is_noop \
+                and self._scope_negotiated(url, caps):
+            # The scope clause rides only a negotiated wire; the push
+            # lane assignment names each pushed message's table (even
+            # out-of-scope tables — the relay's lanes must stay
+            # truthful for OTHER scoped clients of this owner).
+            clause = scope.wire_clause(
+                request.owner.mnemonic,
+                push_tables=tuple(m.table for m in request.messages),
+            )
         try:
             node_id = timestamp_from_string(request.clock_timestamp).node
-            body = self._encode_push(request, node_id, caps, use_v2)
+            body = self._encode_push(request, node_id, caps, use_v2,
+                                     scope_clause=clause)
         except Exception as e:  # noqa: BLE001
             self.on_error(UnknownError(e))
             return None
@@ -451,17 +481,32 @@ class SyncTransport:
             NEVER receive v2 records it didn't advertise for (the
             regression this guards: 2-relay fleet failover to a v1
             replica)."""
-            nonlocal url, body, use_v2, downgraded
+            nonlocal url, body, use_v2, downgraded, clause
             url = new_url
-            if use_v2 and not self._aead_negotiated(new_url, caps):
+            need_v1 = use_v2 and not self._aead_negotiated(new_url, caps)
+            drop_scope = (clause is not None
+                          and not self._scope_negotiated(new_url, caps))
+            if not (need_v1 or drop_scope):
+                return
+            if need_v1:
                 use_v2 = False
                 downgraded = True
-                try:
-                    body = self._encode_push(request, node_id, caps, False)
-                except Exception as e:  # noqa: BLE001 - encode must never
-                    # kill the transport thread; surface and end the round
-                    self.on_error(UnknownError(e))
-                    raise _Abort() from e
+            if drop_scope:
+                # The PR-8 retarget lesson, applied to scope: a
+                # non-advertising failover target must NEVER receive a
+                # scope clause — re-emit unscoped (a full serve is the
+                # conservative answer; the worker still filters).
+                clause = None
+                metrics.inc("evolu_scope_downgrades_total",
+                            reason="failover")
+            try:
+                body = self._encode_push(request, node_id, caps, use_v2,
+                                         scope_clause=clause)
+            except Exception as e:  # noqa: BLE001 - encode must never
+                # kill the transport thread; surface and end the round
+                self.on_error(UnknownError(e))
+                raise _Abort() from e
+            if need_v1:
                 metrics.inc("evolu_crypto_v1_fallback_total", reason="failover")
                 log("sync:request", "aead downgrade for failover", url=new_url)
 
@@ -537,7 +582,21 @@ class SyncTransport:
             # the owner, the clock's node id (its own-write exclusion
             # key), and the relay that actually served — the placed
             # one, after any 307 follow.
-            self.push_subscriber.ensure(owner_id, node_id, url)
+            # A scoped client's subscription carries its lane tags so
+            # the hub can skip wakes its filter provably can't see —
+            # only when the round's relay negotiated the scope (the
+            # same emission gate as the clause itself).
+            sub_tags = None
+            if scope is not None and scope.tables \
+                    and self._scope_negotiated(url, caps):
+                from evolu_tpu.sync.scope import derive_scope_tag
+
+                sub_tags = tuple(
+                    derive_scope_tag(request.owner.mnemonic, t)
+                    for t in scope.tables
+                )
+            self.push_subscriber.ensure(owner_id, node_id, url,
+                                        tags=sub_tags)
         # Push-mix counters AFTER the POST landed: a round that ended
         # offline, errored, or was downgraded mid-flight must count as
         # what actually reached a relay, not what was first encoded
@@ -746,15 +805,20 @@ class PushSubscriber:
         self._node: Optional[str] = None
         self._base: Optional[str] = None  # bound by ensure()
         self._route: Optional[str] = None  # learned via 307
+        self._tags: Optional[Tuple[str, ...]] = None  # scope lanes
         self.cursor = 0
         self.wakes = 0  # total on_wake firings (tests/bench read it)
 
-    def ensure(self, owner_id: str, node: str, url: str) -> None:
+    def ensure(self, owner_id: str, node: str, url: str,
+               tags: Optional[Tuple[str, ...]] = None) -> None:
         """Bind (or re-bind) the subscription; starts the loop thread
-        on first call. Safe from any thread, idempotent."""
+        on first call. Safe from any thread, idempotent. `tags` scopes
+        the subscription to those lanes (sync/scope.py — None = wake on
+        every foreign write, unchanged)."""
         with self._lock:
             self._owner, self._node = owner_id, node
             self._base = url.rstrip("/")
+            self._tags = tuple(tags) if tags else None
             start = self._thread is None and not self._stop.is_set()
             if start:
                 self._thread = threading.Thread(
@@ -769,9 +833,10 @@ class PushSubscriber:
             # daemon thread that only touches the network.
             t.join(timeout=0.2)
 
-    def _target(self) -> Tuple[str, str, str]:
+    def _target(self) -> Tuple[str, str, str, Optional[Tuple[str, ...]]]:
         with self._lock:
-            return (self._route or self._base, self._owner, self._node)
+            return (self._route or self._base, self._owner, self._node,
+                    self._tags)
 
     def _loop(self) -> None:
         import json as _json
@@ -781,12 +846,14 @@ class PushSubscriber:
         attempt = 0
         follows = 0  # consecutive 307s without a successful poll
         while not self._stop.is_set():
-            base, owner, node = self._target()
+            base, owner, node, tags = self._target()
             url = (
                 f"{base}/push/poll?owner={urllib.parse.quote(owner)}"
                 f"&node={node}&cursor={self.cursor}"
                 f"&timeout={self._poll_timeout_s}"
             )
+            if tags:
+                url += "&tags=" + urllib.parse.quote(",".join(tags))
             try:
                 raw = self._http_get(url, self._poll_timeout_s + 10.0)
             except urllib.error.HTTPError as e:

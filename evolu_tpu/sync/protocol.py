@@ -280,8 +280,48 @@ CAP_CRDT_LIST = "crdt-list-v1"
 # records self-describe via a magic prefix — so negotiation only
 # controls what gets written, never what can be read.
 CAP_AEAD_BATCH = "aead-batch-v1"
-KNOWN_CAPABILITIES = (CAP_CRDT_TYPES, CAP_CRDT_LIST, CAP_AEAD_BATCH)
+# Partial replication (ISSUE 18, sync/scope.py + server/scope.py): a
+# NEGOTIATED scope clause on SyncRequest (field 6) asks the relay to
+# serve only the slice matching a timestamp watermark and/or a set of
+# opaque lane tags, answered from a derived scoped Merkle subtree.
+# Like aead-batch-v1 this capability GATES emission: a client only
+# attaches the clause to a relay whose LAST response echoed it back,
+# and failover to a non-advertising relay re-encodes without it
+# (sync/client.py retarget). Decoding is unconditional; a relay that
+# does not SERVE the capability ignores the clause (full serve — the
+# over-approximation-only stance: serving more is always sound).
+CAP_SYNC_SCOPE = "sync-scope-v1"
+KNOWN_CAPABILITIES = (CAP_CRDT_TYPES, CAP_CRDT_LIST, CAP_AEAD_BATCH,
+                      CAP_SYNC_SCOPE)
 _MAX_CAPABILITIES = 64  # decode bound: a hostile body must not mint unbounded strings
+# Scope-clause decode bounds (satellite: lane-cardinality hardening).
+# A hostile client must not mint unbounded per-scope state on the
+# relay: requested tags are hard-capped at decode time; PUSH tag
+# assignments are capped by the message count they annotate (validated
+# after the field walk). Server-side per-owner lane tracking has its
+# own cap with a conservative overflow lane (server/scope.py).
+_MAX_SCOPE_TAGS = 16
+_MAX_SCOPE_TAG_LEN = 128
+
+
+@dataclass(frozen=True)
+class ScopeClause:
+    """The wire form of a sync scope (SyncRequest field 6).
+
+    `watermark_millis`: HLC-millis lower bound — the relay serves only
+    rows at or after this minute frontier (timestamps are plaintext, so
+    this needs zero wire trust). 0 = no watermark.
+    `tags`: opaque lane tags (client-side HMACs of table/document names
+    under the owner key — sync/scope.py) whose lanes the client wants;
+    the relay partitions rows into lanes without learning what a tag
+    names, and rows in no known lane are served conservatively.
+    `push_tags`: lane assignment for THIS request's pushed messages,
+    parallel to `messages` ("" = untagged). Empty = no assignment.
+    """
+
+    watermark_millis: int = 0
+    tags: Tuple[str, ...] = ()
+    push_tags: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -291,6 +331,10 @@ class SyncRequest:
     node_id: str
     merkle_tree: str
     capabilities: Tuple[str, ...] = ()
+    # Optional partial-replication scope (sync-scope-v1). None on every
+    # v1 request — the encoder emits field 6 only when present, so
+    # capability-less traffic stays byte-identical.
+    scope: Optional["ScopeClause"] = None
 
 
 @dataclass(frozen=True)
@@ -319,10 +363,72 @@ def _decode_capability(v, caps: List[str]) -> None:
     caps.append(v.decode("utf-8"))
 
 
+def encode_scope_clause(s: "ScopeClause") -> bytes:
+    """The nested scope message: watermarkMillis=1 (varint), tags=2
+    (repeated string), pushTags=3 (repeated string)."""
+    out = b""
+    if s.watermark_millis:
+        out += _tag(1, 0) + _varint(s.watermark_millis)
+    out += b"".join(_string(2, t) for t in s.tags)
+    out += b"".join(_string(3, t) for t in s.push_tags)
+    return out
+
+
+def encode_request_scope(s: Optional["ScopeClause"]) -> bytes:
+    """SyncRequest field-6 bytes — appendable to an already-encoded
+    request body exactly like `encode_request_capabilities`, which is
+    how the fused C wire path gains the clause without touching the C
+    encoder. b"" when no scope: unscoped requests stay byte-identical."""
+    if s is None:
+        return b""
+    return _len_delimited(6, encode_scope_clause(s))
+
+
+def _decode_scope_tag(v, wt: int, tags: List[str], what: str) -> None:
+    if wt != 2:
+        raise ValueError(f"scope {what} field has wire type {wt}")
+    if len(tags) >= _MAX_SCOPE_TAGS:
+        raise ValueError(f"too many scope {what} entries")
+    if len(v) > _MAX_SCOPE_TAG_LEN:
+        raise ValueError(f"scope {what} too long ({len(v)} bytes)")
+    tags.append(v.decode("utf-8"))
+
+
+@_wire_decoder
+def decode_scope_clause(data: bytes) -> ScopeClause:
+    watermark = 0
+    tags: List[str] = []
+    push_tags: List[str] = []
+    pos = 0
+    while pos < len(data):
+        num, wt, v, pos = _read_field(data, pos)
+        if num == 1:
+            watermark = int(v)
+            # Varints are unsigned on the wire: a two's-complement
+            # negative int64 arrives as a value in [2^63, 2^64).
+            if watermark >= 1 << 63:
+                raise ValueError("scope watermark must be non-negative")
+        elif num == 2:
+            _decode_scope_tag(v, wt, tags, "tag")
+        elif num == 3:
+            # push_tags may legitimately exceed _MAX_SCOPE_TAGS entries
+            # (one per pushed message, "" for untagged) but each entry
+            # is still length-bounded; the entry-count bound is the
+            # message count, validated by decode_sync_request after the
+            # walk.
+            if wt != 2:
+                raise ValueError(f"scope push tag field has wire type {wt}")
+            if len(v) > _MAX_SCOPE_TAG_LEN:
+                raise ValueError(f"scope push tag too long ({len(v)} bytes)")
+            push_tags.append(v.decode("utf-8"))
+    return ScopeClause(watermark, tuple(tags), tuple(push_tags))
+
+
 def encode_sync_request(r: SyncRequest) -> bytes:
     out = b"".join(_len_delimited(1, encode_encrypted_message(m)) for m in r.messages)
     out += _string(2, r.user_id) + _string(3, r.node_id) + _string(4, r.merkle_tree)
-    return out + encode_request_capabilities(r.capabilities)
+    return out + encode_request_capabilities(r.capabilities) \
+        + encode_request_scope(r.scope)
 
 
 @_wire_decoder
@@ -330,6 +436,7 @@ def decode_sync_request(data: bytes) -> SyncRequest:
     messages: List[EncryptedCrdtMessage] = []
     user_id = node_id = merkle_tree = ""
     capabilities: List[str] = []
+    scope: Optional[ScopeClause] = None
     pos = 0
     while pos < len(data):
         num, wt, v, pos = _read_field(data, pos)
@@ -343,8 +450,18 @@ def decode_sync_request(data: bytes) -> SyncRequest:
             merkle_tree = v.decode("utf-8")
         elif num == 5:
             _decode_capability(v, capabilities)
+        elif num == 6:
+            if wt != 2:
+                raise ValueError(f"scope clause field has wire type {wt}")
+            scope = decode_scope_clause(v)
+    if scope is not None and scope.push_tags and \
+            len(scope.push_tags) != len(messages):
+        raise ValueError(
+            f"scope push tags ({len(scope.push_tags)}) do not match the "
+            f"message count ({len(messages)})"
+        )
     return SyncRequest(tuple(messages), user_id, node_id, merkle_tree,
-                       tuple(capabilities))
+                       tuple(capabilities), scope)
 
 
 def encode_sync_response(r: SyncResponse) -> bytes:
@@ -557,11 +674,23 @@ class SnapshotRequest:
     rebalance needs instead of a full-store ship. Empty = everything
     (the whole-store bootstrap, and what pre-fleet donors — whose
     decoders skip the unknown field — always serve; pullers keep a
-    client-side record filter for exactly that downgrade)."""
+    client-side record filter for exactly that downgrade).
+
+    `watermark_millis` (field 4) + `tags` (field 5, partial-replication
+    extension, ISSUE 18): a non-zero watermark / non-empty tag set
+    scopes the capture to the matching slice — rows at or after the
+    watermark minute whose lane is requested or unknown — and the
+    manifest trees are recomputed from the SHIPPED rows, so the
+    installer's byte-identity verify holds for the slice. A scoped
+    snapshot bootstraps a thin client, never a full replica
+    (docs/PARTIAL_SYNC.md). Pre-scope donors skip the unknown fields
+    and ship everything: serving more is always sound."""
 
     replica_id: str
     chunk_bytes: int = 0
     owners: Tuple[str, ...] = ()
+    watermark_millis: int = 0
+    tags: Tuple[str, ...] = ()
 
 
 @dataclass(frozen=True)
@@ -604,13 +733,18 @@ def encode_snapshot_request(r: SnapshotRequest) -> bytes:
         out += _tag(2, 0) + _varint(r.chunk_bytes)
     for uid in r.owners:
         out += _string(3, uid)
+    if r.watermark_millis:
+        out += _tag(4, 0) + _varint(r.watermark_millis)
+    for t in r.tags:
+        out += _string(5, t)
     return out
 
 
 @_wire_decoder
 def decode_snapshot_request(data: bytes) -> SnapshotRequest:
-    replica_id, chunk_bytes = "", 0
+    replica_id, chunk_bytes, watermark = "", 0, 0
     owners: List[str] = []
+    tags: List[str] = []
     pos = 0
     while pos < len(data):
         num, wt, v, pos = _read_field(data, pos)
@@ -622,7 +756,14 @@ def decode_snapshot_request(data: bytes) -> SnapshotRequest:
             if wt != 2:
                 raise ValueError(f"owners field has wire type {wt}")
             owners.append(v.decode("utf-8"))
-    return SnapshotRequest(replica_id, chunk_bytes, tuple(owners))
+        elif num == 4:
+            watermark = int(v)
+            if watermark < 0:
+                raise ValueError("snapshot watermark must be non-negative")
+        elif num == 5:
+            _decode_scope_tag(v, wt, tags, "tag")
+    return SnapshotRequest(replica_id, chunk_bytes, tuple(owners),
+                           watermark, tuple(tags))
 
 
 def encode_snapshot_manifest(m: SnapshotManifest) -> bytes:

@@ -1,0 +1,148 @@
+"""Partial replication: the client-side sync-scope model (ISSUE 18).
+
+A `SyncScope` declares the slice of an owner's log a thin client wants
+to converge on, along two E2EE-compatible axes the relay can evaluate
+blind:
+
+- **timestamp watermark** (`watermark_millis`): HLC-millis lower bound
+  — "recent history only". Timestamps are already plaintext on the
+  wire, so this leaks nothing new and needs zero wire trust.
+- **scope tags** (`tables` → HMAC lanes): the client names plaintext
+  tables/documents; on the wire each becomes an opaque HMAC of the
+  name under a key derived from the owner mnemonic, so the relay can
+  partition rows into lanes without learning what any lane names.
+
+Convergence stance (Merkle-CRDTs, arXiv:2004.00107): a scoped client
+converges byte-identically WITHIN its slice because the relay answers
+from a scoped Merkle subtree derived from the same filter; everything
+outside the filter is provably deferred, never silently dropped —
+rows the relay cannot attribute to a lane are served conservatively
+(over-approximation only, the PR-13 push-granularity stance), and the
+client records the remainder as a counted deferred frontier
+(runtime/worker.py).
+
+Escalation: `widen()` relaxes the scope (lower watermark and/or more
+tables); the next ordinary anti-entropy round catches up incrementally
+— no special protocol. NARROWING an established scope is unsupported:
+a client whose local tree already holds out-of-scope rows would
+permanently diverge from the scoped server subtree (the livelock guard
+would surface it as a SyncError). See docs/PARTIAL_SYNC.md.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from evolu_tpu.sync import protocol
+
+# Tag length on the wire: 16 hex chars (64 bits) — collision-safe for
+# per-owner table counts while staying far under the protocol's
+# per-tag byte bound.
+SCOPE_TAG_HEX_LEN = 16
+_SCOPE_KEY_INFO = b"evolu-scope-v1"
+
+
+def derive_scope_tag(mnemonic: str, name: str) -> str:
+    """The opaque lane tag for a table/document name: HMAC-SHA256 of
+    the name under a scope key derived from the owner mnemonic,
+    truncated to 16 hex chars. Deterministic per (owner, name) so every
+    device of an owner lands rows in the same lane; meaningless to the
+    relay (E2EE-blind lane partitioning)."""
+    scope_key = hmac.new(
+        mnemonic.encode("utf-8"), _SCOPE_KEY_INFO, hashlib.sha256
+    ).digest()
+    digest = hmac.new(scope_key, name.encode("utf-8"), hashlib.sha256)
+    return digest.hexdigest()[:SCOPE_TAG_HEX_LEN]
+
+
+@dataclass(frozen=True)
+class SyncScope:
+    """A client's declared slice. `watermark_millis` = 0 means no time
+    bound; empty `tables` means no table filter (every table in scope).
+    Both empty would be a no-op scope — treat as unscoped."""
+
+    watermark_millis: int = 0
+    tables: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if self.watermark_millis < 0:
+            raise ValueError("scope watermark must be non-negative")
+        if len(self.tables) > protocol._MAX_SCOPE_TAGS:
+            raise ValueError(
+                f"scope declares {len(self.tables)} tables; the wire caps "
+                f"requested lanes at {protocol._MAX_SCOPE_TAGS}"
+            )
+
+    @property
+    def is_noop(self) -> bool:
+        return not self.watermark_millis and not self.tables
+
+    def table_in_scope(self, table: str) -> bool:
+        """Client-side materialization filter: with no table filter
+        everything materializes; system tables (``__``-prefixed) are
+        always in scope — the log/clock substrate must stay whole."""
+        if not self.tables or table.startswith("__"):
+            return True
+        return table in self.tables
+
+    def widen(self, watermark_millis: Optional[int] = None,
+              tables: Tuple[str, ...] = ()) -> "SyncScope":
+        """Escalation: a strictly-wider scope (lower/equal watermark,
+        superset tables). Raises on any attempt to narrow — narrowing
+        an established scope breaks slice convergence (module doc)."""
+        new_wm = self.watermark_millis if watermark_millis is None \
+            else watermark_millis
+        if new_wm > self.watermark_millis:
+            raise ValueError("widen() cannot raise the watermark")
+        if self.tables:
+            new_tables = self.tables + tuple(
+                t for t in tables if t not in self.tables
+            )
+        else:
+            # No table filter = all tables already in scope; adding
+            # names would NARROW it.
+            if tables:
+                raise ValueError(
+                    "widen() cannot add a table filter to an unfiltered scope"
+                )
+            new_tables = ()
+        return SyncScope(new_wm, new_tables)
+
+    def wire_clause(self, mnemonic: str,
+                    push_tables: Tuple[str, ...] = ()
+                    ) -> Optional[protocol.ScopeClause]:
+        """The capability-gated wire form: requested lane tags derived
+        from `tables`, plus a lane assignment for this round's pushed
+        messages (`push_tables`, one plaintext table name per pushed
+        message — tagged even when the table is outside this scope, so
+        the relay's lanes stay truthful for OTHER scoped clients).
+        None for a no-op scope (unscoped wire, byte-identical)."""
+        if self.is_noop:
+            return None
+        tags = tuple(derive_scope_tag(mnemonic, t) for t in self.tables)
+        push_tags: Tuple[str, ...] = ()
+        if push_tables and tags:
+            push_tags = tuple(
+                derive_scope_tag(mnemonic, t) for t in push_tables
+            )
+        return protocol.ScopeClause(self.watermark_millis, tags, push_tags)
+
+
+class ScopeDeferred(Exception):
+    """Typed "this answer would lie" marker: a Query touched a table
+    whose rows are (partly) outside the local scope — the store holds a
+    counted deferred frontier for it, so honest behavior is to surface
+    the deferral, never to answer silently-empty rows. Carries what the
+    caller needs to decide between widening the scope (escalation) and
+    rendering a placeholder."""
+
+    def __init__(self, tables: Tuple[str, ...], deferred_rows: int):
+        super().__init__(
+            f"query touches out-of-scope table(s) {', '.join(tables)}: "
+            f"{deferred_rows} row(s) deferred by the sync scope"
+        )
+        self.tables = tables
+        self.deferred_rows = deferred_rows

@@ -68,8 +68,21 @@ class Config:
     # framework DECODES v2 records unconditionally; drop the capability
     # here for owners shared with reference OpenPGP.js peers, which
     # cannot (the same interop dial as wire_extensions).
+    # `sync-scope-v1` (ISSUE 18, sync/scope.py) likewise GATES
+    # emission: a scope clause (Config.sync_scope) rides the wire only
+    # after the relay echoes it — an unscoped or unnegotiated round
+    # stays byte-identical to v1.
     sync_capabilities: Tuple[str, ...] = (
-        "crdt-types-v1", "crdt-list-v1", "aead-batch-v1")
+        "crdt-types-v1", "crdt-list-v1", "aead-batch-v1", "sync-scope-v1")
+    # Partial replication (ISSUE 18, sync/scope.py::SyncScope): the
+    # slice of the owner's log this client converges on — an HLC-millis
+    # watermark ("recent history only") and/or a table filter (opaque
+    # HMAC lanes on the wire). None = full replica (everything
+    # unchanged). Out-of-scope rows land in the log but skip
+    # materialization; queries touching them raise ScopeDeferred
+    # (honest partial answers, runtime/worker.py); widen the scope to
+    # escalate. Narrowing an established scope is unsupported.
+    sync_scope: "object | None" = None
     # -- relay fleet knobs (no reference equivalent). These are LIVE
     # defaults: `RelayServer` / `ReplicationManager` resolve any
     # constructor arg left at None from the process `default_config`

@@ -1572,3 +1572,227 @@ def test_push_subscription_partition_heal_episode():
             for s in servers:
                 s.stop()
             th.join(timeout=5)
+
+
+def test_scoped_partial_replication_episode():
+    """ISSUE 18 satellite: one seeded adversarial episode through the
+    partial-replication plane — a FULL and a SCOPED device of one
+    owner, homed at DIFFERENT relays that gossip via anti-entropy
+    replication, under regressing/stuttering HLC clocks, a relay-level
+    partition and heal, a NON-CANONICAL batch bouncing to the host
+    oracle mid-stream, and a mid-stream scope escalation. Invariants:
+    the two devices' __message logs converge byte-identically (the
+    scoped device defers MATERIALIZATION, never history); the scoped
+    device's in-scope table is byte-identical to the full device's;
+    the out-of-scope table stays empty with a COUNTER-EXACT deferred
+    frontier; after widening, the scoped device is byte-identical
+    everywhere, including rows written after the escalation; and the
+    conservation ledger balances at episode end (_evidence audits).
+
+    The reference's livelock guard (repeated identical merkle diff) CAN
+    fire transiently here — frozen adversarial clocks cluster rows into
+    one minute while relay gossip keeps landing foreign rows into that
+    same minute between a device's rounds — so transient SyncError is
+    tolerated (each next sync starts a fresh chain), matching the other
+    replicating-relay episodes above; any OTHER surfaced error fails
+    the episode."""
+    with _evidence("model-check-scope", 20260807):
+        _run_scoped_partial_replication_episode()
+
+
+def _run_scoped_partial_replication_episode():
+    from evolu_tpu.core.merkle import apply_prefix_xors, minute_deltas_host
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.core.types import CrdtMessage, SyncError
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.runtime import messages as wmsg
+    from evolu_tpu.server.replicate import ReplicationManager
+    from evolu_tpu.sync.scope import ScopeDeferred, SyncScope  # noqa: F401
+    from tests.test_replication import _FaultyTransport, _state
+
+    seed = 20260807
+    rng = random.Random(seed)
+    base = int(time.time() * 1000)
+
+    def adversarial_now(sub_seed):
+        """Same hostile envelope as the fleet episode above: 40%
+        frozen, 20% bounded regression, else small advances."""
+        r = random.Random(sub_seed)
+        state = {"t": base}
+
+        def now():
+            roll = r.random()
+            if roll < 0.4:
+                pass  # stutter
+            elif roll < 0.6:
+                state["t"] = max(base - 20_000,
+                                 state["t"] - r.randrange(0, 10_000))
+            else:
+                state["t"] += r.randrange(1, 400)
+            return state["t"]
+
+        return now
+
+    stores = [RelayStore(), RelayStore()]
+    faults = [_FaultyTransport(), _FaultyTransport()]
+    mgrs = [
+        ReplicationManager(
+            s, [], replica_id=f"scope-{i}", interval_s=0.1,
+            debounce_s=0.02, backoff_base_s=0.05, backoff_max_s=0.3,
+            http_post=f.post,
+        )
+        for i, (s, f) in enumerate(zip(stores, faults))
+    ]
+    servers = [RelayServer(s, replication=m).start()
+               for s, m in zip(stores, mgrs)]
+    a, b = servers
+    replicas = []
+    try:
+        mgrs[0].add_peer(b.url)
+        mgrs[1].add_peer(a.url)
+        full = create_evolu(SCHEMA, config=Config(sync_url=a.url,
+                                                  backend="tpu"))
+        thin = create_evolu(
+            SCHEMA, mnemonic=full.owner.mnemonic,
+            config=Config(sync_url=b.url, backend="cpu",
+                          sync_scope=SyncScope(tables=("todo",))))
+        replicas = [full, thin]
+        errors = []
+        for i, r in enumerate(replicas):
+            r.worker.now = adversarial_now(seed + i)
+            connect(r)
+            r.subscribe_error(errors.append)
+
+        def step(r, allow_category):
+            tables = ["todo", "todo", "todoCategory"] if allow_category \
+                else ["todo"]
+            t = rng.choice(tables)
+            if t == "todo":
+                r.create("todo", {"title": f"t{rng.randrange(10**6)}",
+                                  "isCompleted": False})
+            else:
+                r.create("todoCategory",
+                         {"name": f"c{rng.randrange(10**6)}"})
+            r.worker.flush()
+            if rng.random() < 0.4:
+                r.sync()
+                r.worker.flush()
+
+        # Phase 1 — connected: mixed writes. The full device writes
+        # both tables; the scoped device writes only its slice.
+        for _ in range(14):
+            step(full, True)
+            step(thin, False)
+
+        # Mid-stream NON-CANONICAL batch (uppercase node hex) injected
+        # at the full device for the IN-SCOPE table: the apply must
+        # route to the host oracle (r5 contract) on every replica it
+        # reaches via anti-entropy.
+        bounces0 = metrics.get_counter("evolu_merge_host_fallbacks_total")
+        full._transport.flush()
+        full.worker.flush()
+        nc = tuple(
+            CrdtMessage(
+                (lambda s: s[:30] + s[30:].upper())(timestamp_to_string(
+                    Timestamp(base + 1000 + i, 0, "00000000000000ab"))),
+                "todo", f"ncrow{i}", "title", f"nc{i}")
+            for i in range(3)
+        )
+        from evolu_tpu.storage.clock import read_clock
+        local = read_clock(full.db).merkle_tree
+        deltas, _ = minute_deltas_host(m.timestamp for m in nc)
+        full.receive(nc, merkle_tree_to_string(
+            apply_prefix_xors(dict(local), deltas)))
+        full.worker.flush()
+        assert metrics.get_counter(
+            "evolu_merge_host_fallbacks_total") > bounces0
+
+        # Phase 2 — partition the relay gossip both directions; the
+        # devices keep writing against their OWN relay.
+        faults[0].block(b.url)
+        faults[1].block(a.url)
+        for _ in range(8):
+            step(full, True)
+            step(thin, False)
+
+        # Phase 3 — heal, then converge: relay gossip AND both
+        # devices' sync rounds, until the two LOGS are byte-identical.
+        faults[0].heal()
+        faults[1].heal()
+        mgrs[0].hint()
+        mgrs[1].hint()
+
+        def log(r):
+            return r.db.exec(
+                'SELECT * FROM "__message" ORDER BY "timestamp"')
+
+        deadline = time.time() + 60
+        while True:
+            for r in replicas:
+                r.sync()
+                r.worker.flush()
+            if log(full) == log(thin) and \
+                    _state(stores[0]) == _state(stores[1]):
+                break
+            assert time.time() < deadline, \
+                "logs/relays did not converge across the scope boundary"
+            time.sleep(0.05)
+        for r in replicas:
+            r._transport.flush()
+            r.worker.flush()
+        assert not [e for e in errors if not isinstance(e, SyncError)], \
+            "non-livelock error surfaced"
+
+        # Within-slice byte-identity: the scoped device's in-scope
+        # table equals the full device's, non-canonical rows included.
+        todo_full = full.db.exec('SELECT * FROM "todo" ORDER BY "id"')
+        todo_thin = thin.db.exec('SELECT * FROM "todo" ORDER BY "id"')
+        assert todo_full == todo_thin
+        assert any(r[0].startswith("ncrow")
+                   for r in thin.db.exec('SELECT "id" FROM "todo"'))
+        # Out-of-scope: zero materialized rows, counter-EXACT frontier
+        # (the thin device authored no todoCategory rows, so every one
+        # in its log was deferred — and redeliveries must not inflate).
+        assert thin.db.exec('SELECT * FROM "todoCategory"') == []
+        n_cat = thin.db.exec_sql_query(
+            'SELECT COUNT(*) AS n FROM "__message" WHERE "table" = ?',
+            ("todoCategory",))[0]["n"]
+        assert n_cat > 0, "episode never exercised the deferred leg"
+        frontier = thin.db.exec_sql_query(
+            'SELECT "rows" FROM "__scope_deferred" WHERE "table" = ?',
+            ("todoCategory",))
+        assert frontier and frontier[0]["rows"] == n_cat
+
+        # Mid-stream escalation: widen to full, then keep writing.
+        thin.worker.post(wmsg.WidenSyncScope(full=True))
+        thin.worker.flush()
+        assert thin.db.exec_sql_query(
+            'SELECT * FROM "__scope_deferred"') == []
+        for _ in range(4):
+            step(full, True)
+        deadline = time.time() + 60
+        while True:
+            for r in replicas:
+                r.sync()
+                r.worker.flush()
+            if log(full) == log(thin):
+                break
+            assert time.time() < deadline, \
+                "post-escalation convergence failed"
+            time.sleep(0.05)
+        for r in replicas:
+            r._transport.flush()
+            r.worker.flush()
+        # Byte-identical EVERYWHERE now — the re-materialized table
+        # equals the always-materialized one, new writes included.
+        assert full.db.exec('SELECT * FROM "todoCategory" ORDER BY "id"') \
+            == thin.db.exec('SELECT * FROM "todoCategory" ORDER BY "id"')
+        assert full.db.exec('SELECT * FROM "todo" ORDER BY "id"') \
+            == thin.db.exec('SELECT * FROM "todo" ORDER BY "id"')
+        assert not [e for e in errors if not isinstance(e, SyncError)], \
+            "non-livelock error surfaced"
+    finally:
+        for r in replicas:
+            r.dispose()
+        for s in servers:
+            s.stop()

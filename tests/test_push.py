@@ -122,9 +122,11 @@ def test_hub_capacity_and_close():
 
 
 def test_parse_poll_query_contract():
-    assert parse_poll_query(f"owner=o&node={SUB}&cursor=3") == ("o", SUB, 3, None)
+    # 5th element (ISSUE 18): optional scope-lane tags, None = unscoped.
     assert parse_poll_query(
-        f"owner=o&node={SUB}&cursor=0&timeout=2.5") == ("o", SUB, 0, 2.5)
+        f"owner=o&node={SUB}&cursor=3") == ("o", SUB, 3, None, None)
+    assert parse_poll_query(
+        f"owner=o&node={SUB}&cursor=0&timeout=2.5") == ("o", SUB, 0, 2.5, None)
     for bad in ("", "owner=o", f"owner=o&node=XYZ&cursor=0",
                 f"owner=o&node={SUB}&cursor=x",
                 f"owner=o&node={SUB}&cursor=0&timeout=nan",
