@@ -1,0 +1,241 @@
+"""Parallel owner-sharded drain (PR-19) vs the single drain worker.
+
+The PR-11 write-behind queue moved the SQLite btree off the latency
+path but drained it with ONE thread under ONE lock — the host-apply
+wall stayed serial no matter how many storage shards the store had.
+PR-19 gives every shard its own drain worker, lock, and watermark;
+this bench measures what that buys on the DRAIN leg, with process-
+level walls (the only honest wall on a shared host: each leg is a
+fresh file-backed store + queue, timed from lock release to the
+composed drain barrier).
+
+Method (CLAUDE.md timing discipline): per (mode, workers) leg, park
+every drain worker by holding the composed `db_lock`, serve the whole
+seeded stream (the backlog accumulates in the shard deques), then
+release and time `flush()` — the drain wall for that backlog. The
+reported slope is Δrows/Δwall between a small and a large backlog, so
+store open, replay, and child spawn cancel out. `ratio` =
+slope(2 workers)/slope(1 worker) per mode.
+
+Modes:
+- `thread`: workers apply in-process (the native path's shape — there
+  the C inserts drop the GIL; on the pure-Python backend used here
+  sqlite3 still releases the GIL around its C calls).
+- `process`: workers feed per-shard child processes over pipes (the
+  pure-Python escape hatch from the GIL); the leg asserts the queue
+  actually resolved `drain_mode == "process"`.
+
+Gates (hard-fail, run in --smoke too):
+- byte-identity: EVERY leg's drained state crc equals the ground-truth
+  oracle (direct `add_messages`, no engine, no queue — an independent
+  computation, so a serving leg that drops rows cannot go unnoticed).
+- audit: the episode-end conservation audit is clean — every queued
+  row reached exactly one ledger terminal across all legs.
+
+HONESTY (docs/BENCHMARKS.md): parallel drain needs parallel hardware.
+The `ratio >= 1.8` scaling assertion only fires when `os.cpu_count()`
+>= 2 and not --smoke; on a 1-core container the measured ~1x flat
+line is reported as-is — the point of PR-19 is that the drain LIMIT
+moves from "one thread" to "core count". Correctness gates always
+run. Prints ONE JSON line; numbers live in docs/BENCHMARKS.md.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import zlib
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+for _v in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"):
+    os.environ.pop(_v, None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from evolu_tpu.core.merkle import merkle_tree_to_string
+from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+from evolu_tpu.obs import ledger
+from evolu_tpu.server.engine import BatchReconciler
+from evolu_tpu.server.relay import RelayStore, ShardedRelayStore
+from evolu_tpu.storage.write_behind import WriteBehindQueue
+from evolu_tpu.sync import protocol
+
+BASE = 1_700_000_000_000
+OWNERS = 8
+SHARDS = 2
+
+
+def _stream(n_batches: int, rows_per_owner: int, payload: bytes):
+    """Seeded batches of distinct-owner in-sync FRESH pushes (the
+    steady-state hot shape). All-fresh matters beyond realism: a
+    duplicate-redelivery shape bounces the response to the exact path,
+    whose serve-side `flush_owner` would deadlock against this bench's
+    parked drain (dup correction is pinned by tests/test_write_behind
+    and the SIGKILL torture instead). Client trees come from a
+    deterministic tree oracle — a reference computation, quarantined
+    from the conservation ledger."""
+    with ledger.quarantine():
+        oracle = RelayStore()
+        batches = []
+        for b in range(n_batches):
+            reqs = []
+            for o in range(OWNERS):
+                owner = f"owner{o:02d}"
+                node = f"{o + 1:016x}"
+                msgs = [
+                    protocol.EncryptedCrdtMessage(
+                        timestamp_to_string(Timestamp(
+                            BASE + (b * rows_per_owner + i) * 1000, 0, node
+                        )),
+                        payload,
+                    )
+                    for i in range(rows_per_owner)
+                ]
+                tree = oracle.add_messages(owner, msgs)
+                reqs.append(protocol.SyncRequest(
+                    tuple(msgs), owner, node, merkle_tree_to_string(tree)
+                ))
+            batches.append(reqs)
+        oracle.close()
+    return batches
+
+
+def _state_crc(store) -> int:
+    crc = 0
+    for s in (getattr(store, "shards", None) or [store]):
+        for u in sorted(s.user_ids()):
+            crc = zlib.crc32(s.get_merkle_tree_string(u).encode(), crc)
+            for m in s.replica_messages(u, ""):
+                crc = zlib.crc32(m.timestamp.encode(), crc)
+                crc = zlib.crc32(m.content, crc)
+    return crc
+
+
+def _ground_truth_crc(batches) -> int:
+    """Direct add_messages — no engine, no queue: the independent
+    oracle every drained leg must match byte-for-byte."""
+    with ledger.quarantine():
+        store = ShardedRelayStore(shards=SHARDS)
+        for reqs in batches:
+            for r in reqs:
+                store.add_messages(r.user_id, r.messages)
+        crc = _state_crc(store)
+        store.close()
+    return crc
+
+
+def _drain_leg(tmp, tag, warmup, batches, workers, process):
+    """Drain `warmup` end-to-end first (spawns the per-shard children
+    in process mode, warms the btree files), then serve `batches` with
+    every drain worker parked behind db_lock, and time the released
+    flush. → (drain_wall_s, rows, crc, mode)."""
+    path = os.path.join(tmp, f"{tag}.db")
+    store = ShardedRelayStore(path, backend="python", shards=SHARDS)
+    wb = WriteBehindQueue(store, log_path=path + ".wblog",
+                          drain_workers=workers, drain_process=process)
+    eng = BatchReconciler(store, write_behind=wb)
+
+    def serve(reqs):
+        # The bench IS the delivery boundary (no HTTP front): ingress
+        # posts here, where relay.py posts it at decode.
+        for r in reqs:
+            ledger.count(ledger.INGRESS_SYNC, len(r.messages),
+                         owner=r.user_id)
+        eng.run_batch_wire(reqs)
+        return sum(len(r.messages) for r in reqs)
+
+    serve(warmup)
+    wb.flush()
+    rows = 0
+    wb.db_lock.acquire()
+    try:
+        for reqs in batches:
+            rows += serve(reqs)
+    finally:
+        wb.db_lock.release()
+    t0 = time.perf_counter()
+    wb.flush()
+    wall = time.perf_counter() - t0
+    mode = wb.drain_mode
+    crc = _state_crc(store)
+    wb.close()
+    eng.close()
+    store.close()
+    return wall, rows, crc, mode
+
+
+def main() -> None:
+    smoke = "--smoke" in sys.argv
+    rows_per_owner = 16 if smoke else 96
+    lo, hi = (2, 5) if smoke else (4, 16)
+    cpus = os.cpu_count() or 1
+    assert_scaling = (not smoke) and cpus >= 2
+
+    batches = _stream(hi + 1, rows_per_owner, b"x" * 64)
+    # Batch 0 is the (drained, untimed) warmup; a count-n leg ends
+    # with batches[:1+n] applied.
+    want_crc = {n: _ground_truth_crc(batches[:1 + n]) for n in (lo, hi)}
+    # Both shards must actually carry load or the ratio is vacuous.
+    covered = {zlib.crc32(f"owner{o:02d}".encode()) % SHARDS
+               for o in range(OWNERS)}
+    assert covered == set(range(SHARDS)), covered
+
+    legs = {}
+    with tempfile.TemporaryDirectory() as tmp:
+        for mode_name, process in (("thread", False), ("process", True)):
+            for workers in (1, 2):
+                walls = {}
+                for count in (lo, hi):
+                    tag = f"{mode_name}-w{workers}-n{count}"
+                    wall, rows, crc, got_mode = _drain_leg(
+                        tmp, tag, batches[0], batches[1:1 + count],
+                        workers, process)
+                    assert crc == want_crc[count], (
+                        f"{tag}: drained state != ground-truth oracle "
+                        f"({crc:08x} != {want_crc[count]:08x})"
+                    )
+                    assert got_mode == mode_name, (tag, got_mode)
+                    walls[count] = (wall, rows)
+                d_wall = walls[hi][0] - walls[lo][0]
+                d_rows = walls[hi][1] - walls[lo][1]
+                legs[f"{mode_name}_w{workers}"] = {
+                    "drain_rows_per_s": round(d_rows / max(d_wall, 1e-9)),
+                    "wall_lo_s": round(walls[lo][0], 4),
+                    "wall_hi_s": round(walls[hi][0], 4),
+                }
+
+    ratios = {
+        m: round(legs[f"{m}_w2"]["drain_rows_per_s"]
+                 / max(legs[f"{m}_w1"]["drain_rows_per_s"], 1), 2)
+        for m in ("thread", "process")
+    }
+    if assert_scaling:
+        best = max(ratios.values())
+        assert best >= 1.8, (
+            f"2-worker drain only {best:.2f}x the single worker on "
+            f"{cpus} cores (ratios={ratios})"
+        )
+
+    violations = ledger.audit(at_barrier=True)
+    assert not violations, violations
+
+    print(json.dumps({
+        "bench": "shard_drain",
+        "smoke": smoke,
+        "platform": "cpu",
+        "shards": SHARDS,
+        "owners": OWNERS,
+        "rows_hi": hi * OWNERS * rows_per_owner,
+        "legs": legs,
+        "ratio_thread": ratios["thread"],
+        "ratio_process": ratios["process"],
+        "state_crc": f"{want_crc[hi]:08x}",
+        "byte_identical": True,
+        "audit_clean": True,
+        "note": {"cpus": cpus, "scaling_asserted": assert_scaling},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -197,8 +197,11 @@ STAGES: Tuple[Stage, ...] = (
     ),
     Stage(
         "host_apply", "runtime",
-        "engine.finish_batch: per-shard C inserts + delta decode + "
-        "Merkle tree folds + one atomic commit per shard",
+        "the btree+tree materialization leg: per-shard C inserts + "
+        "delta decode + Merkle tree folds + one atomic commit per "
+        "shard — engine.finish_batch synchronously, or the per-shard "
+        "write-behind drain workers in deferred mode (each worker "
+        "records its shard's batches with a shard= label)",
         inputs=("staged_batch",),
         outputs=("responses",),
         price=(("host_apply_rows_per_s", "rows_per_s"),),
@@ -297,11 +300,17 @@ class _StageAccountant:
         return st
 
     def record(self, stage: str, seconds: float, rows: int = 0,
-               nbytes: int = 0) -> None:
+               nbytes: int = 0, shard: Optional[int] = None) -> None:
         if not metrics.registry.enabled:
             return
         ms = seconds * 1e3
         metrics.observe("evolu_stage_ms", ms, stage=stage)
+        if shard is not None:
+            # Per-shard split of a stage that runs concurrently per
+            # shard (the write-behind drain): shard labels are bounded
+            # by store topology, far inside the 512-per-family cap.
+            metrics.observe("evolu_stage_shard_ms", ms, stage=stage,
+                            shard=str(shard))
         metrics.inc("evolu_stage_seconds_total", seconds, stage=stage)
         if rows:
             metrics.inc("evolu_stage_rows_total", rows, stage=stage)
@@ -420,10 +429,11 @@ def get_platform() -> str:
 
 
 def record_stage(stage: str, seconds: float, rows: int = 0,
-                 nbytes: int = 0) -> None:
+                 nbytes: int = 0, shard: Optional[int] = None) -> None:
     """Record one execution of a stage (runtime seams call this
-    directly: engine.start_batch/finish_batch, ops.to_host_many)."""
-    _acct.record(stage, seconds, rows=rows, nbytes=nbytes)
+    directly: engine.start_batch/finish_batch, ops.to_host_many, the
+    write-behind drain workers with their shard index)."""
+    _acct.record(stage, seconds, rows=rows, nbytes=nbytes, shard=shard)
 
 
 def record_span(target: str, ms: float, rows: object = 0) -> None:

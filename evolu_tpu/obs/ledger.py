@@ -61,7 +61,10 @@ quiescence):
                            == apply.inserted + apply.losing + apply.duplicate
 
 (*) barrier-only: meaningful at quiescence — after write-behind drain
-barriers, with no requests in flight. `audit(at_barrier=False)` skips
+barriers (PR-19: the composed per-shard barrier; each shard's drain
+transaction posts its OWN pending entry, committed iff that shard's
+SQLite transaction committed, so a kill between shard commits leaves
+every row at exactly one terminal), with no requests in flight. `audit(at_barrier=False)` skips
 them; `audit()` (the default) checks everything and returns the
 violated equations with per-station deltas — an empty list IS the
 conservation proof, and tests/test_model_check.py asserts it at the

@@ -1270,7 +1270,9 @@ class BatchReconciler:
                 if cached is not None:
                     base_tree = cached[0]
                 else:
-                    with wb.db_lock:
+                    # Per-owner read: only the owner's SHARD lock — a
+                    # sibling shard's drain keeps running underneath.
+                    with wb.owner_lock(o):
                         raw = self.store.get_merkle_tree_string(o)
                     base_tree = merkle_tree_from_string(raw)
                 tree = apply_prefix_xors(base_tree, deltas)
@@ -1295,8 +1297,8 @@ class BatchReconciler:
             # entered the log (the ACK); rows the in-batch dedup
             # dropped never reach the queue and terminate HERE as
             # store.duplicate. The queued rows' inserted/duplicate
-            # split is classified exactly at drain time
-            # (write_behind._materialize) — nothing is posted if the
+            # split is classified exactly at drain time, per shard
+            # (write_behind._materialize_shard) — nothing is posted if the
             # append raised (backpressure = no state anywhere).
             kept: Dict[str, int] = {}
             for si in live:
@@ -1326,7 +1328,7 @@ class BatchReconciler:
         if cached is not None:
             tree, raw = cached
         else:
-            with self.write_behind.db_lock:
+            with self.write_behind.owner_lock(user_id):
                 raw = self.store.get_merkle_tree_string(user_id)
             tree = merkle_tree_from_string(raw)
         trees[user_id] = tree
@@ -1369,7 +1371,7 @@ class BatchReconciler:
                 from evolu_tpu.server import scope as scope_mod
 
                 wb.flush_owner(r.user_id)
-                with wb.db_lock:
+                with wb.owner_lock(r.user_id):
                     out.append(protocol.encode_sync_response(
                         scope_mod.scoped_response(self.store, r)))
                 continue
@@ -1379,10 +1381,12 @@ class BatchReconciler:
                 out.append(protocol._string(2, raw))
                 continue
             # The response needs stored rows: SQLite must be current
-            # for this owner first (the per-owner drain watermark),
-            # and from here on the EXACT committed tree serves.
+            # for this owner first (the per-owner drain watermark —
+            # ONLY the owner's shard; a backlogged sibling shard
+            # cannot stall this serve), and from here on the EXACT
+            # committed tree serves under the owner's shard lock.
             wb.flush_owner(r.user_id)
-            with wb.db_lock:
+            with wb.owner_lock(r.user_id):
                 raw = self.store.get_merkle_tree_string(r.user_id)
             tree = merkle_tree_from_string(raw)
             trees[r.user_id] = tree
@@ -1398,7 +1402,7 @@ class BatchReconciler:
                 out.append(None)
                 continue
             try:
-                with wb.db_lock:
+                with wb.owner_lock(r.user_id):
                     stream = fetch_response_stream(
                         db, r.user_id, r.node_id, tree, client_tree
                     )
@@ -1408,6 +1412,8 @@ class BatchReconciler:
                 continue
             out.append(stream + protocol._string(2, raw))
         if fallback:
+            # Mixed-owner object-path respond: the one deferred-mode
+            # site that still needs the whole-store composite lock.
             with wb.db_lock:
                 resps = self._respond([r for _i, r in fallback], trees, strings)
             for (i, _r), resp in zip(fallback, resps):

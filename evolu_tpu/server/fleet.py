@@ -179,10 +179,10 @@ class FleetManager:
         self.replication = replication
         # PR-11: the rebalance installer is a direct store writer; on a
         # write-behind relay each owner move runs behind the queue's
-        # drain barrier (drained + drain-locked — coarse, but owner
-        # moves are operator events, and the moved owners are
-        # FleetNotReady during the install so no serving-path state
-        # races them). Backlog-driven readiness lives in the relay's
+        # drain barrier (drained + drain-locked across EVERY shard
+        # worker since PR-19 — coarse, but owner moves are operator
+        # events, and the moved owners are FleetNotReady during the
+        # install so no serving-path state races them). Backlog-driven readiness lives in the relay's
         # /health handler: a saturated backlog answers 503, so peer
         # failover and the rebalance readiness probe route around it.
         self.write_behind = write_behind

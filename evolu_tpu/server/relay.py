@@ -50,6 +50,7 @@ from evolu_tpu.core.timestamp import (
 )
 from evolu_tpu.core.types import NonCanonicalStoreError
 from evolu_tpu.storage.native import open_database
+from evolu_tpu.storage.sqlite import configure_shared_file_db
 from evolu_tpu.sync import aead, protocol
 
 MAX_BODY_BYTES = 20 * 1024 * 1024  # index.ts:222
@@ -183,23 +184,12 @@ class RelayStore:
 
     def __init__(self, path: str = ":memory:", backend: str = "auto"):
         self.db = open_database(path, backend)
-        if path != ":memory:":
-            # File-backed stores may be shared across PROCESSES (the
-            # pre-forked MultiprocessRelay): WAL lets readers proceed
-            # under a writer, busy_timeout makes concurrent writers
-            # queue instead of failing, NORMAL sync is the standard
-            # WAL durability point (matches better-sqlite3 defaults).
-            # busy_timeout FIRST: the WAL conversion itself can hit a
-            # concurrent holder on a fresh shared file, and the native
-            # backend installs no busy handler at open.
-            for pragma in ("busy_timeout=5000", "journal_mode=WAL",
-                           "synchronous=NORMAL"):
-                self.db.exec_sql_query(f"PRAGMA {pragma}", ())
-            # Cross-process writers must take the write lock at BEGIN:
-            # a deferred transaction upgrading to write after another
-            # process committed gets SQLITE_BUSY with NO busy-handler
-            # retry. BEGIN IMMEDIATE queues under busy_timeout instead.
-            self.db.set_begin_immediate()
+        # File-backed stores may be shared across PROCESSES (the
+        # pre-forked MultiprocessRelay, the write-behind's
+        # process-per-shard drain children): one shared pragma
+        # discipline, see sqlite.configure_shared_file_db (no-op for
+        # :memory:).
+        configure_shared_file_db(self.db)
         # Uniqueness pair is the reference's (timestamp, userId)
         # (index.ts:64-75); the key ORDER is flipped and the table is
         # WITHOUT ROWID — a deliberate layout improvement: get_messages
@@ -1559,10 +1549,26 @@ class RelayServer:
                 )
                 if base and base != ":memory:":
                     write_behind_log = base + ".wblog"
+            # PR-19 parallel drain knobs (same env-wins-both-ways rule
+            # as EVOLU_WRITE_BEHIND): worker count + process-per-shard
+            # mode resolve here so an operator can steer a deployed
+            # relay without a Config edit.
+            env_workers = os.environ.get("EVOLU_WB_DRAIN_WORKERS", "")
+            drain_workers = (
+                int(env_workers) if env_workers
+                else default_config.wb_drain_workers
+            )
+            env_proc = os.environ.get("EVOLU_WB_DRAIN_PROCESS", "")
+            drain_process = (
+                env_proc.lower() not in ("0", "false", "no", "off")
+                if env_proc else default_config.wb_drain_process
+            )
             self.write_behind = WriteBehindQueue(
                 self.store, log_path=write_behind_log,
                 max_rows=default_config.write_behind_max_rows,
                 drain_batch_rows=default_config.write_behind_drain_rows,
+                drain_workers=drain_workers,
+                drain_process=drain_process,
             )
             batching = True
         # PR-12 mesh-sharded engine (docs/MESH.md): opt-in via

@@ -291,7 +291,9 @@ class ReplicationManager:
         # gossip summaries read the store directly (owner_trees) — a
         # round starts by draining so we only ever ADVERTISE committed
         # state (a tree advertised ahead of its rows would make peers
-        # pull ranges the store cannot yet serve).
+        # pull ranges the store cannot yet serve). PR-19: flush() is
+        # the COMPOSED barrier — it waits out every shard's drain
+        # worker, so the guarantee holds per shard.
         self.write_behind = write_behind
         # ISSUE 13: rows this manager ingests (anti-entropy pulls,
         # partition heals) are newly visible at THIS relay — parked

@@ -166,7 +166,8 @@ class SyncScheduler:
         self._mesh_engine = mesh_engine or (mesh_ctx is not None)
         # PR-11: a storage.write_behind.WriteBehindQueue makes the
         # engine serve from device-derived in-memory state and defer
-        # SQLite to the queue's drain thread. The scheduler's jobs:
+        # SQLite to the queue's drain workers (one per storage shard
+        # since PR-19). The scheduler's jobs:
         # construct the engine with it, convert its backpressure into
         # the 503 + Retry-After answer (queue-full stalls admission,
         # never drops), and run every DIRECT store write (singleton

@@ -848,7 +848,8 @@ def write_checkpoint(store, path: str,
     factory held across the CAPTURE (PR-11: the write-behind queue's
     `drain_barrier` — a checkpoint is a durable floor, so it must see
     fully committed state, and the drain must not commit underneath
-    the capture's read transactions)."""
+    the capture's read transactions; PR-19: the barrier composes over
+    every shard's drain worker, holding all shard locks at once)."""
     if barrier is not None:
         with barrier():
             manifest, chunks = capture_snapshot(store, chunk_bytes)

@@ -121,4 +121,23 @@ class PySqliteDatabase:
             self._conn.close()
 
 
+def configure_shared_file_db(db) -> None:
+    """Make a FILE-BACKED database safe for concurrent writers across
+    processes — the one pragma discipline shared by the pre-forked
+    fleet relays and the write-behind's process-per-shard drain
+    children. Order matters: busy_timeout FIRST, so the WAL switch
+    itself (a write) waits out a concurrent writer instead of failing;
+    WAL + synchronous=NORMAL is the durability/perf point the
+    checkpoint format assumes; BEGIN IMMEDIATE takes the write lock at
+    BEGIN (a deferred upgrade after a concurrent commit gets
+    SQLITE_BUSY with no busy_timeout applied). No-op for :memory:
+    databases — nothing shares those."""
+    if getattr(db, "path", None) in (None, ":memory:"):
+        return
+    for pragma in ("busy_timeout=5000", "journal_mode=WAL",
+                   "synchronous=NORMAL"):
+        db.exec_sql_query(f"PRAGMA {pragma}", ())
+    db.set_begin_immediate()
+
+
 

@@ -137,6 +137,22 @@ class Config:
     write_behind_max_rows: int = 1 << 20
     # Drain transaction sizing (rows per btree commit).
     write_behind_drain_rows: int = 1 << 16
+    # PR-19 parallel owner-sharded drain: worker count for the
+    # write-behind drain (0 = one worker per storage shard, the
+    # default; clamped to the shard count; workers own shards
+    # round-robin). Owners never share rows and LWW merge commutes, so
+    # per-shard transactions need no cross-shard ordering — the end
+    # state stays byte-identical at any worker count.
+    # EVOLU_WB_DRAIN_WORKERS overrides at the relay.
+    wb_drain_workers: int = 0
+    # Delegate each drain worker's shard transactions to a child
+    # process (storage/_wb_shard_proc.py) instead of running them on
+    # the worker thread. Only honest for pure-Python FILE-BACKED
+    # shards (the sqlite3 leg holds the GIL; the native C leg already
+    # drops it, so threads scale there) — anything else falls back to
+    # threads with a logged warning. EVOLU_WB_DRAIN_PROCESS=1
+    # overrides at the relay.
+    wb_drain_process: bool = False
     # PR-12 mesh-sharded engine (parallel/mesh.py::MeshContext): one
     # pjit/shard_map pass reconciles every owner across the device mesh
     # with STABLE owner->device placement (crc32, like the fleet ring)

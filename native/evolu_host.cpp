@@ -584,6 +584,15 @@ int eh_parse_timestamps(const char *ts_packed, int64_t n, int64_t *out_millis,
 // dedup through the PK exactly like sequential INSERT OR IGNORE: the
 // first occurrence reports was-new, later ones don't (index.ts:148-159
 // changes()==1 semantics).
+//
+// Threading contract (PR-19 parallel sharded drain): this function
+// touches only its `db` handle and caller-owned buffers — no globals,
+// no Python API — so ctypes calls it with the GIL RELEASED and the
+// write-behind queue runs one drain worker PER SHARD concurrently,
+// each on its own sqlite3 handle (SQLite objects are never shared
+// across the workers; serialization is per shard via the shard lock).
+// Keep it that way: any global/static state added here would race the
+// parallel drain.
 int eh_relay_insert_packed(sqlite3 *db, int64_t n_groups,
                            const char *const *group_users,
                            const int64_t *group_counts,

@@ -1001,7 +1001,22 @@ def test_write_behind_sigkill_torture(tmp_path, seed):
         _run_write_behind_torture(tmp_path, seed)
 
 
-def _run_write_behind_torture(tmp_path, seed):
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [7, 29, 101])
+def test_write_behind_sharded_sigkill_torture(tmp_path, seed):
+    """PR-19: the same SIGKILL episode against a 3-shard store with
+    one drain worker per shard. The kill can now land with shard k's
+    transaction committed and shard j's still pending (workers drain
+    concurrently) — replay must heal the partial commit exactly:
+    committed rows re-classify as duplicates, the end state is still
+    byte-identical to a synchronous oracle of the ACKed prefix (or
+    prefix+1 — fsync-before-ACK-print), and the finish process's
+    episode audit stays clean."""
+    with _evidence("write-behind-sharded-sigkill", seed):
+        _run_write_behind_torture(tmp_path, seed, shards=3, workers=3)
+
+
+def _run_write_behind_torture(tmp_path, seed, shards=1, workers=0):
     import os
     import signal
     import subprocess
@@ -1020,7 +1035,7 @@ def _run_write_behind_torture(tmp_path, seed):
     env = {**os.environ, "JAX_PLATFORMS": "cpu"}
     proc = subprocess.Popen(
         [sys.executable, worker, "ingest", db_path, str(seed),
-         str(n_batches), "0.15"],
+         str(n_batches), "0.15", str(shards), str(workers)],
         stdout=subprocess.PIPE, text=True, env=env,
     )
     kill_after = rng.randrange(1, n_batches - 1)
@@ -1052,7 +1067,8 @@ def _run_write_behind_torture(tmp_path, seed):
 
     # Restart: constructor replay + flush, then the state crc.
     out = subprocess.run(
-        [sys.executable, worker, "finish", db_path],
+        [sys.executable, worker, "finish", db_path, str(shards),
+         str(workers)],
         capture_output=True, text=True, timeout=300, env=env, check=True,
     )
     done = [ln for ln in out.stdout.splitlines() if ln.startswith("DONE crc=")]
@@ -1062,23 +1078,48 @@ def _run_write_behind_torture(tmp_path, seed):
     # Oracle twins: synchronous apply of the ACKed prefix — and of
     # prefix+1 (a kill between the log fsync and the ACK print means
     # one more batch is legitimately durable). The kill may also land
-    # mid-append of batch acked+1: its record was either fully fsynced
-    # (crc-framed) or its torn tail was discarded at replay, so the
-    # end state matches exactly one of the two twins. Batches are
-    # whole records here (single-shard store), never split.
+    # mid-append of batch acked+1: each record is crc-framed, so a
+    # torn frame is discarded at replay — on a single-shard store the
+    # batch is ONE record (fully durable or absent, exactly the two
+    # twins above). A sharded store appends one record PER LIVE SHARD
+    # (ascending shard order) under one fsync, and a kill mid-append
+    # can leave a complete frame PREFIX of that batch on disk (the
+    # kernel's page cache survives process death), so every
+    # record-prefix of batch acked+1 is also an accepted twin. The
+    # restriction is well-defined: in-batch dedup never crosses
+    # shards (its key includes the owner, and an owner's rows all
+    # land in one shard).
     batches = seeded_batches(seed, n_batches)
     accepted = set()
     from evolu_tpu.obs import ledger as ledger_mod
 
+    def _twin(prefix_batches, partial_reqs=None):
+        oracle = RelayStore()
+        eng = BatchReconciler(oracle)
+        for reqs in prefix_batches:
+            eng.run_batch_wire(reqs)
+        if partial_reqs:
+            eng.run_batch_wire(partial_reqs)
+        crc = f"{state_crc(oracle):08x}"
+        eng.close()
+        oracle.close()
+        return crc
+
     with ledger_mod.quarantine():  # reference computation, not traffic
         for extra in (0, 1):
-            oracle = RelayStore()
-            eng = BatchReconciler(oracle)
-            for reqs in batches[: acked + 1 + extra]:
-                eng.run_batch_wire(reqs)
-            accepted.add(f"{state_crc(oracle):08x}")
-            eng.close()
-            oracle.close()
+            accepted.add(_twin(batches[: acked + 1 + extra]))
+        if shards > 1 and acked + 1 < len(batches):
+            import zlib as _zlib
+
+            def shard_of(u):
+                return _zlib.crc32(u.encode("utf-8")) % shards
+
+            nxt = batches[acked + 1]
+            live = sorted({shard_of(r.user_id) for r in nxt if r.messages})
+            for r in range(1, len(live)):
+                allow = set(live[:r])
+                sub = [q for q in nxt if shard_of(q.user_id) in allow]
+                accepted.add(_twin(batches[: acked + 1], sub))
     assert got_crc in accepted, (got_crc, accepted, acked)
 
 

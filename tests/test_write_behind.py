@@ -460,10 +460,12 @@ def test_persistent_drain_failure_fails_health(monkeypatch):
     server = RelayServer(RelayStore(), write_behind=True)
     wb = server.write_behind
 
-    def boom(records, exact=False):
+    def boom(si, ops, exact=False, carry_taint=(), wid=None):
         raise RuntimeError("injected persistent drain failure")
 
-    monkeypatch.setattr(wb, "_materialize", boom)
+    # The per-shard materialize seam: every drain worker funnels its
+    # batches through it, so one patch wedges every shard.
+    monkeypatch.setattr(wb, "_materialize_shard", boom)
     server.start()
     try:
         req = protocol.SyncRequest(_msgs("a" * 16, 0, 6), "uF", "a" * 16, "{}")
@@ -564,6 +566,321 @@ def test_reset_drops_pending_and_truncates(tmp_path):
     wb2.close()
     eng.close()
     store.close()
+
+
+# -- PR-19 parallel owner-sharded drain --
+
+
+def _record_of(owner_msgs):
+    """Build an IngestRecord straight from {owner: msgs} (no tree rows
+    — the exact/replay path recomputes trees from was-new flags)."""
+    gu, gc, ts, ct, lens = [], [], b"", b"", []
+    for o, msgs in owner_msgs.items():
+        gu.append(o)
+        gc.append(len(msgs))
+        for m in msgs:
+            ts += m.timestamp.encode("ascii")
+            ct += m.content
+            lens.append(len(m.content))
+    return IngestRecord(gu, gc, ts, ct, np.array(lens, np.int32), [])
+
+
+def _write_log(path, records):
+    """Hand-frame a write-behind log (what append_batch's fsync leaves
+    on disk) — the crash fixtures build arbitrary pre-crash states
+    without racing a real drain."""
+    import struct
+    import zlib
+
+    from evolu_tpu.storage.write_behind import LOG_MAGIC
+
+    with open(path, "wb") as f:
+        f.write(LOG_MAGIC)
+        for r in records:
+            body = r.encode()
+            f.write(struct.pack("<I", len(body)))
+            f.write(struct.pack("<I", zlib.crc32(body)))
+            f.write(body)
+
+
+def _owners_per_shard(store, per_shard=1):
+    """Deterministic owner names covering every shard of `store`."""
+    found = {}
+    i = 0
+    while any(len(v) < per_shard for v in found.values()) or len(found) < len(store.shards):
+        o = f"owner{i}"
+        si = store.shard_index(o)
+        found.setdefault(si, [])
+        if len(found[si]) < per_shard:
+            found[si].append(o)
+        i += 1
+        if i > 10000:
+            raise AssertionError("owner search runaway")
+    return found
+
+
+def test_parallel_drain_matches_single_worker_oracle():
+    """The tentpole's byte-identity gate: the same workload (multi-
+    owner batches + duplicate redelivery, owners on every shard)
+    drained by one worker per shard vs ONE worker total lands the
+    identical SQLite end state — owners never share rows and LWW
+    commutes, so drain concurrency must be unobservable."""
+    store = ShardedRelayStore(shards=4)
+    wb = WriteBehindQueue(store)  # default: one worker per shard
+    eng = BatchReconciler(store, write_behind=wb)
+    oracle = ShardedRelayStore(shards=4)
+    owb = WriteBehindQueue(oracle, drain_workers=1)
+    oeng = BatchReconciler(oracle, write_behind=owb)
+    assert wb.drain_workers == 4 and owb.drain_workers == 1
+
+    by_shard = _owners_per_shard(store)
+    owners = [os_[0] for os_ in by_shard.values()]
+    node = {o: f"{i + 1:016x}" for i, o in enumerate(owners)}
+    for rnd in range(3):
+        reqs = [
+            protocol.SyncRequest(
+                _msgs(node[o], rnd * 10, 7 + rnd), o, node[o], "{}"
+            )
+            for o in owners
+        ]
+        # Duplicate redelivery of round 0's rows (the retry shape the
+        # exact drain correction must converge).
+        if rnd == 2:
+            reqs += [
+                protocol.SyncRequest(_msgs(node[o], 0, 3), o, node[o], "{}")
+                for o in owners
+            ]
+        assert eng.run_batch_wire(reqs) == oeng.run_batch_wire(reqs)
+    wb.flush(timeout=60)
+    owb.flush(timeout=60)
+    assert _dump(store) == _dump(oracle)
+    for q, s, e in ((wb, store, eng), (owb, oracle, oeng)):
+        q.close()
+        e.close()
+        s.close()
+
+
+def test_flush_owner_touches_only_its_shard():
+    """A stalled sibling shard must NOT stall flush_owner: the per-
+    owner barrier waits on the owner's shard watermark only."""
+    import time as _time
+
+    store = ShardedRelayStore(shards=2)
+    by_shard = _owners_per_shard(store)
+    fast_o, slow_o = by_shard[0][0], by_shard[1][0]
+    store2 = ShardedRelayStore(shards=2)
+    # Shard 1 (slow_o's shard) sleeps 3s per drain batch; shard 0
+    # drains instantly.
+    wb = WriteBehindQueue(store2, _shard_delay_s={1: 3.0})
+    try:
+        wb.append_batch([_record_of({fast_o: _msgs("a" * 16, 0, 5),
+                                     slow_o: _msgs("b" * 16, 0, 5)})])
+        t0 = _time.monotonic()
+        wb.flush_owner(fast_o, timeout=10)
+        assert _time.monotonic() - t0 < 2.0  # did not ride the stall
+        shards = {s["shard"]: s for s in wb.shard_payloads()}
+        assert shards[0]["backlog_rows"] == 0
+        assert shards[1]["backlog_rows"] == 5  # sibling still pending
+        with pytest.raises(TimeoutError):
+            wb.flush(timeout=0.2)  # the composed flush DOES wait
+        wb.flush(timeout=30)
+    finally:
+        wb.close()
+        store2.close()
+        store.close()
+
+
+def test_partial_commit_crash_replay_reclassifies_committed_shard(tmp_path):
+    """SIGKILL with shard k committed and shard j still pending: the
+    log replays BOTH, the end state is byte-identical to the oracle,
+    and exactly shard k's rows re-classify as store.duplicate (the
+    per-shard retry rule) — with the conservation audit clean."""
+    from evolu_tpu.obs import ledger
+
+    by = None
+    path = str(tmp_path / "relay.db")
+    store = ShardedRelayStore(path, shards=2)
+    by = _owners_per_shard(store)
+    k_owner, j_owner = by[0][0], by[1][0]
+    k_msgs = _msgs("c" * 16, 0, 8)
+    j_msgs = _msgs("d" * 16, 0, 6)
+    records = [_record_of({k_owner: k_msgs, j_owner: j_msgs})]
+    _write_log(path + ".wblog", records)
+    # "Pre-crash" state: shard k's transaction committed (rows + tree
+    # in SQLite), shard j's did not. Reference mutation, not traffic.
+    with ledger.quarantine():
+        store.add_messages(k_owner, list(k_msgs))
+
+    ledger.reset()  # the proof window starts at the restart
+    wb = WriteBehindQueue(store, log_path=path + ".wblog")  # replays
+    oracle = ShardedRelayStore(shards=2)
+    with ledger.quarantine():
+        oracle.add_messages(k_owner, list(k_msgs))
+        oracle.add_messages(j_owner, list(j_msgs))
+    assert _dump(store) == _dump(oracle)
+    t = ledger.totals()
+    assert t.get(ledger.STORE_DUPLICATE, 0) == len(k_msgs)  # exactly k's
+    assert t.get(ledger.STORE_INSERTED, 0) == len(j_msgs)
+    assert t.get(ledger.INGRESS_REPLAY, 0) == len(k_msgs) + len(j_msgs)
+    assert ledger.audit(at_barrier=True) == []
+    wb.close()
+    store.close()
+    oracle.close()
+
+
+def test_replay_survives_shard_count_change(tmp_path):
+    """The log stores owner groups, never shard assignments: a log
+    written under shards=2 replays exactly into a shards=3 store
+    (re-split by the topology it wakes up under)."""
+    owners = [f"owner{i}" for i in range(6)]
+    nodes = {o: f"{i + 1:016x}" for i, o in enumerate(owners)}
+    records = [
+        _record_of({o: _msgs(nodes[o], rnd * 10, 4) for o in owners})
+        for rnd in range(2)
+    ]
+    log_path = str(tmp_path / "wb.wblog")
+    _write_log(log_path, records)
+
+    store3 = ShardedRelayStore(str(tmp_path / "relay3.db"), shards=3)
+    wb = WriteBehindQueue(store3, log_path=log_path)  # replays under 3
+    oracle = ShardedRelayStore(shards=3)
+    from evolu_tpu.obs import ledger
+
+    with ledger.quarantine():
+        for o in owners:
+            for rnd in range(2):
+                oracle.add_messages(o, list(_msgs(nodes[o], rnd * 10, 4)))
+    assert _dump(store3) == _dump(oracle)
+    wb.close()
+    store3.close()
+    oracle.close()
+
+
+def test_process_drain_parity(tmp_path):
+    """Process-per-shard drain (pure-Python file-backed shards): the
+    end state is byte-identical to the synchronous oracle, the mode
+    actually engages, and the conservation totals balance (the parent
+    posts every terminal from the children's returned counts)."""
+    from evolu_tpu.obs import ledger
+
+    path = str(tmp_path / "relay.db")
+    store = ShardedRelayStore(path, backend="python", shards=2)
+    wb = WriteBehindQueue(store, log_path=path + ".wblog",
+                          drain_process=True)
+    assert wb.drain_mode == "process"
+    eng = BatchReconciler(store, write_behind=wb)
+    oracle = ShardedRelayStore(shards=2)
+    oeng = BatchReconciler(oracle)
+    by = _owners_per_shard(store)
+    owners = [os_[0] for os_ in by.values()]
+    nodes = {o: f"{i + 1:016x}" for i, o in enumerate(owners)}
+    for rnd in range(2):
+        reqs = [
+            protocol.SyncRequest(_msgs(nodes[o], rnd * 10, 6), o, nodes[o], "{}")
+            for o in owners
+        ]
+        if rnd == 1:  # duplicate redelivery through the child path
+            reqs += [
+                protocol.SyncRequest(_msgs(nodes[o], 0, 2), o, nodes[o], "{}")
+                for o in owners
+            ]
+        assert eng.run_batch_wire(reqs) == oeng.run_batch_wire(reqs)
+    wb.flush(timeout=60)
+    assert _dump(store) == _dump(oracle)
+    t = ledger.totals()
+    assert t.get(ledger.WB_QUEUED, 0) == t.get(ledger.WB_DRAINED, 0)
+    wb.close()
+    eng.close()
+    oeng.close()
+    store.close()
+    oracle.close()
+
+
+def test_same_batch_fresh_plus_duplicate_requests_stay_exact():
+    """Regression (found while building the sharded-drain parity
+    gate, but pre-existing): one batch carrying BOTH a fresh push and
+    a duplicate redelivery for the same owner. The record's per-owner
+    tree string is the post-batch OPTIMISTIC tree — it pre-folded the
+    redelivered rows' hashes (XOR-cancel against the stored copies).
+    The old per-op drain landed that string verbatim for the clean op
+    and then recomputed the dup op on top of it with zero new rows to
+    fold, committing the cancelled (wrong) tree. The per-owner
+    regroup in apply_shard_ops recomputes from the STORED tree with
+    all of the owner's new rows instead — end state and responses
+    must match the synchronous oracle and the reference add_messages
+    ground truth."""
+    from evolu_tpu.obs import ledger
+    from evolu_tpu.server.relay import RelayStore as _RS
+
+    node = "1".zfill(16)
+    gt = _RS()
+    with ledger.quarantine():
+        gt.add_messages("uZ", list(_msgs(node, 0, 6)) + list(_msgs(node, 10, 6)))
+    gt_tree = gt.get_merkle_tree_string("uZ")
+    gt.close()
+
+    store = ShardedRelayStore(shards=2)
+    wb = WriteBehindQueue(store)
+    eng = BatchReconciler(store, write_behind=wb)
+    oracle = ShardedRelayStore(shards=2)
+    oeng = BatchReconciler(oracle)
+    r0 = [protocol.SyncRequest(_msgs(node, 0, 6), "uZ", node, "{}")]
+    assert eng.run_batch_wire(r0) == oeng.run_batch_wire(r0)
+    r1 = [
+        protocol.SyncRequest(_msgs(node, 10, 6), "uZ", node, "{}"),
+        protocol.SyncRequest(_msgs(node, 0, 2), "uZ", node, "{}"),  # retry
+    ]
+    assert eng.run_batch_wire(r1) == oeng.run_batch_wire(r1)
+    wb.flush(timeout=30)
+    assert store.get_merkle_tree_string("uZ") == gt_tree
+    assert _dump(store) == _dump(oracle)
+    wb.close()
+    eng.close()
+    oeng.close()
+    store.close()
+    oracle.close()
+
+
+def test_process_drain_falls_back_for_memory_or_native_stores():
+    """:memory: shards cannot be shared with a child process — the
+    queue must fall back to threads, not half-work."""
+    store = ShardedRelayStore(shards=2)  # :memory:
+    wb = WriteBehindQueue(store, drain_process=True)
+    assert wb.drain_mode == "thread"
+    wb.close()
+    store.close()
+
+
+def test_stats_and_health_report_per_shard(pair):
+    """/stats + /health carry the per-shard split (backlog, watermark
+    lag, failure counters) so failover can see WHICH shard is wedged."""
+    store, wb, eng, oracle, oeng = pair
+    by = _owners_per_shard(store)
+    owners = [os_[0] for os_ in by.values()]
+    nodes = {o: f"{i + 1:016x}" for i, o in enumerate(owners)}
+    eng.run_batch_wire([
+        protocol.SyncRequest(_msgs(nodes[o], 0, 4), o, nodes[o], "{}")
+        for o in owners
+    ])
+    wb.flush(timeout=30)
+    s = wb.stats_payload()
+    assert s["drain_workers"] == 4 and s["drain_mode"] == "thread"
+    assert [sh["shard"] for sh in s["shards"]] == [0, 1, 2, 3]
+    for sh in s["shards"]:
+        assert sh["backlog_rows"] == 0
+        assert sh["watermark_lag"] == 0
+        assert sh["drain_failures_consecutive"] == 0
+        assert sh["failing"] is False
+    h = wb.health_payload()
+    assert len(h["shards"]) == 4
+    assert h["failing"] is False
+    from evolu_tpu.obs import metrics
+
+    # The per-shard metrics family posted for at least one shard.
+    assert any(
+        metrics.get_gauge("evolu_wb_shard_queue_rows", shard=str(si)) == 0
+        for si in range(4)
+    )
 
 
 # -- the PR-11 invariant audit (client side: cache is truth) --
