@@ -33,11 +33,14 @@ Gates (hard-fail, run in --smoke too):
   row reached exactly one ledger terminal across all legs.
 
 HONESTY (docs/BENCHMARKS.md): parallel drain needs parallel hardware.
-The `ratio >= 1.8` scaling assertion only fires when `os.cpu_count()`
->= 2 and not --smoke; on a 1-core container the measured ~1x flat
-line is reported as-is — the point of PR-19 is that the drain LIMIT
-moves from "one thread" to "core count". Correctness gates always
-run. Prints ONE JSON line; numbers live in docs/BENCHMARKS.md.
+The `ratio >= 1.8` scaling assertion fires whenever `os.cpu_count()`
+>= 2 — --smoke included (an armed smoke run widens the backlog to the
+full depths so the drain wall dwarfs scheduler jitter; rows stay
+small). On a 1-core container the skip is EXPLICIT: the reason is
+printed to stderr and recorded in the JSON note, and the measured ~1x
+flat line is reported as-is — the point of PR-19 is that the drain
+LIMIT moves from "one thread" to "core count". Correctness gates
+always run. Prints ONE JSON line; numbers live in docs/BENCHMARKS.md.
 """
 
 import json
@@ -167,10 +170,21 @@ def _drain_leg(tmp, tag, warmup, batches, workers, process):
 
 def main() -> None:
     smoke = "--smoke" in sys.argv
-    rows_per_owner = 16 if smoke else 96
-    lo, hi = (2, 5) if smoke else (4, 16)
     cpus = os.cpu_count() or 1
-    assert_scaling = (not smoke) and cpus >= 2
+    assert_scaling = cpus >= 2  # armed under --smoke too (ISSUE 20)
+    rows_per_owner = 16 if smoke else 96
+    # An ARMED smoke run uses the full backlog depths (rows stay
+    # small): the ratio needs per-leg drain walls that dwarf
+    # scheduler jitter, or a passing 1.8x would be luck, not scaling.
+    lo, hi = (2, 5) if (smoke and not assert_scaling) else (4, 16)
+    skip_reason = None
+    if not assert_scaling:
+        skip_reason = (
+            f"scaling assertion skipped: os.cpu_count()={cpus} < 2 — "
+            "parallel drain cannot beat one worker without a second "
+            "core; correctness gates (byte-identity, audit) still ran"
+        )
+        print(f"shard_drain: {skip_reason}", file=sys.stderr)
 
     batches = _stream(hi + 1, rows_per_owner, b"x" * 64)
     # Batch 0 is the (drained, untimed) warmup; a count-n leg ends
@@ -233,7 +247,8 @@ def main() -> None:
         "state_crc": f"{want_crc[hi]:08x}",
         "byte_identical": True,
         "audit_clean": True,
-        "note": {"cpus": cpus, "scaling_asserted": assert_scaling},
+        "note": {"cpus": cpus, "scaling_asserted": assert_scaling,
+                 "skip_reason": skip_reason},
     }))
 
 

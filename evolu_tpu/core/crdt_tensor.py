@@ -1,0 +1,625 @@
+"""Tensor-valued CRDT columns — the `"col:tensor:…"` type (ISSUE 20).
+
+CRDT-compliant model merging as a first-class workload (the two-layer
+CRDT model-merging architecture, arXiv:2605.19373): replicas
+collaboratively edit fixed-shape numeric state, converged by a merge
+monoid DECLARED per column. Ops are ordinary `CrdtMessage`s on the
+PR-7 typed-op substrate — the Merkle/anti-entropy algebra stays
+TIMESTAMP-ONLY and byte-for-byte unchanged; only the app-table
+materialization differs (materialization-only divergence, exactly like
+counters, sets and lists before it).
+
+Column spec: `"weights:tensor:<monoid>:<dtype>:<shape>"`, e.g.
+`"weights:tensor:sum:f32:4x8"` — monoid ∈ {sum, mean, max}, dtype ∈
+{f32, bf16}, shape `x`-separated. The FULL type string is stored in
+`__crdt_schema`, so the generic conflict check ("cannot re-declare
+with a different type") covers monoid/dtype/shape changes for free.
+
+**Exactness model (the whole design):** float addition is not
+associative, so a float fold could never be bit-identical under
+arbitrary permutation/partition/redelivery (the acceptance bar every
+CRDT type here clears). Sum and mean therefore quantize at decode —
+`q = rint(v * 2^16)` — and accumulate in MODULAR uint64 (two's
+complement), which IS exactly commutative and associative: device and
+host agree unconditionally, in any order, on any backend. Codec caps
+(`|v| ≤ 2^15`, `count ≤ 2^15`) make the sum exact (no wrap) up to
+~2^31 ops/cell and the count-weighted mean up to ~2^16 ops/cell;
+beyond that the accumulator wraps mod 2^64 — still CONVERGENT on
+every replica, just wrapped (documented in docs/TENSOR_CRDT.md; the
+same bound shape as the PN-counter's int32-delta argument). Values
+live on the 2^-16 lattice: an overwrite's payload is quantized too, so
+base and deltas compose in one integer algebra. Element-wise max maps
+f32 bits through the standard monotone u32 key transform (nonneg →
+bits|0x8000_0000, neg → ~bits): integer max is exact and idempotent,
+and the total order puts -0.0 below +0.0.
+
+**Merge monoids** (op kinds: `["d", b64]` delta, `["s", b64]` set):
+- `sum`: cell value = Σ quantized deltas (mod 2^64 per element).
+- `mean`: deltas carry a count (`["d", b64, count]`, the mean-by-count
+  weight); cell value = Σ(q·count) / Σ count.
+- `max`: element-wise max over delta payloads (exact float bits, no
+  quantization).
+- LWW-overwrite fallback, composed with each delta monoid by the
+  SEMIDIRECT-PRODUCT rule (arXiv:2004.04303): the latest `set` op (by
+  raw-string timestamp, the LWW order) resets the fold base; deltas
+  timestamped AFTER it reapply on top; deltas before it are shadowed.
+  The fold is a pure function of the delivered op SET — every
+  schedule converges. The base itself enters the fold as one ordinary
+  contribution (quantized / key-mapped), so "reset + reapply" is a
+  single segmented reduction.
+
+Layer map (the PR-7/PR-14 playbook):
+- this module: specs + ValueError-only codecs with declared
+  shape/dtype validation and the `TENSOR_MAX_BYTES` payload cap, the
+  `__crdt_tensor` op-log SQL state, the pure-numpy host oracle
+  (`fold_cell` / `replay_log` — the semantics ground truth), and
+  materialization with device routing;
+- `ops/crdt_tensor_merge.py`: the device twin — the merge IS a
+  batched segmented reduction over the `pallas_scan` machinery
+  (blocked XLA on CPU, single-pass Pallas on TPU), with the
+  reconcile-shaped shard cores (`pack_owner_cell_key` packed layout +
+  the wide fallback these payload widths finally exercise);
+- `storage/apply.py` → `crdt_types.apply_typed_ops`: folds new tensor
+  ops inside the apply transaction (dedup = `__message` screen);
+- `runtime/client.py`: `tensor_delta` / `tensor_set` / `tensor_value`
+  (drain-before-observe, the `set_remove` lesson);
+- `sync/protocol.py`: the advisory `crdt-tensor-v1` capability.
+
+The HOST does all raw-string timestamp ordering (base selection +
+delta masking), exactly like the list twin — device kernels see only
+integers, so the canonical-timestamp routing contract never applies
+to the tensor leg. GC is an explicit non-goal: `__crdt_tensor` keeps
+one row per op (the log IS the state; a snapshot bootstrap ships it
+like any other state table).
+"""
+
+from __future__ import annotations
+
+import base64
+import functools
+import json
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from evolu_tpu.core.types import CrdtMessage
+from evolu_tpu.obs import metrics
+
+TENSOR = "tensor"
+MONOIDS = ("sum", "mean", "max")
+DTYPES = ("f32", "bf16")
+
+# Payload cap, enforced at DECLARATION (a schema whose cells cannot be
+# shipped must fail loudly) and re-checked at decode (hostile framing).
+TENSOR_MAX_BYTES = 1 << 16
+_MAX_DIMS = 8
+
+# Fixed-point lattice: q = rint(v * 2^16). The codec magnitude cap
+# |v| ≤ 2^15 bounds |q| ≤ 2^31, so an unwrapped sum survives ~2^31
+# ops/cell (the PN-counter bound); count ≤ 2^15 bounds the weighted
+# mean's unwrapped range at ~2^16 ops/cell. Beyond: modular wrap,
+# convergent on every replica.
+_FRAC_BITS = 16
+_SCALE = float(1 << _FRAC_BITS)
+_MAG_MAX = float(1 << 15)
+_COUNT_MAX = 1 << 15
+
+# Flat-element ceiling for ONE device dispatch (ops × elements after
+# flattening); materialization chunks row groups under it, and a
+# single cell exceeding it folds on the host oracle.
+DEVICE_MAX_FLAT = 1 << 24
+
+TENSOR_STATE_TABLES_SQL = (
+    # One row per op — the log IS the merge state (the semidirect fold
+    # needs every delta's timestamp relative to the winning base, so
+    # nothing can be pre-reduced without re-deriving LWW order). "tag"
+    # is the op's own HLC timestamp (PK = the redelivery screen),
+    # "kind" is "d"/"s", "count" the mean weight (1 elsewhere),
+    # "payload" the raw little-endian element bytes.
+    'CREATE TABLE IF NOT EXISTS "__crdt_tensor" ('
+    '"tag" BLOB PRIMARY KEY, "table" BLOB, "row" BLOB, "column" BLOB, '
+    '"kind" BLOB, "count" INTEGER NOT NULL, "payload" BLOB)',
+    'CREATE INDEX IF NOT EXISTS "index__crdt_tensor_cell" ON "__crdt_tensor" '
+    '("table", "row", "column")',
+)
+
+Cell = Tuple[str, str, str]
+
+
+class TensorConfig:
+    """Parsed, validated column config — the unit every codec, fold and
+    kernel wrapper takes. Hashable/immutable; `type_string` round-trips
+    to the `__crdt_schema` entry."""
+
+    __slots__ = ("monoid", "dtype", "shape", "size", "nbytes", "type_string")
+
+    def __init__(self, monoid: str, dtype: str, shape: Tuple[int, ...]):
+        self.monoid = monoid
+        self.dtype = dtype
+        self.shape = shape
+        self.size = 1
+        for d in shape:
+            self.size *= d
+        self.nbytes = self.size * (4 if dtype == "f32" else 2)
+        self.type_string = (
+            f"{TENSOR}:{monoid}:{dtype}:" + "x".join(str(d) for d in shape)
+        )
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"TensorConfig({self.type_string!r})"
+
+
+@functools.lru_cache(maxsize=None)
+def parse_tensor_type(ct: str) -> TensorConfig:
+    """`"tensor:sum:f32:4x8"` → TensorConfig. ValueError only — a
+    typo'd declaration must fail loudly, never become an LWW column."""
+    parts = ct.split(":")
+    if len(parts) != 4 or parts[0] != TENSOR:
+        raise ValueError(
+            f"tensor column type must be 'tensor:<monoid>:<dtype>:<shape>': {ct!r}"
+        )
+    _tag, monoid, dtype, shape_s = parts
+    if monoid not in MONOIDS:
+        raise ValueError(f"unknown tensor merge monoid {monoid!r} in {ct!r}")
+    if dtype not in DTYPES:
+        raise ValueError(f"unknown tensor dtype {dtype!r} in {ct!r}")
+    dims = shape_s.split("x")
+    if not dims or len(dims) > _MAX_DIMS:
+        raise ValueError(f"tensor shape must have 1..{_MAX_DIMS} dims: {ct!r}")
+    shape: List[int] = []
+    for d in dims:
+        if not d.isdigit() or (len(d) > 1 and d[0] == "0") or int(d) < 1:
+            raise ValueError(f"bad tensor dim {d!r} in {ct!r}")
+        shape.append(int(d))
+    cfg = TensorConfig(monoid, dtype, tuple(shape))
+    if cfg.nbytes > TENSOR_MAX_BYTES:
+        raise ValueError(
+            f"tensor payload {cfg.nbytes}B exceeds the {TENSOR_MAX_BYTES}B cap: {ct!r}"
+        )
+    return cfg
+
+
+def is_tensor_type(ct: str) -> bool:
+    return isinstance(ct, str) and ct.startswith(TENSOR + ":")
+
+
+def tensor_type(monoid: str, dtype: str, shape: Sequence[int]) -> str:
+    """Spec-suffix builder (validates): `tensor_type("sum","f32",(4,8))`
+    → `"tensor:sum:f32:4x8"` — append to a column name with `:`."""
+    ct = f"{TENSOR}:{monoid}:{dtype}:" + "x".join(str(int(d)) for d in shape)
+    parse_tensor_type(ct)
+    return ct
+
+
+def _np_dtype(cfg: TensorConfig):
+    if cfg.dtype == "f32":
+        return np.dtype(np.float32)
+    import ml_dtypes  # jax hard dependency; no backend touch
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+# --- op codecs (ValueError-only, like every wire decoder) ---
+
+
+def _encode(cfg: TensorConfig, kind: str, array, count: int = 1) -> str:
+    arr = np.asarray(array, dtype=np.float32)
+    if arr.shape != cfg.shape:
+        raise ValueError(
+            f"tensor op shape {arr.shape} != declared {cfg.shape}"
+        )
+    if not np.all(np.isfinite(arr)):
+        raise ValueError("tensor op values must be finite")
+    if cfg.monoid != "max" and bool(np.any(np.abs(arr) > _MAG_MAX)):
+        raise ValueError(f"tensor op magnitude exceeds {_MAG_MAX}")
+    payload = arr.reshape(-1).astype(_np_dtype(cfg)).tobytes()
+    b64 = base64.b64encode(payload).decode("ascii")
+    if cfg.monoid == "mean":
+        if isinstance(count, bool) or not isinstance(count, int) \
+                or not 1 <= count <= _COUNT_MAX:
+            raise ValueError(f"tensor op count must be 1..{_COUNT_MAX}: {count!r}")
+        return json.dumps([kind, b64, count], separators=(",", ":"))
+    if count != 1:
+        raise ValueError(f"count is the mean monoid's weight, not {cfg.monoid}'s")
+    return json.dumps([kind, b64], separators=(",", ":"))
+
+
+def tensor_delta_value(cfg: TensorConfig, array, count: int = 1) -> str:
+    """Encode a delta op value for `cfg`'s monoid."""
+    return _encode(cfg, "d", array, count)
+
+
+def tensor_set_value(cfg: TensorConfig, array, count: int = 1) -> str:
+    """Encode an overwrite (the semidirect LWW fallback): resets the
+    fold base; later-timestamped deltas reapply on top."""
+    return _encode(cfg, "s", array, count)
+
+
+def decode_tensor_op(cfg: TensorConfig, value) -> Tuple[str, bytes, int]:
+    """Decode an op value against the DECLARED config → (kind, payload
+    bytes, count). ValueError only — the fold layer catches, counts and
+    drops malformed ops so a hostile peer can never wedge sync. Every
+    accepted payload is exactly `cfg.nbytes` of finite, magnitude-
+    bounded elements (bound skipped for max, which never accumulates)."""
+    if not isinstance(value, str):
+        raise ValueError(f"tensor op value must be a JSON string: {value!r}")
+    if len(value) > 2 * TENSOR_MAX_BYTES:
+        raise ValueError("tensor op value exceeds the payload cap")
+    try:
+        op = json.loads(value)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"malformed tensor op JSON: {e}") from e
+    if not isinstance(op, list) or not op or op[0] not in ("d", "s"):
+        raise ValueError(f"malformed tensor op shape: {value!r}")
+    count = 1
+    if cfg.monoid == "mean":
+        if len(op) != 3:
+            raise ValueError(f"mean op must be [kind, b64, count]: {value!r}")
+        count = op[2]
+        if isinstance(count, bool) or not isinstance(count, int) \
+                or not 1 <= count <= _COUNT_MAX:
+            raise ValueError(f"tensor op count must be 1..{_COUNT_MAX}: {count!r}")
+    elif len(op) != 2:
+        raise ValueError(f"{cfg.monoid} op must be [kind, b64]: {value!r}")
+    if not isinstance(op[1], str):
+        raise ValueError(f"tensor op payload must be base64: {value!r}")
+    try:
+        payload = base64.b64decode(op[1], validate=True)
+    except Exception as e:  # binascii.Error
+        raise ValueError(f"tensor op payload is not base64: {e}") from e
+    if len(payload) != cfg.nbytes:
+        raise ValueError(
+            f"tensor op payload {len(payload)}B != declared {cfg.nbytes}B"
+        )
+    arr = _payload_f32(cfg, payload)
+    if not np.all(np.isfinite(arr)):
+        raise ValueError("tensor op payload must be finite")
+    if cfg.monoid != "max" and bool(np.any(np.abs(arr) > _MAG_MAX)):
+        raise ValueError(f"tensor op magnitude exceeds {_MAG_MAX}")
+    return op[0], payload, count
+
+
+def decode_tensor_batch(
+    cfg: TensorConfig, msgs: Sequence[CrdtMessage]
+) -> Tuple[List[Tuple[CrdtMessage, str, bytes, int]], int]:
+    """→ ([(msg, kind, payload, count)], malformed_count). Malformed
+    ops drop HERE so they can never touch a cell (the decode-layer
+    batching-independence argument shared by every typed decoder)."""
+    out: List[Tuple[CrdtMessage, str, bytes, int]] = []
+    bad = 0
+    for m in msgs:
+        try:
+            kind, payload, count = decode_tensor_op(cfg, m.value)
+        except ValueError:
+            bad += 1
+            continue
+        out.append((m, kind, payload, count))
+    return out, bad
+
+
+# --- the fixed-point / key algebra (shared by oracle AND device prep) ---
+
+
+def _payload_f32(cfg: TensorConfig, payload: bytes) -> np.ndarray:
+    """Payload bytes → (size,) float32 (bf16 widens EXACTLY)."""
+    if cfg.dtype == "f32":
+        return np.frombuffer(payload, dtype=np.float32)
+    import ml_dtypes
+
+    return np.frombuffer(payload, dtype=ml_dtypes.bfloat16).astype(np.float32)
+
+
+def quantize(cfg: TensorConfig, payload: bytes) -> np.ndarray:
+    """Payload → (size,) int64 on the 2^-16 lattice. f32→f64 widening
+    and the f64 multiply are exact; rint is IEEE round-half-even —
+    fully deterministic across platforms."""
+    v = _payload_f32(cfg, payload).astype(np.float64)
+    return np.rint(v * _SCALE).astype(np.int64)
+
+
+def monotone_key(cfg: TensorConfig, payload: bytes) -> np.ndarray:
+    """f32 bits → (size,) uint32 keys with unsigned-integer order ==
+    float total order (nonneg → bits|0x8000_0000, neg → ~bits; -0.0
+    sorts below +0.0). Codec-rejected non-finite values never reach
+    here, so NaN ordering is moot."""
+    b = _payload_f32(cfg, payload).view(np.uint32)
+    return np.where(b >> 31 != 0, ~b, b | np.uint32(0x80000000)).astype(np.uint32)
+
+
+def monotone_key_invert(keys: np.ndarray) -> np.ndarray:
+    """Inverse of `monotone_key` → float32."""
+    k = keys.astype(np.uint32)
+    b = np.where(k >> 31 != 0, k ^ np.uint32(0x80000000), ~k)
+    return b.astype(np.uint32).view(np.float32)
+
+
+def zeros_value(cfg: TensorConfig) -> bytes:
+    """The app-table default for a never-touched cell: all-zero element
+    bytes (identical for f32 and bf16 — 0.0 encodes as zero bytes)."""
+    return bytes(cfg.nbytes)
+
+
+def _finalize(cfg: TensorConfig, acc: np.ndarray, den: int) -> bytes:
+    """Accumulator → canonical app-table bytes. ONE copy shared by the
+    host oracle and the device unpack, so finalization can never drift:
+    - sum/mean: u64 acc viewed two's-complement int64, divided on the
+      exact f64 lattice (den includes the 2^16 scale), then ONE rounding
+      into the declared dtype;
+    - max: u32 keys inverted to f32, then narrowed."""
+    if cfg.monoid == "max":
+        vec = monotone_key_invert(acc.astype(np.uint32))
+    else:
+        vec = acc.astype(np.uint64).view(np.int64).astype(np.float64) / (
+            float(den) * _SCALE
+        )
+    return np.asarray(vec, dtype=_np_dtype(cfg)).tobytes()
+
+
+# --- host-oracle fold (the semantics ground truth) ---
+
+
+def contributing_ops(
+    ops: Sequence[Tuple[str, str, int, bytes]],
+) -> List[Tuple[str, int, bytes]]:
+    """The semidirect mask: [(tag, kind, count, payload)] in ANY order
+    (duplicate tags collapse keep-first, mirroring the PK / keep-first
+    screen) → the ordered contributing list [(kind, count, payload)]:
+    the latest `set` op (raw-string tag order — the LWW rule), then
+    every delta tagged strictly after it; with no set op, all deltas.
+    Deltas shadowed by the base drop here, which is exactly what makes
+    the fold a pure function of the op set."""
+    by_tag: Dict[str, Tuple[str, int, bytes]] = {}
+    for tag, kind, count, payload in ops:
+        if tag not in by_tag:
+            by_tag[tag] = (kind, count, payload)
+    tags = sorted(by_tag)
+    base_i = -1
+    for i, t in enumerate(tags):
+        if by_tag[t][0] == "s":
+            base_i = i
+    contrib: List[Tuple[str, int, bytes]] = []
+    if base_i >= 0:
+        contrib.append(by_tag[tags[base_i]])
+    for t in tags[base_i + 1:] if base_i >= 0 else tags:
+        kind, count, payload = by_tag[t]
+        if kind == "d":
+            contrib.append((kind, count, payload))
+    return contrib
+
+
+def _fold_contributions(
+    cfg: TensorConfig, contrib: Sequence[Tuple[str, int, bytes]]
+) -> bytes:
+    """Pure-numpy reduction over an already-masked contributing list —
+    modular u64 for sum/mean (commutative AND associative bit-exactly,
+    the device-parity keystone), integer max over monotone keys."""
+    if not contrib:
+        return zeros_value(cfg)
+    if cfg.monoid == "max":
+        acc: Optional[np.ndarray] = None
+        for _kind, _count, payload in contrib:
+            keys = monotone_key(cfg, payload)
+            acc = keys if acc is None else np.maximum(acc, keys)
+        return _finalize(cfg, acc, 1)
+    acc64 = np.zeros(cfg.size, np.uint64)
+    den = 0
+    for _kind, count, payload in contrib:
+        c = count if cfg.monoid == "mean" else 1
+        acc64 += quantize(cfg, payload).view(np.uint64) * np.uint64(c)
+        den += c
+    return _finalize(cfg, acc64, den if cfg.monoid == "mean" else 1)
+
+
+def fold_cell(cfg: TensorConfig, ops: Sequence[Tuple[str, str, int, bytes]]) -> bytes:
+    """Pure per-cell fold: [(tag, kind, count, payload)] in any order →
+    canonical materialized bytes. The one-call oracle the device twin,
+    the goldens and the model-check replay are pinned against."""
+    return _fold_contributions(cfg, contributing_ops(ops))
+
+
+def replay_log(
+    types: Dict[Tuple[str, str], str], msgs: Sequence[CrdtMessage]
+) -> Dict[Cell, bytes]:
+    """Host-oracle replay of a FULL op log (any order, duplicates
+    fine): → {cell: materialized bytes} for every tensor column in
+    `types`. Ground truth for the model-check episodes."""
+    seen: Set[str] = set()
+    per_cell: Dict[Cell, List[Tuple[str, str, int, bytes]]] = {}
+    for m in msgs:
+        if m.timestamp in seen:
+            continue
+        seen.add(m.timestamp)
+        ct = types.get((m.table, m.column))
+        if ct is None or not is_tensor_type(ct):
+            continue
+        try:
+            kind, payload, count = decode_tensor_op(parse_tensor_type(ct), m.value)
+        except ValueError:
+            continue
+        per_cell.setdefault((m.table, m.row, m.column), []).append(
+            (m.timestamp, kind, count, payload)
+        )
+    return {
+        cell: fold_cell(parse_tensor_type(types[(cell[0], cell[2])]), ops)
+        for cell, ops in per_cell.items()
+    }
+
+
+# --- SQL state fold (runs INSIDE the caller's apply transaction) ---
+
+
+def apply_tensor_ops(db, ct: str, new_msgs: Sequence[CrdtMessage]) -> Set[Cell]:
+    """Fold NEW tensor ops of ONE declared type (already screened
+    against __message) into the `__crdt_tensor` op log. Returns touched
+    cells; the caller (`crdt_types.apply_typed_ops`) materializes them."""
+    if not new_msgs:
+        return set()
+    cfg = parse_tensor_type(ct)
+    valid, bad = decode_tensor_batch(cfg, new_msgs)
+    if bad:
+        metrics.inc("evolu_crdt_malformed_ops_total", bad, type=TENSOR)
+    if not valid:
+        return set()
+    metrics.inc("evolu_crdt_ops_total", len(valid), type=TENSOR)
+    n_sets = sum(1 for _m, kind, _p, _c in valid if kind == "s")
+    if n_sets:
+        metrics.inc("evolu_crdt_tensor_ops_total", n_sets, kind="set")
+    if len(valid) - n_sets:
+        metrics.inc("evolu_crdt_tensor_ops_total", len(valid) - n_sets,
+                    kind="delta")
+    metrics.inc("evolu_crdt_tensor_bytes_total",
+                sum(len(p) for _m, _k, p, _c in valid))
+    db.run_many(
+        'INSERT OR IGNORE INTO "__crdt_tensor" '
+        '("tag", "table", "row", "column", "kind", "count", "payload") '
+        "VALUES (?, ?, ?, ?, ?, ?, ?)",
+        [
+            (m.timestamp, m.table, m.row, m.column, kind, count, payload)
+            for m, kind, payload, count in valid
+        ],
+    )
+    # Every VALID op touches its cell — identically on every replica
+    # regardless of batching (the decode-layer screen above).
+    return {(m.table, m.row, m.column) for m, _k, _p, _c in valid}
+
+
+def _cell_rows(
+    db, table: str, column: str, rows: Sequence[str]
+) -> Dict[str, List[Tuple[str, str, int, bytes]]]:
+    """ALL stored ops of the touched cells, grouped per row."""
+    out: Dict[str, List[Tuple[str, str, int, bytes]]] = {}
+    for i in range(0, len(rows), 500):
+        part = rows[i : i + 500]
+        q = (
+            'SELECT "row", "tag", "kind", "count", "payload" FROM "__crdt_tensor" '
+            'WHERE "table" = ? AND "column" = ? AND "row" IN ({})'
+        ).format(",".join("?" * len(part)))
+        for r in db.exec_sql_query(q, (table, column, *part)):
+            out.setdefault(r["row"], []).append(
+                (r["tag"], r["kind"], r["count"], r["payload"])
+            )
+    return out
+
+
+def materialize_tensor_values(
+    db, ct: str, table: str, column: str, rows: Sequence[str]
+) -> Dict[str, bytes]:
+    """→ {row: canonical element bytes} for the touched cells of one
+    (table, column). The HOST applies the semidirect mask (raw-string
+    tag ordering — timestamps never reach the device); the masked
+    contributions route to the device twin when the flattened element
+    count clears `DEVICE_FOLD_MIN`, chunked under `DEVICE_MAX_FLAT`
+    per dispatch (routing happens BEFORE any side effect — this
+    function only reads)."""
+    from evolu_tpu.core.crdt_types import DEVICE_FOLD_MIN
+
+    cfg = parse_tensor_type(ct)
+    per_row = _cell_rows(db, table, column, rows)
+    plans = {row: contributing_ops(ops) for row, ops in per_row.items()}
+    total_elems = sum(len(c) for c in plans.values()) * cfg.size
+    use_device = DEVICE_FOLD_MIN <= total_elems
+    metrics.inc("evolu_crdt_tensor_fold_total",
+                path="device" if use_device else "host", monoid=cfg.monoid)
+    metrics.inc("evolu_crdt_tensor_folded_elements_total", total_elems)
+    if use_device:
+        return _materialize_device(cfg, plans)
+    return {row: _fold_contributions(cfg, c) for row, c in plans.items()}
+
+
+def _materialize_device(
+    cfg: TensorConfig, plans: Dict[str, List[Tuple[str, int, bytes]]]
+) -> Dict[str, bytes]:
+    """Batch every touched cell's masked contributions into segmented-
+    reduction dispatches (`ops.crdt_tensor_merge.tensor_cell_folds`) —
+    bit-identical to `_fold_contributions` (test-pinned) because both
+    sides reduce the SAME u64 lattice / u32 keys with an exactly
+    associative-commutative combine. Row groups chunk under
+    `DEVICE_MAX_FLAT` flat elements; a single cell too big for one
+    dispatch folds on the host oracle (counted)."""
+    from evolu_tpu.ops.crdt_tensor_merge import tensor_cell_folds
+
+    out: Dict[str, bytes] = {}
+    max_ops = DEVICE_MAX_FLAT // cfg.size
+    chunk_rows: List[Tuple[str, List[Tuple[str, int, bytes]]]] = []
+    chunk_ops = 0
+
+    def _flush():
+        nonlocal chunk_rows, chunk_ops
+        if not chunk_rows:
+            return
+        cell_id = np.empty(chunk_ops, np.int32)
+        contrib = np.empty((chunk_ops, cfg.size), np.uint64)
+        dens: List[int] = []
+        at = 0
+        for ci, (_row, contribs) in enumerate(chunk_rows):
+            den = 0
+            for _kind, count, payload in contribs:
+                if cfg.monoid == "max":
+                    contrib[at] = monotone_key(cfg, payload).astype(np.uint64)
+                else:
+                    c = count if cfg.monoid == "mean" else 1
+                    contrib[at] = (
+                        quantize(cfg, payload).view(np.uint64) * np.uint64(c)
+                    )
+                    den += c
+                cell_id[at] = ci
+                at += 1
+            dens.append(den if cfg.monoid == "mean" else 1)
+        table = tensor_cell_folds(cell_id, contrib, len(chunk_rows), cfg.monoid)
+        for ci, (row, _contribs) in enumerate(chunk_rows):
+            out[row] = _finalize(cfg, table[ci], dens[ci])
+        chunk_rows = []
+        chunk_ops = 0
+
+    for row in sorted(plans):
+        contribs = plans[row]
+        if not contribs:
+            out[row] = zeros_value(cfg)
+            continue
+        if len(contribs) > max_ops:  # one cell exceeds a dispatch
+            metrics.inc("evolu_crdt_tensor_oversized_host_folds_total")
+            out[row] = _fold_contributions(cfg, contribs)
+            continue
+        if chunk_ops + len(contribs) > max_ops:
+            _flush()
+        chunk_rows.append((row, contribs))
+        chunk_ops += len(contribs)
+    _flush()
+    return out
+
+
+# --- reads for the client API (drain-before-observe callers) ---
+
+
+def tensor_config(db, table: str, column: str) -> TensorConfig:
+    """The declared config of (table, column) — raises ValueError when
+    the column is not a declared tensor column (writing tensor ops into
+    an undeclared column would LWW them; fail loudly instead)."""
+    from evolu_tpu.core.crdt_types import load_schema
+
+    ct = load_schema(db).column_type(table, column)
+    if not is_tensor_type(ct):
+        raise ValueError(f"{table}.{column} is not a declared tensor column: {ct!r}")
+    return parse_tensor_type(ct)
+
+
+def tensor_state(db, table: str, row: str, column: str) -> Optional[np.ndarray]:
+    """The materialized cell value as a shaped numpy array (declared
+    dtype), or None when the app row does not exist. Callers drain the
+    worker first (`Evolu.tensor_value`)."""
+    from evolu_tpu.storage.sqlite import quote_ident
+
+    cfg = tensor_config(db, table, column)
+    rows = db.exec_sql_query(
+        f'SELECT {quote_ident(column)} AS "v" FROM {quote_ident(table)} '
+        'WHERE "id" = ?',
+        (row,),
+    )
+    if not rows:
+        return None
+    raw = rows[0]["v"]
+    if raw is None:
+        raw = zeros_value(cfg)
+    if isinstance(raw, str):
+        raw = raw.encode("latin-1")
+    return np.frombuffer(bytes(raw), dtype=_np_dtype(cfg)).reshape(cfg.shape).copy()

@@ -25,7 +25,12 @@ op-based column types on the SAME substrate — ops are ordinary
 A third type, the **RGA sequence** (`"list"`, ISSUE 14), lives in its
 own module `core/crdt_list.py` (insert-after ordering with tombstoned
 deletes — the genuinely order-SENSITIVE merge); this module dispatches
-its fold and materialization through the same typed-apply leg.
+its fold and materialization through the same typed-apply leg. So does
+the **tensor family** (`"tensor:<monoid>:<dtype>:<shape>"`, ISSUE 20,
+`core/crdt_tensor.py`): fixed-shape numeric cells with a declared
+merge monoid — there the TYPE STRING itself is parameterized, so
+`partition_typed`'s full-string keys carry each column's config to the
+fold dispatch for free.
 
 Design invariants (see docs/CRDT_TYPES.md):
 - The LWW xor/Merkle algebra is TIMESTAMP-ONLY and stays byte-for-byte
@@ -111,10 +116,19 @@ def parse_column_spec(spec: str) -> Tuple[str, str]:
     if ":" not in spec:
         return spec, LWW
     name, _, ctype = spec.partition(":")
-    if ctype not in COLUMN_TYPES:
-        raise ValueError(f"unknown CRDT column type {ctype!r} in {spec!r}")
     if not name:
         raise ValueError(f"empty column name in spec {spec!r}")
+    if ctype.startswith("tensor"):
+        # Parameterized family: the FULL "tensor:monoid:dtype:shape"
+        # string is the column type (validated here, stored verbatim in
+        # __crdt_schema — the generic re-declaration conflict check
+        # then covers monoid/dtype/shape changes for free).
+        from evolu_tpu.core.crdt_tensor import parse_tensor_type
+
+        parse_tensor_type(ctype)
+        return name, ctype
+    if ctype not in COLUMN_TYPES:
+        raise ValueError(f"unknown CRDT column type {ctype!r} in {spec!r}")
     return name, ctype
 
 
@@ -149,8 +163,9 @@ def ensure_schema_table(db) -> None:
 
 def ensure_state_tables(db) -> None:
     from evolu_tpu.core.crdt_list import LIST_STATE_TABLES_SQL
+    from evolu_tpu.core.crdt_tensor import TENSOR_STATE_TABLES_SQL
 
-    for sql in _STATE_TABLES_SQL + LIST_STATE_TABLES_SQL:
+    for sql in _STATE_TABLES_SQL + LIST_STATE_TABLES_SQL + TENSOR_STATE_TABLES_SQL:
         db.exec(sql)
 
 
@@ -606,8 +621,16 @@ def materialize_cells(db, schema: CrdtSchema, cells: Iterable[Cell]) -> None:
 
             default = "[]"
             values = materialize_list_values(db, table, column, rows)
-        else:  # pragma: no cover - partition_typed never routes LWW here
-            continue
+        else:
+            from evolu_tpu.core.crdt_tensor import (
+                is_tensor_type, materialize_tensor_values, parse_tensor_type,
+                zeros_value,
+            )
+
+            if not is_tensor_type(ct):  # pragma: no cover - never routed here
+                continue
+            default = zeros_value(parse_tensor_type(ct))
+            values = materialize_tensor_values(db, ct, table, column, rows)
         db.run_many(
             _upsert_sql(table, column),
             [(row, values.get(row, default), values.get(row, default))
@@ -627,6 +650,13 @@ def _fold_by_type(db, by_type: Dict[str, List[CrdtMessage]]) -> Set[Cell]:
         from evolu_tpu.core.crdt_list import apply_list_ops
 
         touched |= apply_list_ops(db, list_msgs)
+    for ct, tensor_msgs in by_type.items():
+        # Parameterized tensor family: one bucket PER full type string
+        # (the dict key carries the column config to the fold).
+        if tensor_msgs and ct.startswith("tensor:"):
+            from evolu_tpu.core.crdt_tensor import apply_tensor_ops
+
+            touched |= apply_tensor_ops(db, ct, tensor_msgs)
     return touched
 
 
@@ -664,7 +694,7 @@ def rebuild_state(db, schema: CrdtSchema) -> None:
         return
     ensure_state_tables(db)
     for t in ("__crdt_counter", "__crdt_set", "__crdt_kill",
-              "__crdt_list", "__crdt_list_kill"):
+              "__crdt_list", "__crdt_list_kill", "__crdt_tensor"):
         db.run(f'DELETE FROM "{t}"')
     rows = db.exec_sql_query(
         'SELECT "timestamp", "table", "row", "column", "value" FROM "__message" '

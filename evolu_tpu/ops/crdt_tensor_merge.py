@@ -1,0 +1,261 @@
+"""Device kernels for the tensor-CRDT fold (ISSUE 20).
+
+Host oracle: `core/crdt_tensor.py` — everything here is pinned
+bit-identical to it (tests/test_crdt_tensor.py + goldens that are
+never updated). The merge IS a batched segmented reduction over the
+machinery `pallas_scan` already has: blocked two-level XLA on CPU,
+single-pass Pallas on TPU silicon, interpret-mode parity pinned.
+
+**Why bit-identity is unconditional:** the host pre-masks the
+semidirect composition (base selection + delta shadowing are
+raw-string timestamp work — the device never sees a timestamp) and
+hands the kernel MODULAR uint64 contributions (sum/mean: fixed-point
+q·count on the 2^-16 lattice; max: monotone u32 keys zero-extended).
+Modular add and integer max are exactly associative AND commutative,
+so scan order, blocking, chunk boundaries and Pallas-vs-XLA routing
+cannot move a single bit — unlike a float fold, which could never
+clear the any-permutation acceptance bar.
+
+**Layout (the per-payload-width cost call):** the recorded v5e law
+prices `lax.sort` ~0.75 ms per extra u64 payload at 1M — carrying a
+`width`-element cell through the sort as payloads would cost
+O(width) sorts. Instead the ONE packed i64 key (cell << 24 | idx,
+the `plan_merge_sorted_core` layout) sorts alone, a single row-gather
+`contrib[i_s]` recovers the (n, width) matrix (one gather ≈ 4 sorts,
+amortized over the whole width), and the scan runs over the d-major
+FLATTENED (width·n,) view with tiled segment flags — every element
+column auto-starts a segment at its own offset, so per-cell-per-
+element totals fall out of ONE scan pass regardless of width.
+
+Shard shape: `tensor_shard_sums_core` groups by the SAME
+`reconcile.pack_owner_cell_key` packed layout as the LWW and counter
+shard kernels (lo_bits=0), and payloads this wide finally exercise
+the WIDE fallback (`tensor_shard_sums_wide_core`: owner rides as a
+sort payload, cells < 2^31) at production shapes — routing is static
+host-maxima, mirrored from `reconcile.shard_kernel_for` and counted
+under `evolu_crdt_tensor_kernel_total{variant=packed|wide}`.
+
+Everything traces under enable_x64(True) (i64 keys / u64 lattice)
+and pads to power-of-two buckets; `width` and `monoid` are static
+per COLUMN (schema constants), so the jit cache stays flat within
+batch buckets with tensor traffic hot (fenced by the sentinel test).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from evolu_tpu.obs import metrics
+from evolu_tpu.ops import bucket_size, to_host, with_x64
+from evolu_tpu.ops.crdt_merge import segmented_sum_scan
+from evolu_tpu.ops.merge import _PAD_CELL, _segmented_max_scan
+from evolu_tpu.utils.log import span
+
+
+def _flat_segmented_fold(c_s, v_s, monoid: str):
+    """(n,) sorted cell ids + (n, width) gathered contributions → the
+    inclusive segmented fold over the d-major flattened view. Returns
+    (agg_flat (width·n,), seg_start, seg_end)."""
+    n = c_s.shape[0]
+    width = v_s.shape[1]
+    seg_start = jnp.concatenate([jnp.ones((1,), bool), c_s[1:] != c_s[:-1]])
+    flags = jnp.tile(seg_start, width)
+    flat = v_s.T.reshape(-1)
+    if monoid == "max":
+        agg, _ = _segmented_max_scan(flags, flat, jnp.zeros_like(flat))
+    else:
+        agg = segmented_sum_scan(flags, flat)
+    seg_end = jnp.concatenate([seg_start[1:], jnp.ones((1,), bool)])
+    return agg, seg_start, seg_end
+
+
+@functools.partial(jax.jit, static_argnames=("table_size", "width", "monoid"))
+def tensor_cell_fold_core(cell_id, contrib, table_size, width, monoid):
+    """Traceable core: cell-grouped segmented u64 fold of (n, width)
+    contributions, scattered into a dense (table_size, width) table
+    (slot = cell id; pad rows park on the out-of-range dump slot).
+    `cell_id` int32 with _PAD_CELL padding, `contrib` uint64,
+    n ≤ 2^24 (the packed-key idx bound — the host wrapper chunks).
+    Must trace under enable_x64(True) (guarded like the merge cores)."""
+    n = cell_id.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    key = (cell_id.astype(jnp.int64) << jnp.int64(24)) | idx.astype(jnp.int64)
+    if key.dtype != jnp.dtype("int64"):  # x64 disabled: would mis-group
+        raise TypeError(
+            "tensor_cell_fold_core must be traced under enable_x64(True): "
+            f"packed key degraded to {key.dtype}"
+        )
+    key_s = jax.lax.sort(key)
+    i_s = (key_s & jnp.int64((1 << 24) - 1)).astype(jnp.int32)
+    c_s = (key_s >> jnp.int64(24)).astype(jnp.int32)
+    v_s = contrib[i_s]  # ONE row gather instead of width sort payloads
+    agg, _seg_start, seg_end = _flat_segmented_fold(c_s, v_s, monoid)
+    real = c_s != _PAD_CELL
+    live = jnp.tile(seg_end & real, width)
+    d_ix = jnp.repeat(jnp.arange(width, dtype=jnp.int64), n)
+    tgt = jnp.where(
+        live,
+        jnp.tile(c_s.astype(jnp.int64), width) * jnp.int64(width) + d_ix,
+        jnp.int64(table_size * width),
+    )
+    table = (
+        jnp.zeros(table_size * width, jnp.uint64).at[tgt].set(agg, mode="drop")
+    )
+    return table.reshape(table_size, width)
+
+
+@with_x64
+def tensor_cell_folds(
+    cell_id: np.ndarray, contrib: np.ndarray, num_cells: int, monoid: str
+) -> np.ndarray:
+    """Host entry: → (num_cells, width) uint64 numpy — per-cell modular
+    sums (sum/mean) or max keys (max), bit-identical to the host
+    oracle's accumulator per cell. Batches beyond the 2^24 idx bound
+    fold in chunks — both monoids are associative/commutative on the
+    integer lattice, so chunked accumulation is exact."""
+    n = len(cell_id)
+    width = contrib.shape[1]
+    if n == 0:
+        return np.zeros((num_cells, width), np.uint64)
+    with span("kernel:crdt", "tensor_cell_folds", n=n, cells=num_cells,
+              width=width, monoid=monoid):
+        table_size = bucket_size(max(num_cells, 1))
+        acc = np.zeros((table_size, width), np.uint64)
+        chunk = 1 << 24
+        for i in range(0, n, chunk):
+            c = cell_id[i : i + chunk]
+            v = contrib[i : i + chunk]
+            size = bucket_size(len(c))
+            c_p = np.concatenate(
+                [c.astype(np.int32),
+                 np.full(size - len(c), int(_PAD_CELL), np.int32)]
+            )
+            v_p = np.concatenate(
+                [v.astype(np.uint64),
+                 np.zeros((size - len(v), width), np.uint64)]
+            )
+            t = to_host(tensor_cell_fold_core(
+                jnp.asarray(c_p), jnp.asarray(v_p),
+                table_size=table_size, width=width, monoid=monoid,
+            ))
+            if monoid == "max":
+                np.maximum(acc, t, out=acc)
+            else:
+                acc += t
+        return acc[:num_cells]
+
+
+# --- reconcile-shaped shard cores (packed layout + the wide fallback) ---
+
+
+def tensor_shard_sums_core(owner_ix, cell_id, contrib):
+    """Per-shard tensor fold for the multi-owner reconcile shape: ops
+    group by the SAME packed owner|cell|idx i64 sort key as the LWW and
+    counter shard kernels (`pack_owner_cell_key`, lo_bits=0 — the sum
+    monoid needs no flag bits), then ONE flattened segmented scan sums
+    all `width` element columns per (owner, cell) segment. Returns
+    (grp, seg_end, sums (width·n,) d-major) — per-cell totals sit at
+    seg-end rows, and every output feeds the bench's checksum carry.
+    Preconditions: owner < 4095, cell < 2^25, n ≤ 2^24 (the host
+    router sends anything beyond to the wide variant)."""
+    from evolu_tpu.parallel.reconcile import pack_owner_cell_key
+
+    n = cell_id.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    key = pack_owner_cell_key(owner_ix, cell_id, idx, lo_bits=0)
+    key_s = jax.lax.sort(key)
+    i_s = (key_s & jnp.int64((1 << 24) - 1)).astype(jnp.int32)
+    grp = key_s >> jnp.int64(24)  # owner|cell bits above idx
+    v_s = contrib[i_s]
+    sums, _seg_start, seg_end = _flat_segmented_fold(grp, v_s, "sum")
+    return grp, seg_end, sums
+
+
+def tensor_shard_sums_wide_core(owner_ix, cell_id, contrib):
+    """The wide-id fallback (cell ≥ 2^25 or owner ≥ 4095) — the path
+    tensor payload widths finally exercise at production shapes: the
+    sort key is cell << 24 | idx (cells < 2^31, i32 interning bound),
+    the owner rides as a GATHERED payload instead of key bits, and
+    segmentation is by cell alone — same contract as
+    `reconcile._shard_kernel_wide` (cell ids are globally interned,
+    unique per owner). Returns (own_s, c_s, seg_end, sums) — per-cell
+    totals at seg-end rows, bit-identical to the packed variant
+    wherever its preconditions hold (parity-pinned)."""
+    n = cell_id.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    key = (cell_id.astype(jnp.int64) << jnp.int64(24)) | idx.astype(jnp.int64)
+    if key.dtype != jnp.dtype("int64"):  # x64 disabled: would mis-group
+        raise TypeError(
+            "tensor_shard_sums_wide_core must be traced under "
+            f"enable_x64(True): packed key degraded to {key.dtype}"
+        )
+    key_s, own_s = jax.lax.sort((key, owner_ix.astype(jnp.int32)), num_keys=1)
+    i_s = (key_s & jnp.int64((1 << 24) - 1)).astype(jnp.int32)
+    c_s = (key_s >> jnp.int64(24)).astype(jnp.int32)
+    v_s = contrib[i_s]
+    sums, _seg_start, seg_end = _flat_segmented_fold(c_s, v_s, "sum")
+    return own_s, c_s, seg_end, sums
+
+
+_shard_packed_jit = with_x64(jax.jit(tensor_shard_sums_core))
+_shard_wide_jit = with_x64(jax.jit(tensor_shard_sums_wide_core))
+
+_OWNER_LIMIT = 4095  # reconcile._PAD_OWNER — the padding sentinel
+_CELL_LIMIT = 1 << 25
+
+
+@with_x64
+def tensor_shard_sums(
+    owner_ix: np.ndarray, cell_id: np.ndarray, contrib: np.ndarray
+) -> Dict[Tuple[int, int], np.ndarray]:
+    """Host entry with the static variant routing (mirrors
+    `reconcile.shard_kernel_for`): packed when every owner < 4095 and
+    every cell < 2^25, else the wide fallback. → {(owner, cell):
+    int64 (width,) modular sums} — the parity surface the bench and
+    tests pin against the numpy oracle. Routing is decided on HOST
+    maxima before tracing; both variants are separately compiled."""
+    n = len(cell_id)
+    width = contrib.shape[1]
+    if n == 0:
+        return {}
+    real = cell_id != int(_PAD_CELL)
+    cell_max = int(cell_id.max(initial=0, where=real))
+    owner_max = int(owner_ix.max(initial=0))
+    packed = cell_max < _CELL_LIMIT and owner_max < _OWNER_LIMIT and n <= 1 << 24
+    metrics.inc("evolu_crdt_tensor_kernel_total",
+                variant="packed" if packed else "wide")
+    size = bucket_size(n)
+    o_p = np.concatenate([owner_ix.astype(np.int32),
+                          np.zeros(size - n, np.int32)])
+    c_p = np.concatenate([cell_id.astype(np.int32),
+                          np.full(size - n, int(_PAD_CELL), np.int32)])
+    v_p = np.concatenate([contrib.astype(np.uint64),
+                          np.zeros((size - n, width), np.uint64)])
+    with span("kernel:crdt", "tensor_shard_sums", n=n, width=width,
+              variant="packed" if packed else "wide"):
+        out: Dict[Tuple[int, int], np.ndarray] = {}
+        if packed:
+            grp, seg_end, sums = (np.asarray(x) for x in _shard_packed_jit(
+                jnp.asarray(o_p), jnp.asarray(c_p), jnp.asarray(v_p)))
+            mat = sums.reshape(width, size)
+            for i in np.nonzero(seg_end)[0]:
+                g = int(grp[i])
+                owner, cell = g >> 25, g & (_CELL_LIMIT - 1)
+                if owner == _OWNER_LIMIT:  # padding segment
+                    continue
+                out[(owner, cell)] = mat[:, i].copy().view(np.int64)
+        else:
+            own_s, c_s, seg_end, sums = (np.asarray(x) for x in _shard_wide_jit(
+                jnp.asarray(o_p), jnp.asarray(c_p), jnp.asarray(v_p)))
+            mat = sums.reshape(width, size)
+            for i in np.nonzero(seg_end)[0]:
+                if int(c_s[i]) == int(_PAD_CELL):
+                    continue
+                out[(int(own_s[i]), int(c_s[i]))] = \
+                    mat[:, i].copy().view(np.int64)
+        return out

@@ -344,6 +344,54 @@ class Evolu:
         return [(tag, _json.loads(v))
                 for tag, v in list_state(self.db, table, row_id, column)]
 
+    # -- tensor (declared-monoid numeric) mutations, ISSUE 20 --
+
+    def tensor_delta(self, table: str, row_id: str, column: str, array,
+                     count: int = 1) -> None:
+        """Tensor delta op for a `"<column>:tensor:<monoid>:…"` cell:
+        contributes `array` (validated against the DECLARED shape and
+        dtype) under the column's merge monoid — element-wise sum,
+        count-weighted mean (`count` is the mean monoid's weight; other
+        monoids reject it), or element-wise max. Commutative: no
+        observation needed, so no drain. The worker is flushed only to
+        read the declared config (schema reads ride the same
+        connection discipline as mutations)."""
+        from evolu_tpu.core.crdt_tensor import tensor_config, tensor_delta_value
+
+        self.worker.flush()
+        cfg = tensor_config(self.db, table, column)
+        self._mutate_raw([
+            NewCrdtMessage(table, row_id, column,
+                           tensor_delta_value(cfg, array, count))
+        ])
+
+    def tensor_set(self, table: str, row_id: str, column: str, array,
+                   count: int = 1) -> None:
+        """Tensor overwrite (the semidirect LWW fallback): the
+        latest-timestamped set resets the fold base; deltas timestamped
+        after it reapply on top. Unlike `set_remove`, an overwrite is
+        UNCONDITIONAL — it observes nothing, so there is no
+        drain-before-observe hazard to manage (the set_remove lesson
+        applies to reads, which `tensor_value` performs)."""
+        from evolu_tpu.core.crdt_tensor import tensor_config, tensor_set_value
+
+        self.worker.flush()
+        cfg = tensor_config(self.db, table, column)
+        self._mutate_raw([
+            NewCrdtMessage(table, row_id, column,
+                           tensor_set_value(cfg, array, count))
+        ])
+
+    def tensor_value(self, table: str, row_id: str, column: str):
+        """The materialized cell as a shaped numpy array (declared
+        dtype), or None if the app row does not exist — after draining
+        the worker (drain-before-observe), so a just-queued delta or
+        set from this replica is reflected."""
+        from evolu_tpu.core.crdt_tensor import tensor_state
+
+        self.worker.flush()
+        return tensor_state(self.db, table, row_id, column)
+
     def create(self, table: str, values: Dict[str, object], on_complete=None) -> str:
         values = dict(values)
         values.pop("id", None)

@@ -19,6 +19,14 @@ winner query for all touched cells, decision masks computed batch-wise
 (host here; `evolu_tpu.ops.merge` computes the same masks on device for
 large batches), then bulk SQL. Equivalence is property-tested in
 tests/test_apply.py.
+
+Typed CRDT cells (counter/awset/list and the tensor family, ISSUEs 7/
+14/20) ride the same transaction: `crdt_types.apply_typed_ops` folds
+new ops into the `__crdt_*` state tables (tensor: the `__crdt_tensor`
+op log) and materializes canonical bytes BEFORE the batch's __message
+insert, while `strip_typed_upserts` removes their LWW upserts from the
+plan. Packed batches containing ANY typed cell — tensor included —
+bounce to this object path BEFORE any side effect (the r5 contract).
 """
 
 from __future__ import annotations

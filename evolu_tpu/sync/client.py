@@ -625,6 +625,10 @@ class SyncTransport:
                 1 if protocol.CAP_CRDT_LIST in negotiated else 0,
             )
             metrics.set_gauge(
+                "evolu_crdt_tensor_capability_negotiated",
+                1 if protocol.CAP_CRDT_TENSOR in negotiated else 0,
+            )
+            metrics.set_gauge(
                 "evolu_crypto_aead_negotiated",
                 1 if protocol.CAP_AEAD_BATCH in negotiated else 0,
             )

@@ -291,8 +291,15 @@ CAP_AEAD_BATCH = "aead-batch-v1"
 # does not SERVE the capability ignores the clause (full serve — the
 # over-approximation-only stance: serving more is always sound).
 CAP_SYNC_SCOPE = "sync-scope-v1"
-KNOWN_CAPABILITIES = (CAP_CRDT_TYPES, CAP_CRDT_LIST, CAP_AEAD_BATCH,
-                      CAP_SYNC_SCOPE)
+# Tensor-valued CRDT columns (ISSUE 20, core/crdt_tensor.py): advisory
+# like crdt-types-v1 — tensor ops are ordinary E2EE-opaque messages,
+# so a non-advertising peer relays them byte-identically; the
+# capability only surfaces fleet support (e.g. to gate enabling
+# `"col:tensor:…"` columns for an owner shared with reference TS
+# peers, whose apply would LWW the op strings).
+CAP_CRDT_TENSOR = "crdt-tensor-v1"
+KNOWN_CAPABILITIES = (CAP_CRDT_TYPES, CAP_CRDT_LIST, CAP_CRDT_TENSOR,
+                      CAP_AEAD_BATCH, CAP_SYNC_SCOPE)
 _MAX_CAPABILITIES = 64  # decode bound: a hostile body must not mint unbounded strings
 # Scope-clause decode bounds (satellite: lane-cardinality hardening).
 # A hostile client must not mint unbounded per-scope state on the

@@ -58,10 +58,10 @@ class Config:
     # Wire capabilities advertised in every sync request (field 5 —
     # sync/protocol.py capability extension, ISSUE 7). The relay echoes
     # the intersection with its own set; () sends the v1 wire
-    # byte-identically. `crdt-types-v1` / `crdt-list-v1` (ISSUEs 7, 14)
-    # are advisory (typed CRDT ops are
-    # E2EE-opaque and relay through v1 peers unchanged; the echo only
-    # SURFACES fleet support). `aead-batch-v1` (ISSUE 8, sync/aead.py)
+    # byte-identically. `crdt-types-v1` / `crdt-list-v1` /
+    # `crdt-tensor-v1` (ISSUEs 7, 14, 20) are advisory (typed CRDT ops
+    # are E2EE-opaque and relay through v1 peers unchanged; the echo
+    # only SURFACES fleet support). `aead-batch-v1` (ISSUE 8, sync/aead.py)
     # GATES emission: only after a relay echoes it does the client send
     # session-keyed GCM records instead of per-message OpenPGP — the
     # ~10× crypto-ceiling lift (docs/WIRE_V2.md). Every client of this
@@ -73,7 +73,8 @@ class Config:
     # after the relay echoes it — an unscoped or unnegotiated round
     # stays byte-identical to v1.
     sync_capabilities: Tuple[str, ...] = (
-        "crdt-types-v1", "crdt-list-v1", "aead-batch-v1", "sync-scope-v1")
+        "crdt-types-v1", "crdt-list-v1", "crdt-tensor-v1",
+        "aead-batch-v1", "sync-scope-v1")
     # Partial replication (ISSUE 18, sync/scope.py::SyncScope): the
     # slice of the owner's log this client converges on — an HLC-millis
     # watermark ("recent history only") and/or a table filter (opaque

@@ -1837,3 +1837,241 @@ def _run_scoped_partial_replication_episode():
             r.dispose()
         for s in servers:
             s.stop()
+
+
+def test_tensor_crdt_partition_heal_adversarial_clocks_episode():
+    """ISSUE 20 satellite (ROADMAP #5 dose): tensor-valued columns
+    (sum / mean-by-count / max monoids with overwrite∘delta semidirect
+    composition) under regressing/stuttering HLC clocks through a
+    2-relay fleet with a partition/heal cycle and a mid-stream
+    non-canonical host-bounce. Asserts ELEMENT-EXACT tensor
+    convergence against the pure-numpy replay oracle, counter
+    exactness for the LWW/counter traffic riding along, winner-cache
+    == MAX(timestamp) on the device replica, and (via _evidence)
+    `ledger.audit()` returning zero violated equations."""
+    with _evidence("model-check-tensor-crdt", 20260807):
+        _run_tensor_crdt_episode()
+
+
+def _run_tensor_crdt_episode():
+    import numpy as np
+
+    from evolu_tpu.core import crdt_tensor as tz
+    from evolu_tpu.core import crdt_types as ct
+    from evolu_tpu.core.merkle import create_initial_merkle_tree
+    from evolu_tpu.core.timestamp import Timestamp, timestamp_to_string
+    from evolu_tpu.core.types import CrdtMessage
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.utils.config import FleetConfig
+
+    seed = 20260807
+    rng = random.Random(seed)
+    base = int(time.time() * 1000)
+
+    def adversarial_now(sub_seed):
+        r = random.Random(sub_seed)
+        state = {"t": base}
+
+        def now():
+            roll = r.random()
+            if roll < 0.4:
+                pass  # stutter: frozen clock
+            elif roll < 0.6:
+                state["t"] = max(base - 20_000,
+                                 state["t"] - r.randrange(0, 10_000))
+            else:
+                state["t"] += r.randrange(1, 400)
+            return state["t"]
+
+        return now
+
+    tensor_cols = {"weights": "tensor:sum:f32:4",
+                   "avg": "tensor:mean:f32:2",
+                   "peak": "tensor:max:f32:3"}
+    schema = {"models": ("name", "clicks:counter", "tags:awset",
+                         "steps:list") + tuple(
+                             f"{c}:{t}" for c, t in tensor_cols.items())}
+    cfgs = {c: tz.parse_tensor_type(t) for c, t in tensor_cols.items()}
+    a = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    b = RelayServer(RelayStore(), peers=[], replication_interval_s=30).start()
+    fleet_cfg = FleetConfig(relays=(a.url, b.url), replication_factor=1,
+                            version=1)
+    a.enable_fleet(fleet_cfg)
+    b.enable_fleet(fleet_cfg)
+    replicas = []
+    errors = []
+    try:
+        r1 = create_evolu(schema, config=Config(sync_url=a.url, backend="tpu"))
+        r2 = create_evolu(schema, config=Config(sync_url=b.url, backend="cpu"),
+                          mnemonic=r1.owner.mnemonic)
+        replicas = [r1, r2]
+        for i, r in enumerate(replicas):
+            r.worker.now = adversarial_now(seed + i)
+            r.subscribe_error(errors.append)
+            connect(r)
+
+        # Phase 1 (online): shared rows + overwrite bases, kept in sync.
+        rows = []
+        expected_sum = {}
+        for r in replicas:
+            rid = r.create("models", {"name": f"m-{id(r)}"})
+            r.worker.flush()
+            rows.append(rid)
+            expected_sum[rid] = 0
+        r1.tensor_set("models", rows[0], "weights", [10.0, 20.0, -5.0, 0.5])
+        r1.tensor_set("models", rows[0], "avg", [100.0, 200.0], count=2)
+        r1.worker.flush()
+        _converge(replicas)
+        assert metrics.get_gauge(
+            "evolu_crdt_tensor_capability_negotiated") == 1
+
+        def random_step(r, step, online):
+            roll = rng.random()
+            rid = rng.choice(rows)
+            if roll < 0.30:
+                col = rng.choice(("weights", "avg", "peak"))
+                cfg = cfgs[col]
+                vals = [rng.uniform(-25, 25) for _ in range(cfg.size)]
+                cnt = rng.randrange(1, 6) if cfg.monoid == "mean" else 1
+                r.tensor_delta("models", rid, col, vals, count=cnt)
+            elif roll < 0.38:
+                # A mid-stream overwrite: resets the fold base, later
+                # deltas reapply (the semidirect composition under fire).
+                col = rng.choice(("weights", "peak"))
+                cfg = cfgs[col]
+                r.tensor_set("models", rid, col,
+                             [rng.uniform(-25, 25) for _ in range(cfg.size)])
+            elif roll < 0.58:
+                d = rng.randrange(-50, 51)
+                r.increment("models", rid, "clicks", d)
+                expected_sum[rid] += d
+            elif roll < 0.72:
+                r.set_add("models", rid, "tags", rng.choice("abcd"))
+            elif roll < 0.80:
+                r.set_remove("models", rid, "tags", rng.choice("abcd"))
+            elif roll < 0.90:
+                r.list_append("models", rid, "steps", f"s{step}")
+            else:
+                r.update("models", rid, {"name": f"n{step}"})
+            r.worker.flush()
+            if online and rng.random() < 0.5:
+                s = rng.choice(replicas)
+                s.sync()
+                s.worker.flush()
+
+        for step in range(40):  # online phase
+            random_step(rng.choice(replicas), step, online=True)
+        _converge(replicas)
+
+        # Phase 2 (PARTITION): no sync rounds; both sides mutate the
+        # SAME tensor cells concurrently, including competing overwrites.
+        for step in range(40, 72):
+            random_step(replicas[step % 2], step, online=False)
+
+        # Mid-partition hostile case: a NON-CANONICAL (uppercase node
+        # hex) remote batch — the LWW cell bounces the device planner
+        # to the host oracle, and a tensor op in the SAME batch proves
+        # the tensor leg is canonicalization-blind (host raw-string
+        # ordering; the device never sees a timestamp). Injected into
+        # BOTH replicas so the merged histories stay identical.
+        bounces_before = metrics.get_counter("evolu_merge_host_fallbacks_total")
+        empty_tree = merkle_tree_to_string(create_initial_merkle_tree())
+
+        def nc_ts(i):
+            s = timestamp_to_string(
+                Timestamp(base + 5000 + i, i, "00000000000000ab"))
+            return s[:30] + s[30:].upper()
+
+        hostile = tuple(
+            [CrdtMessage(nc_ts(j), "models", "remrow", "name", f"h{j}")
+             for j in range(3)]
+            + [CrdtMessage(nc_ts(7), "models", "remrow", "weights",
+                           tz.tensor_delta_value(
+                               cfgs["weights"], [1.0, 2.0, 3.0, 4.0]))])
+        for r in replicas:
+            r.receive(hostile, empty_tree)
+            r.worker.flush()
+        assert metrics.get_counter(
+            "evolu_merge_host_fallbacks_total") > bounces_before
+
+        # Phase 3 (HEAL): sync rounds resume.
+        _converge(replicas)
+        for r in replicas:
+            r._transport.flush()
+            r.worker.flush()
+
+        from evolu_tpu.core.types import SyncError
+        real = [e for e in errors if not isinstance(e, SyncError)]
+        assert not real, real
+
+        dumps = []
+        for r in replicas:
+            dumps.append((
+                r.db.exec('SELECT * FROM "__message" ORDER BY "timestamp"'),
+                r.db.exec('SELECT * FROM "models" ORDER BY "id"'),
+                r.db.exec('SELECT * FROM "__crdt_tensor" ORDER BY "tag","column"'),
+                r.db.exec('SELECT * FROM "__crdt_counter" ORDER BY "row","column"'),
+                r.db.exec('SELECT * FROM "__crdt_set" ORDER BY "tag"'),
+                r.db.exec('SELECT * FROM "__crdt_list" ORDER BY "tag"'),
+            ))
+        assert dumps[0] == dumps[1], "state diverged after partition/heal"
+
+        # ELEMENT-EXACT tensor convergence: every materialized tensor
+        # cell equals the pure-numpy replay of the merged log, bit for
+        # bit (the any-permutation acceptance bar, end to end).
+        log_rows = r1.db.exec_sql_query(
+            'SELECT "timestamp", "table", "row", "column", "value" '
+            'FROM "__message" WHERE "table" = ?', ("models",))
+        types = {("models", c): t for c, t in tensor_cols.items()}
+        oracle = tz.replay_log(types, [
+            CrdtMessage(r["timestamp"], r["table"], r["row"], r["column"],
+                        r["value"]) for r in log_rows])
+        assert oracle, "episode produced no tensor traffic"
+        folded_cells = 0
+        for (table, rid, col), expected in oracle.items():
+            for r in replicas:
+                got = tz.tensor_state(r.db, table, rid, col)
+                assert got is not None and got.tobytes() == expected, \
+                    (rid, col)
+            folded_cells += 1
+        assert folded_cells >= 4  # the schedule exercised several cells
+        # The non-canonical tensor delta folded into its own cell.
+        assert np.array_equal(
+            tz.tensor_state(r1.db, "models", "remrow", "weights"),
+            np.asarray([1.0, 2.0, 3.0, 4.0], np.float32))
+
+        # Counter EXACTNESS rides along undisturbed.
+        for rid, total in expected_sum.items():
+            got = r1.db.exec_sql_query(
+                'SELECT "clicks" FROM "models" WHERE "id" = ?', (rid,)
+            )[0]["clicks"]
+            assert got == total, (rid, got, total)
+
+        # Fold integrity: rebuilding from the full log is a no-op.
+        schema_r1 = ct.load_schema(r1.db)
+        before = r1.db.exec('SELECT * FROM "__crdt_tensor" ORDER BY "tag"')
+        ct.rebuild_state(r1.db, schema_r1)
+        assert r1.db.exec(
+            'SELECT * FROM "__crdt_tensor" ORDER BY "tag"') == before
+
+        # Winner-cache == MAX(timestamp) on the device replica.
+        cache = r1.worker._planner.cache
+        w1 = np.asarray(cache._w1)
+        w2 = np.asarray(cache._w2)
+        for (table, rr, col), slot in cache._slots.items():
+            got = r1.db.exec_sql_query(
+                'SELECT MAX("timestamp") AS m FROM "__message" '
+                'WHERE "table" = ? AND "row" = ? AND "column" = ?',
+                (table, rr, col))[0]["m"]
+            k1, k2 = int(w1[slot]), int(w2[slot])
+            if k1 == 0 and k2 == 0:
+                assert got is None, (table, rr, col)
+                continue
+            cached_ts = timestamp_to_string(
+                Timestamp(k1 >> 16, k1 & 0xFFFF, f"{k2:016x}"))
+            assert cached_ts == got, (table, rr, col)
+    finally:
+        for r in replicas:
+            r.dispose()
+        a.stop()
+        b.stop()

@@ -447,3 +447,82 @@ def test_packed_typed_cells_bounce_before_side_effects():
         results[mode] = (dump(db), tree)
         db.close()
     assert results["objects"] == results["packed"]
+
+
+def test_packed_tensor_cells_bounce_before_side_effects():
+    """ISSUE 20 satellite: tensor cells in a packed batch take the
+    SAME pre-side-effect bounce as the other typed families — the
+    packed C cell-apply would LWW-upsert the raw op JSON where the
+    semidirect fold needs message objects. Pinned exactly like the
+    ISSUE 7 leg: plan_packed never consulted, the bounce counter
+    moves, end state equals the pure object path bit-for-bit."""
+    from evolu_tpu.core import crdt_tensor as tz
+    from evolu_tpu.core.types import TableDefinition
+    from evolu_tpu.obs import metrics
+    from evolu_tpu.runtime.worker import select_planner
+    from evolu_tpu.storage.schema import update_db_schema
+
+    cfg_sum = tz.parse_tensor_type("tensor:sum:f32:2")
+    cfg_max = tz.parse_tensor_type("tensor:max:bf16:3")
+    rng = random.Random(20)
+    base = 1_700_000_000_000
+    msgs = []
+    for i in range(200):
+        ts = timestamp_to_string(
+            Timestamp(base + i * 977, i % 3, "a1b2c3d4e5f60718"))
+        roll = rng.random()
+        row = f"row{rng.randrange(8)}"
+        if roll < 0.35:
+            vals = [rng.uniform(-20, 20), rng.uniform(-20, 20)]
+            mk = tz.tensor_set_value if rng.random() < 0.3 \
+                else tz.tensor_delta_value
+            msgs.append(CrdtMessage(ts, "todo", row, "weights",
+                                    mk(cfg_sum, vals)))
+        elif roll < 0.55:
+            vals = [rng.uniform(-8, 8) for _ in range(3)]
+            msgs.append(CrdtMessage(ts, "todo", row, "peak",
+                                    tz.tensor_delta_value(cfg_max, vals)))
+        elif roll < 0.62:  # malformed tensor traffic rides along
+            msgs.append(CrdtMessage(ts, "todo", row, "weights",
+                                    rng.choice(["junk", '["d","x!"]'])))
+        else:
+            msgs.append(CrdtMessage(ts, "todo", row, "title", f"t{i}"))
+    resp = _response_bytes(msgs)
+    pb, _tree = native_crypto.decrypt_response_columns(resp, MN)
+    assert pb is not None
+
+    def mkdb():
+        db = open_database(backend="auto")
+        init_db_model(db, mnemonic=None)
+        update_db_schema(db, [TableDefinition.of(
+            "todo",
+            ("title", "weights:tensor:sum:f32:2", "peak:tensor:max:bf16:3"))])
+        return db
+
+    def dump(db):
+        return (
+            db.exec_sql_query(
+                'SELECT * FROM "__message" '
+                'ORDER BY "timestamp","table","row","column"', ()),
+            db.exec_sql_query('SELECT * FROM "todo" ORDER BY "id"', ()),
+            db.exec_sql_query(
+                'SELECT * FROM "__crdt_tensor" ORDER BY "tag","column"', ()),
+        )
+
+    results = {}
+    for mode in ("objects", "packed"):
+        db = mkdb()
+        planner = select_planner(Config(min_device_batch=64), db)
+        calls = []
+        orig = planner.plan_packed
+        planner.plan_packed = lambda p: (calls.append(1), orig(p))[1]
+        before = metrics.get_counter("evolu_crdt_packed_bounces_total")
+        batch = tuple(msgs) if mode == "objects" else pb
+        tree = apply_messages(db, {}, batch, planner=planner)
+        if mode == "packed":
+            assert not calls, "plan_packed ran on a tensor batch"
+            assert metrics.get_counter(
+                "evolu_crdt_packed_bounces_total") == before + 1
+        results[mode] = (dump(db), tree)
+        db.close()
+    assert results["objects"] == results["packed"]

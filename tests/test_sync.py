@@ -794,7 +794,8 @@ def test_capability_extension_codec_and_v1_byte_identity():
     # No capabilities => no field 5 anywhere (v1 bytes).
     assert protocol.encode_request_capabilities(()) == b""
     assert protocol.decode_sync_request(b0).capabilities == ()
-    caps = (protocol.CAP_CRDT_TYPES, protocol.CAP_CRDT_LIST, "future-cap")
+    caps = (protocol.CAP_CRDT_TYPES, protocol.CAP_CRDT_LIST,
+            protocol.CAP_CRDT_TENSOR, "future-cap")
     b1 = protocol.encode_sync_request(
         protocol.SyncRequest((), "uid", "node", "{}", caps))
     assert b1 == b0 + protocol.encode_request_capabilities(caps)
@@ -841,7 +842,8 @@ def test_capability_negotiation_v1_relay_fallback():
     body = protocol.encode_sync_request(
         protocol.SyncRequest((), "ownerX", "node", "{}"))
     adv = body + protocol.encode_request_capabilities(
-        (protocol.CAP_CRDT_TYPES, protocol.CAP_CRDT_LIST, "not-a-real-cap"))
+        (protocol.CAP_CRDT_TYPES, protocol.CAP_CRDT_LIST,
+         protocol.CAP_CRDT_TENSOR, "not-a-real-cap"))
     current = RelayServer(RelayStore()).start()
     v1 = RelayServer(RelayStore(), capabilities=()).start()
     try:
@@ -849,7 +851,8 @@ def test_capability_negotiation_v1_relay_fallback():
         assert protocol.scan_sync_response_capabilities(plain) == ()
         negotiated = post(current.url, adv)
         assert negotiated == plain + protocol.encode_response_capabilities(
-            (protocol.CAP_CRDT_TYPES, protocol.CAP_CRDT_LIST))
+            (protocol.CAP_CRDT_TYPES, protocol.CAP_CRDT_LIST,
+             protocol.CAP_CRDT_TENSOR))
         assert post(v1.url, adv) == plain  # v1 fallback: byte-identical
     finally:
         current.stop()
